@@ -1,0 +1,22 @@
+"""dgmc_tpu — a TPU-native (JAX/XLA/Pallas) deep graph matching consensus
+framework.
+
+Re-implements the full capability surface of the PyTorch reference
+``deep-graph-matching-consensus`` (Fey et al., ICLR 2020; see
+``/root/reference/dgmc/__init__.py``) with a TPU-first design: padded
+static-shape graph batches, functional modules with explicit PRNG keys,
+segment-sum message passing, blockwise top-k instead of KeOps, and
+``shard_map``-sharded correspondence matrices for multi-chip scale-out.
+"""
+
+try:  # models land after ops in the build order; keep ops importable alone.
+    from dgmc_tpu.models.dgmc import DGMC
+except ImportError:  # pragma: no cover
+    DGMC = None
+
+__version__ = '0.1.0'
+
+__all__ = [
+    'DGMC',
+    '__version__',
+]

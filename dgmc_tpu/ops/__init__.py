@@ -1,0 +1,19 @@
+from dgmc_tpu.ops.graph import (GraphBatch, gather_nodes, scatter_to_nodes,
+                                degree)
+from dgmc_tpu.ops.softmax import masked_softmax
+from dgmc_tpu.ops.segment import segment_sum, segment_mean
+from dgmc_tpu.ops.topk import chunked_topk, dense_topk
+from dgmc_tpu.ops.spline import open_spline_basis
+
+__all__ = [
+    'GraphBatch',
+    'gather_nodes',
+    'scatter_to_nodes',
+    'degree',
+    'masked_softmax',
+    'segment_sum',
+    'segment_mean',
+    'chunked_topk',
+    'dense_topk',
+    'open_spline_basis',
+]

@@ -1,0 +1,102 @@
+"""Padded, statically-shaped graph batches — the TPU-native data model.
+
+The reference keeps graphs ragged (flat node lists + a ``batch`` vector,
+densified on demand via ``to_dense_batch``, reference
+``dgmc/models/dgmc.py:154-158``; collation with ``__inc__`` edge-index
+offsets, reference ``dgmc/utils/data.py:9-16``). XLA wants static shapes, so
+here the padded representation *is* the representation: every batch of graphs
+lives in ``[B, N, ...]`` / ``[B, E, ...]`` arrays with boolean validity
+masks, and edge endpoints are graph-local indices. ``to_dense_batch`` and
+``Batch`` collation therefore vanish from the device path entirely — they
+happen once, host-side, at dataset build time (see
+``dgmc_tpu/utils/data.py``).
+"""
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+
+@struct.dataclass
+class GraphBatch:
+    """A batch of ``B`` graphs padded to ``N`` nodes and ``E`` edges each.
+
+    Attributes:
+        x: ``[B, N, C]`` node features (zeros at padding).
+        senders: ``[B, E]`` int32 graph-local source node of each edge.
+        receivers: ``[B, E]`` int32 graph-local target node of each edge.
+        node_mask: ``[B, N]`` bool, True at real nodes.
+        edge_mask: ``[B, E]`` bool, True at real edges. Padded edges point at
+            node 0 and must be masked out of every aggregation.
+        edge_attr: optional ``[B, E, D]`` edge features (pseudo-coordinates
+            for SplineCNN).
+    """
+    x: jnp.ndarray
+    senders: jnp.ndarray
+    receivers: jnp.ndarray
+    node_mask: jnp.ndarray
+    edge_mask: jnp.ndarray
+    edge_attr: Optional[jnp.ndarray] = None
+
+    @property
+    def num_graphs(self):
+        return self.x.shape[0]
+
+    @property
+    def num_nodes(self):
+        return self.x.shape[1]
+
+    @property
+    def num_edges(self):
+        return self.senders.shape[1]
+
+    def replace_x(self, x):
+        return self.replace(x=x)
+
+    def astype(self, dtype):
+        g = self.replace(x=self.x.astype(dtype))
+        if self.edge_attr is not None:
+            g = g.replace(edge_attr=self.edge_attr.astype(dtype))
+        return g
+
+
+def gather_nodes(x, idx):
+    """Batched node gather: ``x[b, idx[b, e]]``.
+
+    x: ``[B, N, C]``, idx: ``[B, E]`` → ``[B, E, C]``.
+    """
+    return jnp.take_along_axis(x, idx[..., None], axis=1)
+
+
+def scatter_to_nodes(messages, receivers, edge_mask, num_nodes, aggr='sum'):
+    """Batched edge→node aggregation (the ``MessagePassing`` reduce step).
+
+    messages: ``[B, E, C]``, receivers: ``[B, E]``, edge_mask: ``[B, E]``.
+    Returns ``[B, N, C]``. ``aggr`` is ``'sum'`` or ``'mean'`` (masked; empty
+    neighborhoods give zeros, matching PyG's behavior the reference relies
+    on).
+    """
+    messages = jnp.where(edge_mask[..., None], messages, 0)
+
+    def one(m, r):
+        return jax.ops.segment_sum(m, r, num_segments=num_nodes)
+
+    out = jax.vmap(one)(messages, receivers)
+    if aggr == 'mean':
+        deg = degree(receivers, edge_mask, num_nodes)
+        out = out / jnp.maximum(deg, 1.0)[..., None]
+    elif aggr != 'sum':
+        raise ValueError(f'Unknown aggregation: {aggr!r}')
+    return out
+
+
+def degree(receivers, edge_mask, num_nodes):
+    """Masked in-degree per node: ``[B, E]`` → ``[B, N]`` float."""
+
+    def one(r, m):
+        return jax.ops.segment_sum(m.astype(jnp.float32), r,
+                                   num_segments=num_nodes)
+
+    return jax.vmap(one)(receivers, edge_mask)

@@ -1,0 +1,38 @@
+"""Segment reductions — the TPU-native replacement for ``torch_scatter``.
+
+The reference consumes ``torch_scatter.scatter_add`` (reference
+``dgmc/models/dgmc.py:3,212``) and mean-aggregation inside every PyG
+``MessagePassing`` layer (reference ``dgmc/models/rel.py:9``). On TPU these
+become XLA segment reductions, which lower to efficient one-hot matmuls or
+scatters that XLA can fuse with their producers.
+
+All functions take a static ``num_segments`` so shapes stay known to the
+compiler.
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def segment_sum(data, segment_ids, num_segments):
+    """Sum ``data`` rows into ``num_segments`` buckets given by ``segment_ids``.
+
+    data: ``[E, ...]``, segment_ids: ``[E]`` int32. Returns ``[num_segments, ...]``.
+    """
+    return jax.ops.segment_sum(data, segment_ids, num_segments=num_segments)
+
+
+def segment_mean(data, segment_ids, num_segments, weights=None):
+    """Mean-reduce ``data`` rows per segment.
+
+    ``weights`` (optional ``[E]`` float, e.g. an edge-validity mask) scales
+    each row's contribution and the denominator; empty segments yield zeros.
+    """
+    if weights is not None:
+        data = data * weights[..., None]
+        counts = segment_sum(weights, segment_ids, num_segments)
+    else:
+        counts = segment_sum(jnp.ones(segment_ids.shape, data.dtype),
+                             segment_ids, num_segments)
+    totals = segment_sum(data, segment_ids, num_segments)
+    return totals / jnp.maximum(counts, 1.0)[..., None]

@@ -1,0 +1,25 @@
+"""Masked softmax over padded correspondence scores.
+
+Mirrors the reference's ``masked_softmax`` (reference
+``dgmc/models/dgmc.py:15-19``: fill ``-inf`` outside the mask, softmax, zero
+outside the mask) but is safe for fully-masked rows (padded source nodes),
+which would produce NaNs in a naive implementation.
+"""
+
+import jax.numpy as jnp
+
+
+def masked_softmax(src, mask, axis=-1):
+    """Softmax of ``src`` along ``axis`` restricted to ``mask``.
+
+    Entries outside ``mask`` get probability 0. Rows with no valid entry
+    return all zeros instead of NaN.
+    """
+    neg = jnp.finfo(src.dtype).min
+    masked = jnp.where(mask, src, neg)
+    m = jnp.max(masked, axis=axis, keepdims=True)
+    # Guard fully-masked rows: their max is `neg`; shift so exp() is finite.
+    m = jnp.where(jnp.isfinite(m), m, 0.0)
+    e = jnp.exp(masked - m) * mask.astype(src.dtype)
+    denom = jnp.sum(e, axis=axis, keepdims=True)
+    return e / jnp.maximum(denom, jnp.finfo(src.dtype).tiny)

@@ -1,0 +1,60 @@
+"""Closed-form open B-spline basis — the ``torch_spline_conv`` replacement.
+
+The reference's ``SplineCNN`` backbone delegates to the C++/CUDA
+``torch_spline_conv`` kernel via PyG's ``SplineConv`` (reference
+``dgmc/models/spline.py:4,21``; degree-1 open splines, ``kernel_size=5`` per
+pseudo-coordinate dimension). For degree 1 the basis is closed-form: each
+dimension has exactly two active knots with hat-function weights
+``(1 - frac, frac)``, so an edge activates ``2^D`` of the ``K^D`` kernel
+weight matrices with product weights. That is a handful of elementwise ops —
+no custom kernel needed for the basis itself; the heavy lifting (weighting
+node features with the basis) is laid out as a single MXU matmul in
+``dgmc_tpu/models/spline.py``.
+"""
+
+import itertools
+
+import jax.numpy as jnp
+
+
+def open_spline_basis(pseudo, kernel_size, degree=1):
+    """Degree-1 open B-spline basis over pseudo-coordinates in ``[0, 1]``.
+
+    Args:
+        pseudo: ``[..., D]`` edge pseudo-coordinates.
+        kernel_size: knots per dimension (the reference uses 5).
+        degree: only 1 is supported (the reference never uses another).
+
+    Returns:
+        ``(basis, combo_idx)`` with shapes ``[..., 2**D]``: the product basis
+        weight of each active knot combination and its flattened index into
+        the ``K**D`` kernel weight axis (dimension 0 has stride 1, matching
+        a C-order enumeration ``idx = sum_d knot_d * K**d``).
+    """
+    if degree != 1:
+        raise NotImplementedError('Only degree-1 (linear) open B-splines are '
+                                  'supported, as in the reference.')
+    K = kernel_size
+    D = pseudo.shape[-1]
+
+    p = jnp.clip(pseudo, 0.0, 1.0) * (K - 1)
+    lo = jnp.clip(jnp.floor(p), 0, K - 2).astype(jnp.int32)   # [..., D]
+    frac = p - lo                                             # in [0, 1]
+
+    w = jnp.stack([1.0 - frac, frac], axis=-1)                # [..., D, 2]
+    knot = jnp.stack([lo, lo + 1], axis=-1)                   # [..., D, 2]
+
+    combos = list(itertools.product((0, 1), repeat=D))        # 2^D tuples
+    basis_terms = []
+    idx_terms = []
+    for combo in combos:
+        bw = jnp.ones(pseudo.shape[:-1], dtype=pseudo.dtype)
+        fi = jnp.zeros(pseudo.shape[:-1], dtype=jnp.int32)
+        for d, c in enumerate(combo):
+            bw = bw * w[..., d, c]
+            fi = fi + knot[..., d, c] * (K ** d)
+        basis_terms.append(bw)
+        idx_terms.append(fi)
+    basis = jnp.stack(basis_terms, axis=-1)                   # [..., 2^D]
+    combo_idx = jnp.stack(idx_terms, axis=-1)                 # [..., 2^D]
+    return basis, combo_idx

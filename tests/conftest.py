@@ -1,0 +1,19 @@
+"""Test bootstrap: force a virtual 8-device CPU platform so sharding tests
+run anywhere (SURVEY.md §4's multi-device plan). Bench and examples still
+target the real TPU.
+
+Note: the environment may pre-register an experimental TPU plugin at
+interpreter startup and programmatically set ``jax_platforms``, so setting
+the env var here is not enough — we must override the live config before any
+backend is initialized.
+"""
+
+import os
+
+os.environ['JAX_PLATFORMS'] = 'cpu'
+os.environ['XLA_FLAGS'] = (os.environ.get('XLA_FLAGS', '') +
+                           ' --xla_force_host_platform_device_count=8')
+
+import jax  # noqa: E402
+
+jax.config.update('jax_platforms', 'cpu')

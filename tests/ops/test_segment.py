@@ -1,0 +1,26 @@
+import jax.numpy as jnp
+import numpy as np
+
+from dgmc_tpu.ops import segment_sum, segment_mean
+
+
+def test_segment_sum():
+    data = jnp.array([[1.0, 2.0], [3.0, 4.0], [5.0, 6.0]])
+    ids = jnp.array([0, 2, 0])
+    out = segment_sum(data, ids, 3)
+    np.testing.assert_allclose(out, [[6.0, 8.0], [0.0, 0.0], [3.0, 4.0]])
+
+
+def test_segment_mean():
+    data = jnp.array([[2.0], [4.0], [6.0]])
+    ids = jnp.array([1, 1, 0])
+    out = segment_mean(data, ids, 3)
+    np.testing.assert_allclose(out, [[6.0], [3.0], [0.0]])
+
+
+def test_segment_mean_weighted():
+    data = jnp.array([[2.0], [4.0], [6.0]])
+    ids = jnp.array([0, 0, 0])
+    w = jnp.array([1.0, 1.0, 0.0])  # mask out the last edge
+    out = segment_mean(data, ids, 1, weights=w)
+    np.testing.assert_allclose(out, [[3.0]])

@@ -1,0 +1,28 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dgmc_tpu.ops import masked_softmax
+
+
+def test_matches_plain_softmax_when_unmasked():
+    x = jax.random.normal(jax.random.PRNGKey(0), (3, 5))
+    mask = jnp.ones((3, 5), dtype=bool)
+    np.testing.assert_allclose(masked_softmax(x, mask),
+                               jax.nn.softmax(x, axis=-1), rtol=1e-6)
+
+
+def test_masked_entries_are_zero_and_rest_renormalized():
+    x = jnp.array([[1.0, 2.0, 3.0]])
+    mask = jnp.array([[True, False, True]])
+    out = masked_softmax(x, mask)
+    assert out[0, 1] == 0.0
+    np.testing.assert_allclose(out.sum(), 1.0, rtol=1e-6)
+    np.testing.assert_allclose(out[0, 2] / out[0, 0], np.exp(2.0), rtol=1e-5)
+
+
+def test_fully_masked_row_is_zero_not_nan():
+    x = jnp.array([[1.0, 2.0]])
+    mask = jnp.zeros((1, 2), dtype=bool)
+    out = masked_softmax(x, mask)
+    np.testing.assert_allclose(out, jnp.zeros((1, 2)))

@@ -1,0 +1,33 @@
+import jax.numpy as jnp
+import numpy as np
+
+from dgmc_tpu.ops import open_spline_basis
+
+
+def test_basis_partition_of_unity():
+    pseudo = jnp.array([[0.0, 0.0], [0.3, 0.7], [1.0, 1.0], [0.5, 0.123]])
+    basis, combo = open_spline_basis(pseudo, kernel_size=5)
+    assert basis.shape == (4, 4) and combo.shape == (4, 4)
+    np.testing.assert_allclose(basis.sum(-1), jnp.ones(4), rtol=1e-6)
+
+
+def test_basis_at_knot_is_one_hot():
+    # pseudo 0.25 in K=5 lands exactly on knot 1.
+    basis, combo = open_spline_basis(jnp.array([[0.25]]), kernel_size=5)
+    np.testing.assert_allclose(basis[0], [1.0, 0.0])
+    assert combo[0, 0] == 1
+
+
+def test_basis_boundaries():
+    basis, combo = open_spline_basis(jnp.array([[0.0], [1.0]]), kernel_size=5)
+    # pseudo=0 → knot 0 fully; pseudo=1 → knot 4 fully.
+    np.testing.assert_allclose(basis[0], [1.0, 0.0])
+    assert combo[0, 0] == 0
+    np.testing.assert_allclose(basis[1], [0.0, 1.0])
+    assert combo[1, 1] == 4
+
+
+def test_flat_index_layout_2d():
+    # knot (i, j) → i + K*j.
+    basis, combo = open_spline_basis(jnp.array([[0.25, 0.5]]), kernel_size=5)
+    assert combo[0, 0] == 1 + 5 * 2

@@ -1,0 +1,38 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dgmc_tpu.ops import chunked_topk, dense_topk
+
+
+def test_chunked_matches_dense():
+    key = jax.random.PRNGKey(0)
+    k1, k2 = jax.random.split(key)
+    h_s = jax.random.normal(k1, (2, 17, 8))
+    h_t = jax.random.normal(k2, (2, 53, 8))
+    for k in (1, 5, 10):
+        idx_d = dense_topk(h_s, h_t, k)
+        idx_c = chunked_topk(h_s, h_t, k, block=16)
+        np.testing.assert_array_equal(idx_d, idx_c)
+
+
+def test_chunked_matches_dense_with_mask():
+    key = jax.random.PRNGKey(1)
+    k1, k2, k3 = jax.random.split(key, 3)
+    h_s = jax.random.normal(k1, (3, 9, 4))
+    h_t = jax.random.normal(k2, (3, 31, 4))
+    t_mask = jax.random.bernoulli(k3, 0.7, (3, 31))
+    idx_d = dense_topk(h_s, h_t, 4, t_mask=t_mask)
+    idx_c = chunked_topk(h_s, h_t, 4, t_mask=t_mask, block=8)
+    np.testing.assert_array_equal(idx_d, idx_c)
+
+
+def test_tie_breaking_prefers_lower_index():
+    # All-equal scores: top-k must pick the lowest target indices, in order,
+    # in both implementations.
+    h_s = jnp.ones((1, 3, 2))
+    h_t = jnp.ones((1, 20, 2))
+    idx_d = dense_topk(h_s, h_t, 4)
+    idx_c = chunked_topk(h_s, h_t, 4, block=4)
+    np.testing.assert_array_equal(idx_d, np.tile(np.arange(4), (1, 3, 1)))
+    np.testing.assert_array_equal(idx_c, idx_d)
