@@ -1,0 +1,19 @@
+from dgmc_tpu.models.mlp import MLP
+from dgmc_tpu.models.norm import MaskedBatchNorm
+from dgmc_tpu.models.gin import GIN, GINConv
+from dgmc_tpu.models.rel import RelCNN, RelConv
+from dgmc_tpu.models.spline import SplineCNN, SplineConv
+from dgmc_tpu.models.dgmc import DGMC, Correspondence
+
+__all__ = [
+    'MLP',
+    'MaskedBatchNorm',
+    'GIN',
+    'GINConv',
+    'RelCNN',
+    'RelConv',
+    'SplineCNN',
+    'SplineConv',
+    'DGMC',
+    'Correspondence',
+]

@@ -1,0 +1,241 @@
+"""Deep Graph Matching Consensus — TPU-native core algorithm.
+
+Capability parity with the reference ``DGMC`` module (reference
+``dgmc/models/dgmc.py:32-319``): a two-stage matcher that (1) computes an
+initial soft correspondence ``S^0`` from ψ₁ node embeddings and (2) refines
+it for ``num_steps`` neighborhood-consensus iterations — per step, random
+node indicator functions ``r_s`` are projected through ``S`` onto the target
+graph, both graphs run ψ₂, and an MLP on the difference of the resulting
+"consensus colourings" updates the correspondence logits. Dense
+(``k == -1``) and sparse top-k variants are supported, with random negative
+sampling and guaranteed ground-truth inclusion during sparse training
+(reference ``dgmc.py:190-195``).
+
+TPU-first design decisions:
+
+- Padded static shapes everywhere; correspondences are a single
+  :class:`Correspondence` pytree (``idx=None`` ⇒ dense) rather than
+  ``torch.sparse_coo_tensor`` with smuggled ``__idx__``/``__val__`` attrs
+  (the reference's downstream math only ever touches those two tensors, see
+  reference ``dgmc.py:236-242``).
+- Explicit PRNG streams: ``'noise'`` for per-step indicator functions,
+  ``'negatives'`` for sparse negative sampling, ``'dropout'`` for the
+  backbones. Dense and sparse paths draw identical per-step noise from the
+  same stream, preserving the reference's dense≡sparse(k=N) behavioral
+  contract (reference ``test/models/test_dgmc.py:29-84``) under explicit
+  keys.
+- Top-k runs blockwise over target tiles (``dgmc_tpu/ops/topk.py``) — the
+  KeOps ``argKmin`` replacement — so the ``N_s x N_t`` score matrix is never
+  materialized in the sparse path.
+- ``num_steps`` / ``detach`` are call-time arguments (trace-time static),
+  replacing the reference's mid-training module-attribute mutation
+  (reference ``examples/dbp15k.py:63-69``) with explicit phase config.
+"""
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+from flax import struct
+
+from dgmc_tpu.ops.softmax import masked_softmax
+from dgmc_tpu.ops.topk import chunked_topk
+
+EPS = 1e-8
+
+
+@struct.dataclass
+class Correspondence:
+    """Soft correspondence matrix, dense or sparse.
+
+    Dense: ``val[B, N_s, N_t]`` with ``idx is None``.
+    Sparse: ``val[B, N_s, K]`` probabilities over candidate targets
+    ``idx[B, N_s, K]``.
+    """
+    val: jnp.ndarray
+    idx: Optional[jnp.ndarray]
+    src_mask: jnp.ndarray  # [B, N_s]
+    tgt_mask: jnp.ndarray  # [B, N_t]
+
+    @property
+    def is_sparse(self):
+        return self.idx is not None
+
+    def to_dense(self):
+        """Scatter a sparse correspondence back to ``[B, N_s, N_t]``."""
+        if not self.is_sparse:
+            return self.val
+        B, N_s, K = self.val.shape
+        N_t = self.tgt_mask.shape[1]
+        out = jnp.zeros((B, N_s, N_t), self.val.dtype)
+        b = jnp.arange(B)[:, None, None]
+        s = jnp.arange(N_s)[None, :, None]
+        return out.at[b, s, self.idx].add(self.val)
+
+
+def include_gt(S_idx, y_col, y_mask):
+    """Overwrite the *last* candidate slot with the ground-truth column for
+    every valid row whose ground truth is not already present — the sparse
+    training guarantee of the reference's ``__include_gt__`` (reference
+    ``dgmc/models/dgmc.py:96-112``).
+
+    S_idx: ``[B, N_s, K]``; y_col: ``[B, N_s]``; y_mask: ``[B, N_s]``.
+    """
+    present = (S_idx == y_col[..., None]).any(axis=-1)
+    replace = y_mask & ~present
+    new_last = jnp.where(replace, y_col, S_idx[..., -1])
+    return S_idx.at[..., -1].set(new_last)
+
+
+class DGMC(nn.Module):
+    """Two-stage graph matching with iterative neighborhood consensus.
+
+    Args:
+        psi_1: feature GNN; called as ``psi_1(x, graph, train=...)``.
+        psi_2: consensus GNN; must expose ``in_channels``/``out_channels``
+            (the indicator-function width and consensus-colouring width).
+        num_steps: default number of consensus iterations.
+        k: ``-1`` for the dense variant, else the top-k sparsity.
+        detach: default for cutting ψ₁ gradients during refinement.
+    """
+    psi_1: nn.Module
+    psi_2: nn.Module
+    num_steps: int
+    k: int = -1
+    detach: bool = False
+    topk_block: int = 1024
+
+    @nn.compact
+    def __call__(self, graph_s, graph_t, y=None, y_mask=None, train=False,
+                 num_steps=None, detach=None):
+        """Compute initial and refined correspondences ``(S_0, S_L)``.
+
+        Args:
+            graph_s / graph_t: padded :class:`GraphBatch` pairs.
+            y: optional ``[B, N_s]`` ground-truth target column per source
+                node (used only by the sparse variant during training, to
+                inject negatives + the ground truth).
+            y_mask: ``[B, N_s]`` validity of ``y``.
+            train: enables dropout / BN batch stats / negative sampling.
+            num_steps / detach: per-call overrides of the module defaults —
+                the explicit-phase replacement for the reference's
+                attribute-mutation schedule.
+        """
+        num_steps = self.num_steps if num_steps is None else num_steps
+        detach = self.detach if detach is None else detach
+
+        h_s = self.psi_1(graph_s.x, graph_s, train=train)
+        h_t = self.psi_1(graph_t.x, graph_t, train=train)
+        if detach:
+            h_s = jax.lax.stop_gradient(h_s)
+            h_t = jax.lax.stop_gradient(h_t)
+
+        s_mask, t_mask = graph_s.node_mask, graph_t.node_mask
+        (B, N_s), N_t = s_mask.shape, t_mask.shape[1]
+        R_in = self.psi_2.in_channels
+        R_out = self.psi_2.out_channels
+
+        mlp_hidden = nn.Dense(R_out, name='mlp_hidden')
+        mlp_out = nn.Dense(1, name='mlp_out')
+
+        def consensus_mlp(d):
+            return mlp_out(nn.relu(mlp_hidden(d)))[..., 0]
+
+        def noise(step):
+            key = self.make_rng('noise')
+            return jax.random.normal(key, (B, N_s, R_in), h_s.dtype)
+
+        if self.k < 1:
+            # ---- Dense variant ----
+            S_hat = jnp.einsum('bsc,btc->bst', h_s, h_t)
+            S_mask = s_mask[:, :, None] & t_mask[:, None, :]
+            S_0 = masked_softmax(S_hat, S_mask)
+
+            for step in range(num_steps):
+                S = masked_softmax(S_hat, S_mask)
+                r_s = noise(step)
+                r_t = jnp.einsum('bst,bsr->btr', S, r_s)
+                o_s = self.psi_2(r_s, graph_s, train=train)
+                o_t = self.psi_2(r_t, graph_t, train=train)
+                D = o_s[:, :, None, :] - o_t[:, None, :, :]
+                S_hat = S_hat + jnp.where(S_mask, consensus_mlp(D), 0.0)
+
+            S_L = masked_softmax(S_hat, S_mask)
+            return (Correspondence(S_0, None, s_mask, t_mask),
+                    Correspondence(S_L, None, s_mask, t_mask))
+
+        # ---- Sparse (top-k) variant ----
+        S_idx = chunked_topk(h_s, h_t, self.k, t_mask=t_mask,
+                             block=self.topk_block)
+
+        if train and y is not None:
+            if y_mask is None:
+                y_mask = jnp.ones(y.shape, bool)
+            num_rnd = min(self.k, N_t - self.k)
+            if num_rnd > 0:
+                u = jax.random.uniform(self.make_rng('negatives'),
+                                       (B, N_s, num_rnd))
+                n_valid = t_mask.sum(axis=-1).astype(u.dtype)  # [B]
+                rnd = jnp.floor(u * n_valid[:, None, None]).astype(jnp.int32)
+                S_idx = jnp.concatenate([S_idx, rnd], axis=-1)
+            S_idx = include_gt(S_idx, y, y_mask & s_mask)
+
+        def gather_t(feat, idx):
+            # feat [B, N_t, C], idx [B, N_s, K] -> [B, N_s, K, C]
+            Bk, Ns_, K_ = idx.shape
+            flat = jnp.take_along_axis(feat, idx.reshape(Bk, Ns_ * K_, 1),
+                                       axis=1)
+            return flat.reshape(Bk, Ns_, K_, feat.shape[-1])
+
+        entry_mask = jnp.take_along_axis(
+            t_mask, S_idx.reshape(B, -1), axis=1).reshape(S_idx.shape)
+
+        h_t_cand = gather_t(h_t, S_idx)
+        S_hat = jnp.einsum('bsc,bskc->bsk', h_s, h_t_cand)
+        S_0 = masked_softmax(S_hat, entry_mask) * s_mask[..., None]
+
+        K = S_idx.shape[-1]
+        for step in range(num_steps):
+            S = masked_softmax(S_hat, entry_mask) * s_mask[..., None]
+            r_s = noise(step)
+            contrib = S[..., None] * r_s[:, :, None, :]   # [B, N_s, K, R_in]
+
+            def scat(c, idx):
+                return jax.ops.segment_sum(c, idx, num_segments=N_t)
+
+            r_t = jax.vmap(scat)(contrib.reshape(B, N_s * K, R_in),
+                                 S_idx.reshape(B, N_s * K))
+            o_s = self.psi_2(r_s, graph_s, train=train)
+            o_t = self.psi_2(r_t, graph_t, train=train)
+            o_t_cand = gather_t(o_t, S_idx)
+            D = o_s[:, :, None, :] - o_t_cand
+            S_hat = S_hat + consensus_mlp(D)
+
+        S_L = masked_softmax(S_hat, entry_mask) * s_mask[..., None]
+        return (Correspondence(S_0, S_idx, s_mask, t_mask),
+                Correspondence(S_L, S_idx, s_mask, t_mask))
+
+    # -- Metrics (thin wrappers so the reference's model-level API surface,
+    #    reference dgmc.py:246-311, exists here too) --
+
+    @staticmethod
+    def loss(S, y, y_mask=None, reduction='mean'):
+        from dgmc_tpu.models import metrics
+        return metrics.nll_loss(S, y, y_mask, reduction=reduction)
+
+    @staticmethod
+    def acc(S, y, y_mask=None, reduction='mean'):
+        from dgmc_tpu.models import metrics
+        return metrics.acc(S, y, y_mask, reduction=reduction)
+
+    @staticmethod
+    def hits_at_k(k, S, y, y_mask=None, reduction='mean'):
+        from dgmc_tpu.models import metrics
+        return metrics.hits_at_k(k, S, y, y_mask, reduction=reduction)
+
+    def __repr__(self):
+        return (f'{type(self).__name__}(\n'
+                f'    psi_1={self.psi_1!r},\n'
+                f'    psi_2={self.psi_2!r},\n'
+                f'    num_steps={self.num_steps}, k={self.k}\n)')

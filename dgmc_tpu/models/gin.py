@@ -1,0 +1,74 @@
+"""GIN backbone (Graph Isomorphism Network).
+
+Capability parity with the reference ``GIN`` (reference
+``dgmc/models/gin.py``): ``num_layers`` GIN convolutions with a learnable
+epsilon (PyG ``GINConv(train_eps=True)``, reference ``gin.py:22``), each
+wrapping a 2-layer MLP; jumping-knowledge concatenation of
+``[x, h^1, ..., h^L]`` when ``cat``; optional final Dense.
+
+TPU-native formulation: neighbor aggregation is a masked batched
+segment-sum over padded edge arrays instead of torch_scatter.
+
+Constructor note: the second positional argument is named ``channels``
+(flax modules are frozen dataclasses, so the effective output width is the
+``out_channels`` *property*, which accounts for ``cat``/``lin`` exactly like
+the reference's reassigned ``out_channels`` attribute).
+"""
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from dgmc_tpu.models.mlp import MLP
+from dgmc_tpu.ops.graph import gather_nodes, scatter_to_nodes
+
+
+class GINConv(nn.Module):
+    """``h_i' = MLP((1 + eps) * h_i + sum_{j -> i} h_j)`` with learnable eps."""
+    mlp: nn.Module
+
+    @nn.compact
+    def __call__(self, x, graph, train=False):
+        eps = self.param('eps', nn.initializers.zeros, ())
+        msgs = gather_nodes(x, graph.senders)
+        agg = scatter_to_nodes(msgs, graph.receivers, graph.edge_mask,
+                               x.shape[1], aggr='sum')
+        out = (1.0 + eps) * x + agg
+        return self.mlp(out, graph.node_mask, train=train)
+
+
+class GIN(nn.Module):
+    in_channels: int
+    channels: int
+    num_layers: int
+    batch_norm: bool = False
+    cat: bool = True
+    lin: bool = True
+
+    @property
+    def out_channels(self):
+        if self.lin:
+            return self.channels
+        if self.cat:
+            return self.in_channels + self.num_layers * self.channels
+        return self.channels
+
+    @nn.compact
+    def __call__(self, x, graph, train=False):
+        xs = [x]
+        in_ch = self.in_channels
+        for i in range(self.num_layers):
+            mlp = MLP(in_ch, self.channels, 2, self.batch_norm, dropout=0.0,
+                      name=f'mlp_{i}')
+            xs.append(GINConv(mlp, name=f'conv_{i}')(xs[-1], graph,
+                                                     train=train))
+            in_ch = self.channels
+        out = jnp.concatenate(xs, axis=-1) if self.cat else xs[-1]
+        if self.lin:
+            out = nn.Dense(self.channels, name='final')(out)
+        return out
+
+    def __repr__(self):
+        return (f'{type(self).__name__}({self.in_channels}, '
+                f'{self.out_channels}, num_layers={self.num_layers}, '
+                f'batch_norm={self.batch_norm}, cat={self.cat}, '
+                f'lin={self.lin})')

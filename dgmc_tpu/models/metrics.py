@@ -1,0 +1,91 @@
+"""Correspondence losses and retrieval metrics.
+
+Capability parity with the reference's model-level metrics (reference
+``dgmc/models/dgmc.py:246-311``): NLL over the ground-truth correspondence
+probability, Hits@1 (``acc``), and Hits@k — each for both dense and sparse
+correspondences. Ground truths here are padded ``y[B, N_s]`` target columns
+with a validity mask instead of the reference's ragged ``[2, num_gt]`` pair
+lists (converters live in ``dgmc_tpu/utils/data.py``), so every reduction is
+a masked mean/sum with static shapes.
+
+Reference quirk preserved: for sparse correspondences, ground truths whose
+column is absent from the candidate set contribute nothing to the loss (the
+reference's boolean-mask gather simply selects fewer entries, reference
+``dgmc.py:263-266``); during training absence cannot happen because
+``include_gt`` injects the column.
+"""
+
+import jax.numpy as jnp
+from jax import lax
+
+EPS = 1e-8
+
+
+def _prep(y, y_mask):
+    if y_mask is None:
+        y_mask = jnp.ones(y.shape, bool)
+    return y, y_mask
+
+
+def _gt_val(S, y):
+    """Probability mass the correspondence assigns to the GT column, and
+    whether the GT column is present in the candidate set at all."""
+    if S.is_sparse:
+        hit = S.idx == y[..., None]
+        val = jnp.sum(S.val * hit, axis=-1)
+        found = hit.any(axis=-1)
+    else:
+        val = jnp.take_along_axis(
+            S.val, jnp.clip(y, 0)[..., None], axis=-1)[..., 0]
+        found = jnp.ones(y.shape, bool)
+    return val, found
+
+
+def nll_loss(S, y, y_mask=None, reduction='mean'):
+    """Negative log-likelihood of the ground-truth correspondences."""
+    y, y_mask = _prep(y, y_mask)
+    val, found = _gt_val(S, y)
+    m = y_mask & found
+    nll = -jnp.log(val + EPS) * m
+    if reduction == 'none':
+        return nll
+    total = nll.sum()
+    if reduction == 'sum':
+        return total
+    return total / jnp.maximum(m.sum(), 1)
+
+
+def acc(S, y, y_mask=None, reduction='mean'):
+    """Hits@1: fraction of valid ground truths whose argmax prediction is
+    correct."""
+    y, y_mask = _prep(y, y_mask)
+    if S.is_sparse:
+        best = jnp.argmax(S.val, axis=-1)
+        pred = jnp.take_along_axis(S.idx, best[..., None], axis=-1)[..., 0]
+    else:
+        scores = jnp.where(S.tgt_mask[:, None, :], S.val,
+                           jnp.finfo(S.val.dtype).min)
+        pred = jnp.argmax(scores, axis=-1)
+    correct = ((pred == y) & y_mask).sum()
+    if reduction == 'sum':
+        return correct
+    return correct / jnp.maximum(y_mask.sum(), 1)
+
+
+def hits_at_k(k, S, y, y_mask=None, reduction='mean'):
+    """Hits@k: fraction of valid ground truths ranked in the top k."""
+    y, y_mask = _prep(y, y_mask)
+    if S.is_sparse:
+        kk = min(k, S.val.shape[-1])
+        _, pos = lax.top_k(S.val, kk)
+        pred = jnp.take_along_axis(S.idx, pos, axis=-1)
+    else:
+        kk = min(k, S.val.shape[-1])
+        scores = jnp.where(S.tgt_mask[:, None, :], S.val,
+                           jnp.finfo(S.val.dtype).min)
+        _, pred = lax.top_k(scores, kk)
+    hit = (pred == y[..., None]).any(axis=-1)
+    correct = (hit & y_mask).sum()
+    if reduction == 'sum':
+        return correct
+    return correct / jnp.maximum(y_mask.sum(), 1)

@@ -1,0 +1,38 @@
+"""Multi-layer perceptron backbone.
+
+Capability parity with the reference ``MLP`` (reference
+``dgmc/models/mlp.py``): N Dense layers; ReLU and optional BatchNorm between
+layers; dropout applied *before the final* Dense only. Works on padded
+``[B, N, C]`` node tensors with an optional node mask (for BN statistics).
+"""
+
+from flax import linen as nn
+
+from dgmc_tpu.models.norm import MaskedBatchNorm
+
+
+class MLP(nn.Module):
+    in_channels: int
+    out_channels: int
+    num_layers: int
+    batch_norm: bool = False
+    dropout: float = 0.0
+
+    @nn.compact
+    def __call__(self, x, node_mask=None, train=False):
+        for i in range(self.num_layers):
+            last = i == self.num_layers - 1
+            if last:
+                x = nn.Dropout(self.dropout, deterministic=not train)(x)
+            x = nn.Dense(self.out_channels, name=f'dense_{i}')(x)
+            if not last:
+                x = nn.relu(x)
+                if self.batch_norm:
+                    x = MaskedBatchNorm(name=f'bn_{i}')(
+                        x, node_mask, use_running_average=not train)
+        return x
+
+    def __repr__(self):
+        return (f'{type(self).__name__}({self.in_channels}, '
+                f'{self.out_channels}, num_layers={self.num_layers}, '
+                f'batch_norm={self.batch_norm}, dropout={self.dropout})')

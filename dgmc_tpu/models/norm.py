@@ -1,0 +1,52 @@
+"""Mask-aware batch normalization.
+
+The reference uses ``torch.nn.BatchNorm1d`` over flat node lists (reference
+``dgmc/models/mlp.py:2,21``, ``rel.py:57``), where every row is a real node.
+In the padded representation, batch statistics must exclude padding or the
+zero rows would bias mean/variance, so this is a BatchNorm that takes the
+node mask into account. Running statistics live in the ``batch_stats``
+collection as explicit state — the functional equivalent of torch's buffer
+mutation.
+"""
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+
+class MaskedBatchNorm(nn.Module):
+    momentum: float = 0.9
+    epsilon: float = 1e-5
+
+    @nn.compact
+    def __call__(self, x, mask=None, use_running_average=True):
+        """x: ``[..., C]``; mask: broadcastable to ``x.shape[:-1]`` or None."""
+        C = x.shape[-1]
+        ra_mean = self.variable('batch_stats', 'mean',
+                                lambda: jnp.zeros(C, jnp.float32))
+        ra_var = self.variable('batch_stats', 'var',
+                               lambda: jnp.ones(C, jnp.float32))
+        scale = self.param('scale', nn.initializers.ones, (C,))
+        bias = self.param('bias', nn.initializers.zeros, (C,))
+
+        if use_running_average:
+            mean, var = ra_mean.value, ra_var.value
+        else:
+            xf = x.astype(jnp.float32).reshape(-1, C)
+            if mask is None:
+                n = jnp.asarray(xf.shape[0], jnp.float32)
+                mean = xf.mean(axis=0)
+                var = ((xf - mean) ** 2).mean(axis=0)
+            else:
+                w = mask.astype(jnp.float32).reshape(-1, 1)
+                n = jnp.maximum(w.sum(), 1.0)
+                mean = (xf * w).sum(axis=0) / n
+                var = (((xf - mean) ** 2) * w).sum(axis=0) / n
+            if not self.is_initializing():
+                # Torch tracks running variance with Bessel's correction.
+                unbiased = var * n / jnp.maximum(n - 1.0, 1.0)
+                m = self.momentum
+                ra_mean.value = m * ra_mean.value + (1 - m) * mean
+                ra_var.value = m * ra_var.value + (1 - m) * unbiased
+
+        y = (x - mean) / jnp.sqrt(var + self.epsilon)
+        return y * scale + bias

@@ -1,0 +1,100 @@
+"""SplineCNN backbone — MXU-first replacement for ``torch_spline_conv``.
+
+Capability parity with the reference ``SplineCNN`` (reference
+``dgmc/models/spline.py``): ``num_layers`` B-spline convolutions
+(``kernel_size=5`` per pseudo-coordinate dim, degree 1, mean aggregation,
+root weight + bias, as in PyG's ``SplineConv`` consumed at reference
+``spline.py:21``), ReLU after each conv, jumping-knowledge concat, dropout,
+optional final Dense.
+
+TPU-native formulation of the conv itself: instead of a per-edge
+gather-weights CUDA kernel, all ``K^D`` kernel matrices are applied to the
+*node* features with one large ``[B*N, C_in] x [C_in, K^D*C_out]`` matmul
+(node count is ~5x smaller than edge count for Delaunay graphs), then each
+edge gathers its 2^D active (sender, knot) slices with a single fused index
+and blends them with the closed-form basis weights from
+``dgmc_tpu/ops/spline.py``. Everything is dense, static-shape, and
+MXU-tileable; XLA fuses the basis blend into the gather.
+"""
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from dgmc_tpu.ops.graph import scatter_to_nodes
+from dgmc_tpu.ops.spline import open_spline_basis
+
+
+class SplineConv(nn.Module):
+    out_features: int
+    dim: int
+    kernel_size: int = 5
+    degree: int = 1
+
+    @nn.compact
+    def __call__(self, x, graph, train=False):
+        B, N, C_in = x.shape
+        KD = self.kernel_size ** self.dim
+        weight = self.param(
+            'weight',
+            nn.initializers.variance_scaling(1.0, 'fan_in',
+                                             'truncated_normal',
+                                             in_axis=1, out_axis=2),
+            (KD, C_in, self.out_features))
+
+        # [B, N, KD * C_out]: every node through every kernel matrix — one
+        # MXU GEMM.
+        t = x @ weight.transpose(1, 0, 2).reshape(C_in, KD * self.out_features)
+        t = t.reshape(B, N * KD, self.out_features)
+
+        basis, combo = open_spline_basis(graph.edge_attr, self.kernel_size,
+                                         self.degree)      # [B, E, 2^D]
+        # Fused (sender, knot) index into the flattened [N * KD] axis.
+        flat = graph.senders[..., None] * KD + combo        # [B, E, 2^D]
+        E, A = flat.shape[1], flat.shape[2]
+        picked = jnp.take_along_axis(
+            t, flat.reshape(B, E * A, 1), axis=1).reshape(
+                B, E, A, self.out_features)
+        msgs = jnp.einsum('bea,beao->beo', basis.astype(x.dtype), picked)
+
+        agg = scatter_to_nodes(msgs, graph.receivers, graph.edge_mask, N,
+                               aggr='mean')
+        root = nn.Dense(self.out_features, use_bias=False, name='root')(x)
+        bias = self.param('bias', nn.initializers.zeros, (self.out_features,))
+        return agg + root + bias
+
+
+class SplineCNN(nn.Module):
+    in_channels: int
+    channels: int
+    dim: int
+    num_layers: int
+    cat: bool = True
+    lin: bool = True
+    dropout: float = 0.0
+
+    @property
+    def out_channels(self):
+        if self.lin:
+            return self.channels
+        if self.cat:
+            return self.in_channels + self.num_layers * self.channels
+        return self.channels
+
+    @nn.compact
+    def __call__(self, x, graph, train=False):
+        xs = [x]
+        for i in range(self.num_layers):
+            h = SplineConv(self.channels, self.dim, name=f'conv_{i}')(
+                xs[-1], graph, train=train)
+            xs.append(nn.relu(h))
+        out = jnp.concatenate(xs, axis=-1) if self.cat else xs[-1]
+        out = nn.Dropout(self.dropout, deterministic=not train)(out)
+        if self.lin:
+            out = nn.Dense(self.channels, name='final')(out)
+        return out
+
+    def __repr__(self):
+        return (f'{type(self).__name__}({self.in_channels}, '
+                f'{self.out_channels}, dim={self.dim}, '
+                f'num_layers={self.num_layers}, cat={self.cat}, '
+                f'lin={self.lin}, dropout={self.dropout})')

@@ -1,0 +1,59 @@
+"""Shared builders for small padded graph batches used across model tests."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from dgmc_tpu.ops import GraphBatch
+
+
+def graph_from_edges(x, edges, num_nodes_pad=None, num_edges_pad=None,
+                     edge_attr=None, num_valid_nodes=None):
+    """Build a single-graph ``GraphBatch`` (B=1) from a dense edge list.
+
+    x: ``[N, C]``; edges: list of (src, dst).
+    """
+    x = np.asarray(x, np.float32)
+    n = x.shape[0]
+    n_pad = num_nodes_pad or n
+    e = len(edges)
+    e_pad = num_edges_pad or e
+    senders = np.zeros(e_pad, np.int32)
+    receivers = np.zeros(e_pad, np.int32)
+    for i, (s, d) in enumerate(edges):
+        senders[i], receivers[i] = s, d
+    xp = np.zeros((n_pad, x.shape[1]), np.float32)
+    xp[:n] = x
+    node_mask = np.zeros(n_pad, bool)
+    node_mask[:num_valid_nodes if num_valid_nodes is not None else n] = True
+    edge_mask = np.zeros(e_pad, bool)
+    edge_mask[:e] = True
+    attr = None
+    if edge_attr is not None:
+        a = np.asarray(edge_attr, np.float32)
+        attr = np.zeros((e_pad, a.shape[1]), np.float32)
+        attr[:e] = a
+    return GraphBatch(
+        x=jnp.asarray(xp)[None],
+        senders=jnp.asarray(senders)[None],
+        receivers=jnp.asarray(receivers)[None],
+        node_mask=jnp.asarray(node_mask)[None],
+        edge_mask=jnp.asarray(edge_mask)[None],
+        edge_attr=None if attr is None else jnp.asarray(attr)[None])
+
+
+def stack_graphs(g1, g2):
+    """Concatenate two B=1 GraphBatches along the batch axis (equal pads)."""
+    import jax
+    return jax.tree.map(
+        lambda a, b: None if a is None else jnp.concatenate([a, b], 0),
+        g1, g2, is_leaf=lambda v: v is None)
+
+
+def path_graph(n=4, c=32, seed=0):
+    """The reference tests' canonical graph: an n-node undirected path."""
+    rng = np.random.RandomState(seed)
+    x = rng.randn(n, c).astype(np.float32)
+    edges = []
+    for i in range(n - 1):
+        edges += [(i, i + 1), (i + 1, i)]
+    return graph_from_edges(x, edges)

@@ -1,0 +1,108 @@
+"""Backbone contract tests, mirroring the reference's per-backbone suites
+(reference ``test/models/test_{gin,rel,spline,mlp}.py``): for every
+(cat, lin) combination the output width equals ``model.out_channels``, which
+is ``16 + num_layers * 32`` exactly when ``cat and not lin`` else 32."""
+
+import itertools
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from dgmc_tpu.models import MLP, GIN, RelCNN, SplineCNN
+
+from tests.helpers import path_graph
+
+KEY = jax.random.PRNGKey(0)
+
+
+def _init_apply(model, *args, **kwargs):
+    variables = model.init({'params': KEY}, *args, **kwargs)
+    return model.apply(variables, *args, **kwargs)
+
+
+def test_mlp_shapes_and_repr():
+    g = path_graph(n=4, c=16)
+    model = MLP(16, 32, num_layers=2, batch_norm=True)
+    out = _init_apply(model, g.x, g.node_mask)
+    assert out.shape == (1, 4, 32)
+    assert repr(model) == ('MLP(16, 32, num_layers=2, batch_norm=True, '
+                           'dropout=0.0)')
+
+
+@pytest.mark.parametrize('cat,lin', itertools.product([False, True], repeat=2))
+def test_gin_out_channels_contract(cat, lin):
+    g = path_graph(n=4, c=16)
+    model = GIN(16, 32, num_layers=2, cat=cat, lin=lin)
+    expected = 16 + 2 * 32 if cat and not lin else 32
+    assert model.out_channels == expected
+    out = _init_apply(model, g.x, g)
+    assert out.shape == (1, 4, expected)
+
+
+@pytest.mark.parametrize('cat,lin', itertools.product([False, True], repeat=2))
+def test_rel_out_channels_contract(cat, lin):
+    g = path_graph(n=4, c=16)
+    model = RelCNN(16, 32, num_layers=2, cat=cat, lin=lin, dropout=0.5)
+    expected = 16 + 2 * 32 if cat and not lin else 32
+    assert model.out_channels == expected
+    out = _init_apply(model, g.x, g)
+    assert out.shape == (1, 4, expected)
+
+
+@pytest.mark.parametrize('cat,lin', itertools.product([False, True], repeat=2))
+def test_spline_out_channels_contract(cat, lin):
+    import numpy as np
+    rng = np.random.RandomState(1)
+    from tests.helpers import graph_from_edges
+    edges = [(0, 1), (1, 0), (1, 2), (2, 1), (2, 3), (3, 2)]
+    g = graph_from_edges(rng.randn(4, 16), edges,
+                         edge_attr=rng.rand(6, 3))
+    model = SplineCNN(16, 32, dim=3, num_layers=2, cat=cat, lin=lin,
+                      dropout=0.5)
+    expected = 16 + 2 * 32 if cat and not lin else 32
+    assert model.out_channels == expected
+    out = _init_apply(model, g.x, g)
+    assert out.shape == (1, 4, expected)
+
+
+def test_repr_formats():
+    assert repr(GIN(16, 32, num_layers=2)) == (
+        'GIN(16, 32, num_layers=2, batch_norm=False, cat=True, lin=True)')
+    assert repr(RelCNN(16, 32, num_layers=2, dropout=0.5)) == (
+        'RelCNN(16, 32, num_layers=2, batch_norm=False, cat=True, lin=True, '
+        'dropout=0.5)')
+    assert repr(SplineCNN(16, 32, dim=2, num_layers=2)) == (
+        'SplineCNN(16, 32, dim=2, num_layers=2, cat=True, lin=True, '
+        'dropout=0.0)')
+
+
+def test_dropout_requires_rng_only_in_train():
+    g = path_graph(n=4, c=16)
+    model = RelCNN(16, 32, num_layers=2, dropout=0.5)
+    variables = model.init({'params': KEY}, g.x, g)
+    out_eval = model.apply(variables, g.x, g, train=False)
+    out_train = model.apply(variables, g.x, g, train=True,
+                            rngs={'dropout': jax.random.PRNGKey(1)})
+    assert out_eval.shape == out_train.shape
+    assert not jnp.allclose(out_eval, out_train)
+
+
+def test_masked_nodes_do_not_leak():
+    """A padded node with junk features must not affect valid nodes."""
+    g1 = path_graph(n=4, c=8)
+    # Same graph padded to 6 nodes with junk in the pad slots.
+    import numpy as np
+    from tests.helpers import graph_from_edges
+    rng = np.random.RandomState(0)
+    x = np.zeros((6, 8), np.float32)
+    x[:4] = np.asarray(g1.x[0])
+    x[4:] = 1e3
+    edges = [(0, 1), (1, 0), (1, 2), (2, 1), (2, 3), (3, 2)]
+    g2 = graph_from_edges(x, edges, num_valid_nodes=4)
+
+    model = GIN(8, 16, num_layers=2)
+    variables = model.init({'params': KEY}, g1.x, g1)
+    out1 = model.apply(variables, g1.x, g1)
+    out2 = model.apply(variables, g2.x, g2)
+    assert jnp.allclose(out1[0, :4], out2[0, :4], atol=1e-5)

@@ -1,0 +1,184 @@
+"""DGMC behavioral-contract tests, mirroring the reference suite
+(reference ``test/models/test_dgmc.py``): dense and sparse variants with
+``k = N`` must produce identical ``S_0``/``S_L``/loss/metrics under shared
+PRNG keys; ``include_gt`` overwrites only the last slot and only where the
+ground truth is missing; hits@all is exactly 1.0."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dgmc_tpu.models import DGMC, GIN
+from dgmc_tpu.models.dgmc import include_gt
+
+from tests.helpers import path_graph, stack_graphs
+
+N, C = 4, 32
+
+
+def build(k=-1, num_steps=1):
+    psi_1 = GIN(C, 16, num_layers=2)
+    psi_2 = GIN(8, 8, num_layers=2)
+    return DGMC(psi_1, psi_2, num_steps=num_steps, k=k)
+
+
+def run(model, g_s, g_t, variables=None, y=None, y_mask=None, train=False,
+        seed=7):
+    rngs = {'noise': jax.random.PRNGKey(seed),
+            'negatives': jax.random.PRNGKey(seed + 1),
+            'dropout': jax.random.PRNGKey(seed + 2)}
+    if variables is None:
+        variables = model.init({'params': jax.random.PRNGKey(0), **rngs},
+                               g_s, g_t)
+    out = model.apply(variables, g_s, g_t, y=y, y_mask=y_mask, train=train,
+                      rngs=rngs)
+    return out, variables
+
+
+def test_repr():
+    model = build()
+    assert repr(model) == (
+        'DGMC(\n'
+        '    psi_1=GIN(32, 16, num_layers=2, batch_norm=False, cat=True, '
+        'lin=True),\n'
+        '    psi_2=GIN(8, 8, num_layers=2, batch_norm=False, cat=True, '
+        'lin=True),\n'
+        '    num_steps=1, k=-1\n)')
+
+
+def test_dense_sparse_equivalence_single_graph():
+    g = path_graph(n=N, c=C)
+    y = jnp.arange(N)[None]
+
+    dense = build(k=-1)
+    (S1_0, S1_L), variables = run(dense, g, g)
+
+    sparse = build(k=N)
+    (S2_0, S2_L), _ = run(sparse, g, g, variables=variables, y=y)
+
+    assert S1_0.val.shape == (1, N, N)
+    np.testing.assert_allclose(S1_0.val, S2_0.to_dense(), atol=1e-6)
+    np.testing.assert_allclose(S1_L.val, S2_L.to_dense(), atol=1e-6)
+
+    loss1 = DGMC.loss(S1_0, y)
+    loss2 = DGMC.loss(S2_0, y)
+    np.testing.assert_allclose(loss1, loss2, rtol=1e-5)
+
+    acc1, acc2 = DGMC.acc(S1_0, y), DGMC.acc(S2_0, y)
+    h1_1 = DGMC.hits_at_k(1, S1_0, y)
+    h2_1 = DGMC.hits_at_k(1, S2_0, y)
+    h1_10 = DGMC.hits_at_k(10, S1_0, y)
+    h2_10 = DGMC.hits_at_k(10, S2_0, y)
+    h1_all = DGMC.hits_at_k(N, S1_0, y)
+    h2_all = DGMC.hits_at_k(N, S2_0, y)
+
+    assert acc1 == acc2 == h1_1 == h2_1
+    assert h1_1 <= h1_10
+    assert h1_10 == h2_10
+    assert h1_10 <= h1_all
+    assert h1_all == h2_all == 1.0
+
+
+def test_dense_sparse_equivalence_batched():
+    g = path_graph(n=N, c=C)
+    gb = stack_graphs(g, g)
+
+    dense = build(k=-1)
+    (S1_0, S1_L), variables = run(dense, gb, gb)
+    assert S1_0.val.shape == (2, N, N)
+
+    sparse = build(k=N)
+    (S2_0, S2_L), _ = run(sparse, gb, gb, variables=variables)
+
+    np.testing.assert_allclose(S1_0.val, S2_0.to_dense(), atol=1e-6)
+    np.testing.assert_allclose(S1_L.val, S2_L.to_dense(), atol=1e-6)
+
+
+def test_gradients_flow_both_variants():
+    g = path_graph(n=N, c=C)
+    y = jnp.arange(N)[None]
+    rngs = {'noise': jax.random.PRNGKey(7),
+            'negatives': jax.random.PRNGKey(8)}
+
+    for k in (-1, N):
+        model = build(k=k)
+        variables = model.init({'params': jax.random.PRNGKey(0), **rngs},
+                               g, g)
+
+        def loss_fn(params):
+            S_0, S_L = model.apply({'params': params}, g, g, y=y,
+                                   train=True, rngs=rngs)
+            return DGMC.loss(S_0, y) + DGMC.loss(S_L, y)
+
+        grads = jax.grad(loss_fn)(variables['params'])
+        flat = jax.tree_util.tree_leaves(grads)
+        assert all(jnp.isfinite(g_).all() for g_ in flat)
+        assert any(jnp.abs(g_).max() > 0 for g_ in flat)
+
+
+def test_detach_cuts_psi1_gradients():
+    g = path_graph(n=N, c=C)
+    y = jnp.arange(N)[None]
+    rngs = {'noise': jax.random.PRNGKey(7)}
+    model = build(k=-1, num_steps=2)
+    variables = model.init({'params': jax.random.PRNGKey(0), **rngs}, g, g)
+
+    def loss_fn(params):
+        _, S_L = model.apply({'params': params}, g, g, detach=True,
+                             rngs=rngs)
+        return DGMC.loss(S_L, y)
+
+    grads = jax.grad(loss_fn)(variables['params'])
+    psi1_grads = jax.tree_util.tree_leaves(grads['psi_1'])
+    assert all(jnp.abs(g_).max() == 0 for g_ in psi1_grads)
+    psi2_grads = jax.tree_util.tree_leaves(grads['psi_2'])
+    assert any(jnp.abs(g_).max() > 0 for g_ in psi2_grads)
+
+
+def test_num_steps_zero_skips_consensus():
+    g = path_graph(n=N, c=C)
+    model = build(k=-1, num_steps=0)
+    (S_0, S_L), _ = run(model, g, g)
+    np.testing.assert_allclose(S_0.val, S_L.val)
+
+
+def test_include_gt():
+    # Hand-written case adapted from the reference's 2x2x2 unit test
+    # (reference test/models/test_dgmc.py:87-95), expressed with padded
+    # per-row ground truth: rows with a valid GT absent from their candidate
+    # list get it written into the LAST slot only.
+    S_idx = jnp.array([[[0, 1], [1, 2]], [[1, 2], [0, 1]]])
+    y = jnp.array([[1, 0], [0, 0]])
+    y_mask = jnp.array([[True, False], [True, True]])
+
+    out = include_gt(S_idx, y, y_mask)
+    assert out.tolist() == [[[0, 1], [1, 2]], [[1, 0], [0, 1]]]
+
+
+def test_sparse_train_injects_gt_and_negatives():
+    g = path_graph(n=N, c=C)
+    big = stack_graphs(g, g)  # B=2
+    y = jnp.array([[3, 2, 1, 0], [0, 1, 2, 3]])
+    model = build(k=1, num_steps=1)
+    (S_0, S_L), _ = run(model, big, big, y=y, train=True)
+    # k=1 plus min(1, N-1)=1 negative plus GT overwrite => K=2 candidates.
+    assert S_0.idx.shape == (2, N, 2)
+    # GT present in every row's candidate list.
+    assert bool((S_0.idx == y[..., None]).any(-1).all())
+    # Loss is finite and positive.
+    loss = DGMC.loss(S_L, y)
+    assert jnp.isfinite(loss) and loss > 0
+
+
+def test_metrics_reductions():
+    g = path_graph(n=N, c=C)
+    y = jnp.arange(N)[None]
+    model = build(k=-1)
+    (S_0, _), _ = run(model, g, g)
+    s = DGMC.loss(S_0, y, reduction='sum')
+    m = DGMC.loss(S_0, y, reduction='mean')
+    n = DGMC.loss(S_0, y, reduction='none')
+    np.testing.assert_allclose(s, n.sum(), rtol=1e-6)
+    np.testing.assert_allclose(m, s / N, rtol=1e-6)
+    assert DGMC.acc(S_0, y, reduction='sum') <= N
