@@ -1,0 +1,14 @@
+from dgmc_tpu.data.transforms import (Compose, Constant, KNNGraph, Delaunay,
+                                      FaceToEdge, Cartesian, Distance)
+from dgmc_tpu.data.synthetic import RandomGraphPairs
+
+__all__ = [
+    'Compose',
+    'Constant',
+    'KNNGraph',
+    'Delaunay',
+    'FaceToEdge',
+    'Cartesian',
+    'Distance',
+    'RandomGraphPairs',
+]

@@ -1,0 +1,169 @@
+"""Host-side keypoint-graph transforms.
+
+Capability parity with the PyG transforms the reference consumes
+(``T.Delaunay``, ``T.FaceToEdge``, ``T.Cartesian``, ``T.Distance``,
+``T.Constant``, ``T.KNNGraph`` at reference ``examples/pascal.py:25-29`` and
+``examples/pascal_pf.py:68-72``). These are data-prep, not device compute —
+they run once at dataset build time in NumPy/SciPy (the reference likewise
+runs them on host inside its ``DataLoader`` workers), so the jit path only
+ever sees padded arrays.
+"""
+
+import numpy as np
+
+from dgmc_tpu.utils.data import Graph
+
+
+class Compose:
+    def __init__(self, transforms):
+        self.transforms = list(transforms)
+
+    def __call__(self, g: Graph) -> Graph:
+        # Shallow-copy so repeated application to a cached Graph can't
+        # accumulate state (transforms rebind fields, never mutate arrays).
+        import dataclasses
+        g = dataclasses.replace(g)
+        for t in self.transforms:
+            g = t(g)
+        return g
+
+
+class Constant:
+    """Set (or append to) node features a constant value column."""
+
+    def __init__(self, value=1.0, cat=True):
+        self.value = value
+        self.cat = cat
+
+    def __call__(self, g: Graph) -> Graph:
+        n = g.num_nodes
+        col = np.full((n, 1), self.value, np.float32)
+        if g.x is not None and self.cat:
+            g.x = np.concatenate([g.x, col], axis=1)
+        else:
+            g.x = col
+        return g
+
+
+class KNNGraph:
+    """Connect every node to its k nearest neighbors (edges j -> i)."""
+
+    def __init__(self, k=6, loop=False):
+        self.k = k
+        self.loop = loop
+
+    def __call__(self, g: Graph) -> Graph:
+        pos = g.pos
+        n = pos.shape[0]
+        d2 = ((pos[:, None, :] - pos[None, :, :]) ** 2).sum(-1)
+        if not self.loop:
+            np.fill_diagonal(d2, np.inf)
+        k = min(self.k, n - (0 if self.loop else 1))
+        if k <= 0:
+            g.edge_index = np.zeros((2, 0), np.int64)
+            return g
+        nbrs = np.argpartition(d2, k - 1, axis=1)[:, :k]   # [n, k] sources
+        targets = np.repeat(np.arange(n), k)
+        sources = nbrs.reshape(-1)
+        g.edge_index = np.stack([sources, targets]).astype(np.int64)
+        return g
+
+
+class Delaunay:
+    """Delaunay triangulation of ``pos`` into faces (SciPy/Qhull on host).
+
+    Degenerate sizes follow the reference's PyG behavior: <3 nodes becomes a
+    complete graph's edges, exactly 3 nodes one triangle.
+    """
+
+    def __call__(self, g: Graph) -> Graph:
+        n = g.pos.shape[0]
+        if n < 2:
+            g.face = np.zeros((3, 0), np.int64)
+            g.edge_index = np.zeros((2, 0), np.int64)
+            return g
+        if n == 2:
+            g.face = None
+            g.edge_index = np.array([[0, 1], [1, 0]], np.int64)
+            return g
+        if n == 3:
+            g.face = np.array([[0], [1], [2]], np.int64)
+            return g
+        from scipy.spatial import Delaunay as SciPyDelaunay
+        from scipy.spatial import QhullError
+        try:
+            tri = SciPyDelaunay(g.pos, qhull_options='QJ')
+            g.face = tri.simplices.T.astype(np.int64)
+        except QhullError:
+            # Collinear and other degenerate layouts: chain the points.
+            order = np.argsort(g.pos[:, 0] + 1e-9 * g.pos[:, 1])
+            src = order[:-1]
+            dst = order[1:]
+            g.edge_index = np.stack([
+                np.concatenate([src, dst]),
+                np.concatenate([dst, src])]).astype(np.int64)
+            g.face = None
+        return g
+
+
+class FaceToEdge:
+    """Triangle faces -> undirected (symmetric, deduplicated) edges."""
+
+    def __init__(self, remove_faces=True):
+        self.remove_faces = remove_faces
+
+    def __call__(self, g: Graph) -> Graph:
+        face = getattr(g, 'face', None)
+        if face is not None and face.size:
+            pairs = np.concatenate(
+                [face[[0, 1]], face[[1, 2]], face[[2, 0]]], axis=1)
+            und = np.concatenate([pairs, pairs[::-1]], axis=1)
+            und = np.unique(und, axis=1)
+            g.edge_index = und.astype(np.int64)
+        if self.remove_faces and hasattr(g, 'face'):
+            g.face = None
+        return g
+
+
+class Cartesian:
+    """Edge pseudo-coordinates: relative node positions, normalized to
+    ``[0, 1]`` (the anisotropic option of reference ``pascal.py:28``)."""
+
+    def __init__(self, norm=True, max_value=None):
+        self.norm = norm
+        self.max_value = max_value
+
+    def __call__(self, g: Graph) -> Graph:
+        src, dst = g.edge_index
+        cart = g.pos[src] - g.pos[dst]
+        if self.norm and cart.size:
+            scale = self.max_value or np.abs(cart).max()
+            cart = cart / (2 * max(scale, 1e-12)) + 0.5
+        attr = cart.astype(np.float32)
+        if g.edge_attr is not None:
+            g.edge_attr = np.concatenate([g.edge_attr, attr], axis=1)
+        else:
+            g.edge_attr = attr
+        return g
+
+
+class Distance:
+    """Edge pseudo-coordinates: euclidean node distance, normalized (the
+    isotropic option of reference ``pascal.py:28``)."""
+
+    def __init__(self, norm=True, max_value=None):
+        self.norm = norm
+        self.max_value = max_value
+
+    def __call__(self, g: Graph) -> Graph:
+        src, dst = g.edge_index
+        d = np.linalg.norm(g.pos[src] - g.pos[dst], axis=1, keepdims=True)
+        if self.norm and d.size:
+            scale = self.max_value or d.max()
+            d = d / max(scale, 1e-12)
+        attr = d.astype(np.float32)
+        if g.edge_attr is not None:
+            g.edge_attr = np.concatenate([g.edge_attr, attr], axis=1)
+        else:
+            g.edge_attr = attr
+        return g

@@ -1,0 +1,13 @@
+from dgmc_tpu.utils.data import (Graph, GraphPair, PairDataset,
+                                 ValidPairDataset, pad_graphs,
+                                 pad_pair_batch, PairLoader)
+
+__all__ = [
+    'Graph',
+    'GraphPair',
+    'PairDataset',
+    'ValidPairDataset',
+    'pad_graphs',
+    'pad_pair_batch',
+    'PairLoader',
+]

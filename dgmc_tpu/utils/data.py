@@ -1,0 +1,286 @@
+"""Host-side pair-data layer: graph containers, pair builders, padded
+collation.
+
+Capability parity with the reference's L2 (reference ``dgmc/utils/data.py``):
+``PairDataset`` (product or sampled pairing of two datasets) and
+``ValidPairDataset`` (only pairs whose source keypoint classes all exist in
+the target, with a per-pair ground-truth mapping) — plus the collation the
+reference gets from PyG's ``Batch``/``follow_batch`` machinery (reference
+``data.py:9-16``, used at reference ``examples/pascal.py:42-43``).
+
+TPU-first difference: collation here produces *padded, fixed-shape*
+``GraphBatch`` pairs (the device-side data model, see
+``dgmc_tpu/ops/graph.py``) instead of ragged concatenation with edge-index
+offsets. All of this runs host-side in NumPy at batch-build time; nothing
+here enters the jit path. Ground truths are padded ``y[B, N_s]`` target
+columns with a validity mask instead of ragged ``[2, num_gt]`` index pairs.
+"""
+
+import dataclasses
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class Graph:
+    """A single host-side graph (NumPy, ragged — the pre-padding form)."""
+    edge_index: np.ndarray                 # [2, E] int
+    x: Optional[np.ndarray] = None         # [N, C] float
+    edge_attr: Optional[np.ndarray] = None  # [E, D] float
+    pos: Optional[np.ndarray] = None       # [N, d] float
+    y: Optional[np.ndarray] = None         # [N] int (keypoint classes etc.)
+    face: Optional[np.ndarray] = None      # [3, F] int (Delaunay triangles)
+    name: Optional[str] = None
+
+    @property
+    def num_nodes(self):
+        if self.x is not None:
+            return self.x.shape[0]
+        if self.pos is not None:
+            return self.pos.shape[0]
+        return int(self.edge_index.max()) + 1 if self.edge_index.size else 0
+
+    @property
+    def num_edges(self):
+        return self.edge_index.shape[1]
+
+
+@dataclasses.dataclass
+class GraphPair:
+    """A (source, target) pair with an optional ground-truth column map:
+    ``y_col[i]`` is the target node matched to source node ``i`` (or -1)."""
+    s: Graph
+    t: Graph
+    y_col: Optional[np.ndarray] = None
+
+
+class PairDataset:
+    """All (or sampled) source x target combinations of two graph datasets.
+
+    Mirrors the reference ``PairDataset`` semantics (reference
+    ``dgmc/utils/data.py:19-60``): ``sample=False`` holds the full product;
+    ``sample=True`` pairs each source with one uniformly random target per
+    access.
+    """
+
+    def __init__(self, dataset_s, dataset_t, sample=False, seed=0):
+        self.dataset_s = dataset_s
+        self.dataset_t = dataset_t
+        self.sample = sample
+        self._rng = np.random.RandomState(seed)
+
+    def __len__(self):
+        if self.sample:
+            return len(self.dataset_s)
+        return len(self.dataset_s) * len(self.dataset_t)
+
+    def __getitem__(self, idx):
+        if self.sample:
+            g_s = self.dataset_s[idx]
+            g_t = self.dataset_t[self._rng.randint(len(self.dataset_t))]
+        else:
+            g_s = self.dataset_s[idx // len(self.dataset_t)]
+            g_t = self.dataset_t[idx % len(self.dataset_t)]
+        return GraphPair(s=g_s, t=g_t)
+
+    def __repr__(self):
+        return (f'{type(self).__name__}({self.dataset_s}, {self.dataset_t}, '
+                f'sample={self.sample})')
+
+
+class ValidPairDataset:
+    """Pairs in which every source node class also occurs in the target,
+    with the induced ground-truth map.
+
+    Mirrors the reference ``ValidPairDataset`` (reference
+    ``dgmc/utils/data.py:63-133``): validity is precomputed from per-graph
+    class-membership bitmasks; the emitted ground truth maps each source
+    node to the target node position holding the same class (reference
+    ``data.py:115-117``).
+    """
+
+    def __init__(self, dataset_s, dataset_t, sample=False, seed=0):
+        self.dataset_s = dataset_s
+        self.dataset_t = dataset_t
+        self.sample = sample
+        self._rng = np.random.RandomState(seed)
+        self.pairs, self.cumdeg = self._compute_pairs()
+
+    def _compute_pairs(self):
+        num_classes = 0
+        for g in list(self.dataset_s) + list(self.dataset_t):
+            if g.y is not None and g.y.size:
+                num_classes = max(num_classes, int(g.y.max()) + 1)
+
+        mask_s = np.zeros((len(self.dataset_s), num_classes), bool)
+        mask_t = np.zeros((len(self.dataset_t), num_classes), bool)
+        for i, g in enumerate(self.dataset_s):
+            mask_s[i, g.y] = True
+        for i, g in enumerate(self.dataset_t):
+            mask_t[i, g.y] = True
+
+        # (i, j) is valid iff classes(i) ⊆ classes(j).
+        subset = (mask_s[:, None, :] & ~mask_t[None, :, :]).sum(-1) == 0
+        pairs = np.argwhere(subset)
+        counts = np.bincount(pairs[:, 0], minlength=len(self.dataset_s))
+        cumdeg = np.concatenate([[0], np.cumsum(counts)])
+        return pairs, cumdeg
+
+    def __len__(self):
+        return len(self.dataset_s) if self.sample else len(self.pairs)
+
+    def __getitem__(self, idx):
+        if self.sample:
+            lo, hi = self.cumdeg[idx], self.cumdeg[idx + 1]
+            if hi <= lo:
+                raise IndexError(f'source graph {idx} has no valid partner')
+            g_s = self.dataset_s[idx]
+            g_t = self.dataset_t[self.pairs[self._rng.randint(lo, hi)][1]]
+        else:
+            i, j = self.pairs[idx]
+            g_s = self.dataset_s[int(i)]
+            g_t = self.dataset_t[int(j)]
+
+        # Target position of each class, then look up the source classes.
+        class_to_pos = np.full(int(g_t.y.max()) + 1, -1, np.int64)
+        class_to_pos[g_t.y] = np.arange(g_t.num_nodes)
+        y_col = class_to_pos[g_s.y]
+        return GraphPair(s=g_s, t=g_t, y_col=y_col)
+
+    def __repr__(self):
+        return (f'{type(self).__name__}({self.dataset_s}, {self.dataset_t}, '
+                f'sample={self.sample})')
+
+
+# ---------------------------------------------------------------------------
+# Padded collation (host-side; NumPy)
+# ---------------------------------------------------------------------------
+
+
+def pad_graphs(graphs: Sequence[Graph], num_nodes: int, num_edges: int,
+               feat_dim: Optional[int] = None):
+    """Collate host graphs into the arrays of a device ``GraphBatch``.
+
+    Returns a dict of NumPy arrays (so callers can choose device placement /
+    dtype); ``dgmc_tpu.ops.GraphBatch(**out)`` is jit-ready.
+    """
+    from dgmc_tpu.ops import GraphBatch
+
+    B = len(graphs)
+    if feat_dim is None:
+        feat_dim = next(g.x.shape[1] for g in graphs if g.x is not None)
+    edge_dim = None
+    for g in graphs:
+        if g.edge_attr is not None:
+            edge_dim = g.edge_attr.shape[1]
+            break
+
+    x = np.zeros((B, num_nodes, feat_dim), np.float32)
+    senders = np.zeros((B, num_edges), np.int32)
+    receivers = np.zeros((B, num_edges), np.int32)
+    node_mask = np.zeros((B, num_nodes), bool)
+    edge_mask = np.zeros((B, num_edges), bool)
+    edge_attr = (np.zeros((B, num_edges, edge_dim), np.float32)
+                 if edge_dim is not None else None)
+
+    for b, g in enumerate(graphs):
+        n, e = g.num_nodes, g.num_edges
+        if n > num_nodes or e > num_edges:
+            raise ValueError(f'graph {b} ({n} nodes / {e} edges) exceeds '
+                             f'padding ({num_nodes} / {num_edges})')
+        if g.x is not None:
+            x[b, :n] = g.x
+        senders[b, :e] = g.edge_index[0]
+        receivers[b, :e] = g.edge_index[1]
+        node_mask[b, :n] = True
+        edge_mask[b, :e] = True
+        if edge_attr is not None and g.edge_attr is not None:
+            edge_attr[b, :e] = g.edge_attr
+
+    return GraphBatch(x=x, senders=senders, receivers=receivers,
+                      node_mask=node_mask, edge_mask=edge_mask,
+                      edge_attr=edge_attr)
+
+
+@dataclasses.dataclass
+class PairBatch:
+    """A device-ready batch of graph pairs."""
+    s: 'GraphBatch'  # noqa: F821
+    t: 'GraphBatch'  # noqa: F821
+    y: Optional[np.ndarray] = None       # [B, N_s] int32, -1 where invalid
+    y_mask: Optional[np.ndarray] = None  # [B, N_s] bool
+
+
+def pad_pair_batch(pairs: List[GraphPair], num_nodes_s, num_edges_s,
+                   num_nodes_t=None, num_edges_t=None):
+    """Collate :class:`GraphPair` lists into a :class:`PairBatch`."""
+    num_nodes_t = num_nodes_t or num_nodes_s
+    num_edges_t = num_edges_t or num_edges_s
+    g_s = pad_graphs([p.s for p in pairs], num_nodes_s, num_edges_s)
+    g_t = pad_graphs([p.t for p in pairs], num_nodes_t, num_edges_t)
+
+    B = len(pairs)
+    y = np.full((B, num_nodes_s), -1, np.int32)
+    y_mask = np.zeros((B, num_nodes_s), bool)
+    for b, p in enumerate(pairs):
+        if p.y_col is not None:
+            n = len(p.y_col)
+            y[b, :n] = p.y_col
+            y_mask[b, :n] = p.y_col >= 0
+    return PairBatch(s=g_s, t=g_t, y=y, y_mask=y_mask)
+
+
+class PairLoader:
+    """Minimal shuffling batch iterator over a pair dataset, emitting
+    fixed-shape :class:`PairBatch` es (one XLA program per loader).
+
+    The fixed padding is computed once from the dataset (or given
+    explicitly); the final short batch is dropped when ``drop_last`` else
+    padded with repeated pairs and a zeroed ``y_mask``.
+    """
+
+    def __init__(self, dataset, batch_size, shuffle=True, seed=0,
+                 num_nodes=None, num_edges=None, drop_last=False):
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self.drop_last = drop_last
+        self._rng = np.random.RandomState(seed)
+        if num_nodes is None or num_edges is None:
+            n_max = e_max = 1
+            for i in range(len(dataset)):
+                p = dataset[i]
+                n_max = max(n_max, p.s.num_nodes, p.t.num_nodes)
+                e_max = max(e_max, p.s.num_edges, p.t.num_edges)
+            num_nodes = num_nodes or n_max
+            num_edges = num_edges or e_max
+        self.num_nodes = num_nodes
+        self.num_edges = num_edges
+
+    def __len__(self):
+        n = len(self.dataset)
+        if self.drop_last:
+            return n // self.batch_size
+        return (n + self.batch_size - 1) // self.batch_size
+
+    def __iter__(self):
+        order = np.arange(len(self.dataset))
+        if self.shuffle:
+            self._rng.shuffle(order)
+        for start in range(0, len(order), self.batch_size):
+            chunk = order[start:start + self.batch_size]
+            if len(chunk) < self.batch_size:
+                if self.drop_last:
+                    return
+                # Repeat pairs to keep the shape static; mask out their GT.
+                fill = np.resize(chunk, self.batch_size - len(chunk))
+                pairs = [self.dataset[int(i)] for i in chunk]
+                filler = [self.dataset[int(i)] for i in fill]
+                batch = pad_pair_batch(pairs + filler, self.num_nodes,
+                                       self.num_edges)
+                batch.y_mask[len(chunk):] = False
+                yield batch
+                return
+            yield pad_pair_batch([self.dataset[int(i)] for i in chunk],
+                                 self.num_nodes, self.num_edges)
