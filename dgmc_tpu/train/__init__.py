@@ -1,0 +1,18 @@
+from dgmc_tpu.train.state import (TrainState, create_train_state,
+                                  init_variables)
+from dgmc_tpu.train.steps import (make_train_step, make_eval_step,
+                                  aggregate_eval)
+from dgmc_tpu.train.checkpoint import (Checkpointer, snapshot_params,
+                                       restore_params)
+
+__all__ = [
+    'TrainState',
+    'create_train_state',
+    'init_variables',
+    'make_train_step',
+    'make_eval_step',
+    'aggregate_eval',
+    'Checkpointer',
+    'snapshot_params',
+    'restore_params',
+]

@@ -1,0 +1,61 @@
+"""Train state: parameters, optimizer state, and BatchNorm statistics.
+
+The reference trains with raw ``torch.optim.Adam`` over a mutable
+``nn.Module`` (e.g. reference ``examples/pascal.py:51-77``); weight snapshots
+for the WILLOW transfer protocol are in-memory ``state_dict`` copies
+(reference ``examples/willow.py:90,155``). The TPU-native equivalent is a
+functional :class:`TrainState` pytree — params, optax state, and the
+``batch_stats`` collection as explicit fields — which makes snapshots free
+(the pytree is the snapshot) and checkpointing a pure serialization concern
+(see ``dgmc_tpu/train/checkpoint.py``).
+"""
+
+from typing import Any
+
+import jax
+import optax
+from flax import struct
+from flax.training import train_state
+
+
+class TrainState(train_state.TrainState):
+    """Flax train state extended with the BatchNorm running statistics."""
+    batch_stats: Any = struct.field(default_factory=dict)
+
+
+def init_variables(model, key, batch, num_steps=None):
+    """Initialize all model variables on a sample batch.
+
+    ``num_steps`` is forced to at least 1 during shape inference so ψ₂ and
+    the consensus MLP materialize their parameters even when training starts
+    in a ``num_steps=0`` phase — the reference constructs every submodule up
+    front (reference ``dgmc/models/dgmc.py:64-78``), and the DBP15K schedule
+    (reference ``examples/dbp15k.py:63-69``) relies on the optimizer seeing
+    those parameters from epoch 1.
+    """
+    if num_steps is None:
+        num_steps = model.num_steps
+    num_steps = max(1, num_steps)
+    k_params, k_noise, k_neg, k_drop = jax.random.split(key, 4)
+    return model.init(
+        {'params': k_params, 'noise': k_noise, 'negatives': k_neg,
+         'dropout': k_drop},
+        batch.s, batch.t, y=batch.y, y_mask=batch.y_mask, train=True,
+        num_steps=num_steps)
+
+
+def create_train_state(model, key, batch, tx=None, learning_rate=1e-3,
+                       num_steps=None):
+    """Build a :class:`TrainState` for ``model`` from a sample batch.
+
+    ``tx`` defaults to plain Adam at ``learning_rate`` — the optimizer every
+    reference experiment uses (e.g. reference ``examples/dbp15k.py:34``).
+    """
+    if tx is None:
+        tx = optax.adam(learning_rate)
+    variables = init_variables(model, key, batch, num_steps=num_steps)
+    return TrainState.create(
+        apply_fn=model.apply,
+        params=variables['params'],
+        batch_stats=variables.get('batch_stats', {}),
+        tx=tx)

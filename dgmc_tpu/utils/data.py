@@ -19,6 +19,7 @@ columns with a validity mask instead of ragged ``[2, num_gt]`` index pairs.
 import dataclasses
 from typing import List, Optional, Sequence
 
+import jax
 import numpy as np
 
 
@@ -210,6 +211,14 @@ class PairBatch:
     t: 'GraphBatch'  # noqa: F821
     y: Optional[np.ndarray] = None       # [B, N_s] int32, -1 where invalid
     y_mask: Optional[np.ndarray] = None  # [B, N_s] bool
+
+
+# Registered as a pytree so a whole PairBatch can cross the jit boundary
+# (and be donated / sharded) as one argument.
+jax.tree_util.register_pytree_node(
+    PairBatch,
+    lambda b: ((b.s, b.t, b.y, b.y_mask), None),
+    lambda _, children: PairBatch(*children))
 
 
 def pad_pair_batch(pairs: List[GraphPair], num_nodes_s, num_edges_s,

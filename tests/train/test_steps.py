@@ -1,0 +1,111 @@
+"""End-to-end training-step tests on synthetic matchable pairs —
+the minimum slice of the reference's example loops (SURVEY.md §7 step 3)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dgmc_tpu.data import (Compose, Constant, KNNGraph, Cartesian,
+                           RandomGraphPairs)
+from dgmc_tpu.models import DGMC, SplineCNN
+from dgmc_tpu.train import (create_train_state, make_train_step,
+                            make_eval_step, aggregate_eval)
+from dgmc_tpu.utils import PairLoader
+
+
+def tiny_loader(batch_size=4, length=8, seed=0):
+    transform = Compose([Constant(), KNNGraph(k=4), Cartesian()])
+    ds = RandomGraphPairs(min_inliers=6, max_inliers=10, min_outliers=0,
+                          max_outliers=2, transform=transform, length=length,
+                          seed=seed)
+    return PairLoader(ds, batch_size, shuffle=True, seed=seed,
+                      num_nodes=12, num_edges=48)
+
+
+def tiny_model(k=-1, num_steps=2):
+    # SplineCNN reads the Cartesian edge pseudo-coordinates — the geometric
+    # signal of this synthetic task (as in reference examples/pascal_pf.py).
+    psi_1 = SplineCNN(1, 16, dim=2, num_layers=2, cat=False, lin=True)
+    psi_2 = SplineCNN(8, 8, dim=2, num_layers=2, cat=True, lin=True)
+    return DGMC(psi_1, psi_2, num_steps=num_steps, k=k)
+
+
+@pytest.mark.parametrize('k', [-1, 4])
+def test_train_step_learns(k):
+    model = tiny_model(k=k)
+    loader = tiny_loader()
+    batch0 = next(iter(loader))
+    state = create_train_state(model, jax.random.key(0), batch0,
+                               learning_rate=1e-2)
+    step = make_train_step(model, loss_on_s0=True)
+
+    losses = []
+    key = jax.random.key(1)
+    for epoch in range(10):
+        for batch in loader:
+            key, sub = jax.random.split(key)
+            state, out = step(state, batch, sub)
+            losses.append(float(out['loss']))
+            assert np.isfinite(losses[-1])
+    # Learning happened: the tail is clearly below the head.
+    assert np.mean(losses[-4:]) < np.mean(losses[:4])
+
+
+def test_two_phase_schedule_shares_state():
+    """Phase 1 (num_steps=0) and phase 2 (num_steps>0, detach) run against
+    the same TrainState — the explicit-config version of the reference's
+    DBP15K schedule (reference examples/dbp15k.py:63-69)."""
+    model = tiny_model(k=4, num_steps=2)
+    loader = tiny_loader()
+    batch = next(iter(loader))
+    state = create_train_state(model, jax.random.key(0), batch)
+    phase1 = make_train_step(model, num_steps=0)
+    phase2 = make_train_step(model, num_steps=2, detach=True)
+
+    state, out1 = phase1(state, batch, jax.random.key(1))
+    state, out2 = phase2(state, batch, jax.random.key(2))
+    assert np.isfinite(float(out1['loss']))
+    assert np.isfinite(float(out2['loss']))
+
+
+def test_detach_cuts_psi1_gradients():
+    model = tiny_model(k=-1)
+    loader = tiny_loader()
+    batch = next(iter(loader))
+    state = create_train_state(model, jax.random.key(0), batch)
+
+    from dgmc_tpu.models import metrics
+
+    def loss_fn(params, detach):
+        (S_0, S_L) = model.apply(
+            {'params': params}, batch.s, batch.t, train=False,
+            num_steps=2, detach=detach,
+            rngs={'noise': jax.random.key(3)})
+        # Only the refined loss: with detach, psi_1 gets zero gradient.
+        return metrics.nll_loss(S_L, batch.y, batch.y_mask)
+
+    g = jax.grad(loss_fn)(state.params, True)
+    psi1_norm = sum(jnp.abs(v).sum()
+                    for v in jax.tree.leaves(g['psi_1']))
+    assert float(psi1_norm) == 0.0
+    g2 = jax.grad(loss_fn)(state.params, False)
+    psi1_norm2 = sum(jnp.abs(v).sum()
+                     for v in jax.tree.leaves(g2['psi_1']))
+    assert float(psi1_norm2) > 0.0
+
+
+def test_eval_step_and_aggregate():
+    model = tiny_model(k=-1)
+    loader = tiny_loader()
+    batch = next(iter(loader))
+    state = create_train_state(model, jax.random.key(0), batch)
+    ev = make_eval_step(model, hits_ks=(1, 3))
+
+    totals = [ev(state, b, jax.random.key(i))
+              for i, b in enumerate(loader)]
+    agg = aggregate_eval([jax.tree.map(float, t) for t in totals])
+    assert 0.0 <= agg['acc'] <= 1.0
+    assert agg['hits@1'] == pytest.approx(agg['acc'])
+    assert agg['hits@3'] >= agg['hits@1']
+    assert agg['count'] > 0
