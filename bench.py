@@ -1,0 +1,101 @@
+"""Benchmark: flagship DGMC training throughput (pairs/sec) on one chip.
+
+Workload: the pascal_pf-shaped dense matcher (SplineCNN ψ₁/ψ₂, 10 consensus
+steps — the reference's headline keypoint configuration, reference
+``examples/pascal_pf.py:81-83`` / ``examples/pascal.py:46-50``) training on
+synthetic geometric pairs padded to 64 nodes, batch 128. The reference
+publishes no wall-clock numbers (BASELINE.md), so the recorded first-round
+throughput (``BENCH_BASELINE.json``, written on first run) is the baseline
+later rounds must beat; ``vs_baseline`` is the ratio against it.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+import json
+import os
+import time
+
+import jax
+import numpy as np
+
+BASELINE_FILE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             'BENCH_BASELINE.json')
+
+BATCH = 128
+NUM_NODES = 64
+NUM_EDGES = 512
+NUM_STEPS = 10
+WARMUP = 3
+ITERS = 20
+
+
+def build():
+    from dgmc_tpu.data import (Cartesian, Compose, Constant, KNNGraph,
+                               RandomGraphPairs)
+    from dgmc_tpu.models import DGMC, SplineCNN
+    from dgmc_tpu.train import create_train_state, make_train_step
+    from dgmc_tpu.utils import PairLoader
+
+    transform = Compose([Constant(), KNNGraph(k=8), Cartesian()])
+    ds = RandomGraphPairs(min_inliers=30, max_inliers=60, min_outliers=0,
+                          max_outliers=4, transform=transform, length=BATCH,
+                          seed=0)
+    loader = PairLoader(ds, BATCH, shuffle=False, num_nodes=NUM_NODES,
+                        num_edges=NUM_EDGES)
+    batch = next(iter(loader))
+
+    psi_1 = SplineCNN(1, 256, dim=2, num_layers=2, cat=False, lin=True,
+                      dropout=0.0)
+    psi_2 = SplineCNN(64, 64, dim=2, num_layers=2, cat=True, lin=True)
+    model = DGMC(psi_1, psi_2, num_steps=NUM_STEPS, k=-1)
+    state = create_train_state(model, jax.random.key(0), batch,
+                               learning_rate=1e-3)
+    step = make_train_step(model, loss_on_s0=True)
+    return state, step, batch
+
+
+def main():
+    state, step, batch = build()
+    key = jax.random.key(1)
+
+    for _ in range(WARMUP):
+        key, sub = jax.random.split(key)
+        state, out = step(state, batch, sub)
+    jax.block_until_ready(out['loss'])
+
+    t0 = time.perf_counter()
+    for _ in range(ITERS):
+        key, sub = jax.random.split(key)
+        state, out = step(state, batch, sub)
+    jax.block_until_ready(out['loss'])
+    dt = time.perf_counter() - t0
+
+    pairs_per_sec = BATCH * ITERS / dt
+    assert np.isfinite(float(out['loss']))
+
+    platform = str(jax.devices()[0].platform)
+    baseline = None
+    if os.path.exists(BASELINE_FILE):
+        with open(BASELINE_FILE) as f:
+            stored = json.load(f)
+        # A baseline recorded on another platform (e.g. CPU smoke run) would
+        # make vs_baseline meaningless — re-seed it instead.
+        if stored.get('device') == platform:
+            baseline = stored['value']
+    if baseline is None:
+        baseline = pairs_per_sec
+        with open(BASELINE_FILE, 'w') as f:
+            json.dump({'metric': 'train_pairs_per_sec',
+                       'value': pairs_per_sec,
+                       'device': platform}, f)
+
+    print(json.dumps({
+        'metric': 'train_pairs_per_sec',
+        'value': round(pairs_per_sec, 2),
+        'unit': 'pairs/sec',
+        'vs_baseline': round(pairs_per_sec / baseline, 4),
+    }))
+
+
+if __name__ == '__main__':
+    main()

@@ -105,6 +105,16 @@ class DGMC(nn.Module):
     k: int = -1
     detach: bool = False
     topk_block: int = 1024
+    # Optional jax.sharding.NamedSharding for correspondence-shaped
+    # intermediates [B, N_s, ...]: row-shards S_hat / S_idx over a mesh axis
+    # so a single huge pair (DBP15K-scale) spreads its activation state
+    # across chips. GSPMD propagates the layout through the consensus loop.
+    corr_sharding: Optional[object] = None
+
+    def _constrain(self, a):
+        if self.corr_sharding is None:
+            return a
+        return jax.lax.with_sharding_constraint(a, self.corr_sharding)
 
     @nn.compact
     def __call__(self, graph_s, graph_t, y=None, y_mask=None, train=False,
@@ -148,7 +158,7 @@ class DGMC(nn.Module):
 
         if self.k < 1:
             # ---- Dense variant ----
-            S_hat = jnp.einsum('bsc,btc->bst', h_s, h_t)
+            S_hat = self._constrain(jnp.einsum('bsc,btc->bst', h_s, h_t))
             S_mask = s_mask[:, :, None] & t_mask[:, None, :]
             S_0 = masked_softmax(S_hat, S_mask)
 
@@ -159,15 +169,17 @@ class DGMC(nn.Module):
                 o_s = self.psi_2(r_s, graph_s, train=train)
                 o_t = self.psi_2(r_t, graph_t, train=train)
                 D = o_s[:, :, None, :] - o_t[:, None, :, :]
-                S_hat = S_hat + jnp.where(S_mask, consensus_mlp(D), 0.0)
+                S_hat = self._constrain(
+                    S_hat + jnp.where(S_mask, consensus_mlp(D), 0.0))
 
             S_L = masked_softmax(S_hat, S_mask)
             return (Correspondence(S_0, None, s_mask, t_mask),
                     Correspondence(S_L, None, s_mask, t_mask))
 
         # ---- Sparse (top-k) variant ----
-        S_idx = chunked_topk(h_s, h_t, self.k, t_mask=t_mask,
-                             block=self.topk_block)
+        S_idx = self._constrain(
+            chunked_topk(h_s, h_t, self.k, t_mask=t_mask,
+                         block=self.topk_block))
 
         if train and y is not None:
             if y_mask is None:
@@ -210,7 +222,7 @@ class DGMC(nn.Module):
             o_t = self.psi_2(r_t, graph_t, train=train)
             o_t_cand = gather_t(o_t, S_idx)
             D = o_s[:, :, None, :] - o_t_cand
-            S_hat = S_hat + consensus_mlp(D)
+            S_hat = self._constrain(S_hat + consensus_mlp(D))
 
         S_L = masked_softmax(S_hat, entry_mask) * s_mask[..., None]
         return (Correspondence(S_0, S_idx, s_mask, t_mask),

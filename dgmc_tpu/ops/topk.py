@@ -34,12 +34,14 @@ def dense_topk(h_s, h_t, k, t_mask=None):
     return jax.lax.top_k(scores, k)[1]
 
 
-@functools.partial(jax.jit, static_argnames=('k', 'block'))
-def chunked_topk(h_s, h_t, k, t_mask=None, block=1024):
+@functools.partial(jax.jit, static_argnames=('k', 'block', 'return_values'))
+def chunked_topk(h_s, h_t, k, t_mask=None, block=1024, return_values=False):
     """Blockwise running top-k of ``h_s @ h_t^T`` along the target axis.
 
     Produces indices identical to :func:`dense_topk` (including tie order)
-    while only ever holding one ``[B, N_s, block]`` score tile.
+    while only ever holding one ``[B, N_s, block]`` score tile. With
+    ``return_values`` the running scores come back too (``(vals, idx)``) —
+    used by the distributed column-sharded merge.
     """
     B, N_s, C = h_s.shape
     N_t = h_t.shape[1]
@@ -61,6 +63,12 @@ def chunked_topk(h_s, h_t, k, t_mask=None, block=1024):
     # in dense_topk (matters only when k exceeds the valid target count).
     init_vals = jnp.full((B, N_s, k), -jnp.inf, dtype=h_s.dtype)
     init_idx = jnp.zeros((B, N_s, k), dtype=jnp.int32)
+    # Under shard_map the scan body output varies over the manual mesh axes
+    # of h_s; the constant init carry must carry the same varying type.
+    vma = tuple(jax.typeof(h_s).vma)
+    if vma:
+        init_vals = jax.lax.pcast(init_vals, vma, to='varying')
+        init_idx = jax.lax.pcast(init_idx, vma, to='varying')
 
     def step(carry, inp):
         vals, idx = carry
@@ -80,5 +88,6 @@ def chunked_topk(h_s, h_t, k, t_mask=None, block=1024):
     starts = jnp.arange(num_blocks, dtype=jnp.int32) * block
     (vals, idx), _ = jax.lax.scan(step, (init_vals, init_idx),
                                   (h_t_blocks, m_blocks, starts))
-    del vals
+    if return_values:
+        return vals, idx
     return idx

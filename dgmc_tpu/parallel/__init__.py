@@ -1,0 +1,21 @@
+from dgmc_tpu.parallel.mesh import (DATA_AXIS, MODEL_AXIS, make_mesh,
+                                    batch_spec, corr_spec, corr_sharding)
+from dgmc_tpu.parallel.sharding import (replicate, shard_batch,
+                                        make_sharded_train_step,
+                                        make_sharded_eval_step)
+from dgmc_tpu.parallel.topk import sharded_topk_rows, sharded_topk_cols
+
+__all__ = [
+    'DATA_AXIS',
+    'MODEL_AXIS',
+    'make_mesh',
+    'batch_spec',
+    'corr_spec',
+    'corr_sharding',
+    'replicate',
+    'shard_batch',
+    'make_sharded_train_step',
+    'make_sharded_eval_step',
+    'sharded_topk_rows',
+    'sharded_topk_cols',
+]

@@ -1,0 +1,57 @@
+"""Device-mesh construction helpers.
+
+The reference is strictly single-process / single-device (SURVEY.md §2.5),
+so every parallelism feature here is net-new design: a
+``jax.sharding.Mesh`` with a ``data`` axis (the pair-batch dimension ``B``
+— the workload's natural data-parallel axis) and a ``model`` axis over which
+the correspondence matrix rows (``N_s``) are sharded for DBP15K-scale
+graphs. Collectives are XLA's (``psum``/``all_gather`` over ICI/DCN),
+inserted by GSPMD from sharding annotations — the TPU-native replacement
+for a NCCL/MPI backend.
+"""
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.experimental import mesh_utils
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = 'data'
+MODEL_AXIS = 'model'
+
+
+def make_mesh(data: Optional[int] = None, model: int = 1,
+              devices: Optional[Sequence] = None) -> Mesh:
+    """Build a ``(data, model)`` mesh over the available devices.
+
+    ``data=None`` takes every device not claimed by ``model``. On real TPU
+    slices ``mesh_utils`` lays the axes out so the (inner) model axis rides
+    the fastest ICI links.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    if data is None:
+        if n % model:
+            raise ValueError(f'{n} devices not divisible by model={model}')
+        data = n // model
+    if data * model != n:
+        raise ValueError(f'mesh {data}x{model} != {n} devices')
+    mesh_devices = mesh_utils.create_device_mesh(
+        (data, model), devices=np.asarray(devices))
+    return Mesh(mesh_devices, (DATA_AXIS, MODEL_AXIS))
+
+
+def batch_spec() -> P:
+    """PartitionSpec sharding a leading pair-batch axis over ``data``."""
+    return P(DATA_AXIS)
+
+
+def corr_spec() -> P:
+    """PartitionSpec for correspondence-shaped arrays ``[B, N_s, ...]``:
+    batch over ``data``, source-node rows over ``model``."""
+    return P(DATA_AXIS, MODEL_AXIS)
+
+
+def corr_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, corr_spec())

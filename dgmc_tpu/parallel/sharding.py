@@ -1,0 +1,67 @@
+"""Sharded training: batch-parallel + correspondence-parallel train steps.
+
+The reference has no distributed execution at all (SURVEY.md §2.5); here the
+train step from ``dgmc_tpu/train/steps.py`` is compiled over a mesh with:
+
+- the pair batch sharded over the ``data`` axis (pure data parallelism —
+  gradients are combined by XLA's reduction collectives automatically,
+  because the loss is a mean over the sharded batch axis),
+- parameters and optimizer state replicated,
+- optionally, correspondence-shaped intermediates (``S_hat``/``S_idx``,
+  shape ``[B, N_s, ...]``) row-sharded over the ``model`` axis via the
+  model's ``corr_sharding`` constraint — activation sharding for
+  DBP15K-scale graphs where a single pair's ``N_s x N_t`` state dwarfs the
+  weights.
+
+GSPMD inserts the collectives (psum for grads, all_gathers at sharding
+boundaries); they ride ICI on a real slice. Nothing here speaks a transport
+protocol — that is the point of the XLA-collective design.
+"""
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from dgmc_tpu.parallel.mesh import DATA_AXIS
+from dgmc_tpu.train import steps as _steps
+
+
+def replicate(tree, mesh):
+    """Place every leaf replicated over the mesh."""
+    s = NamedSharding(mesh, P())
+    return jax.device_put(tree, s)
+
+
+def shard_batch(batch, mesh, axis=DATA_AXIS):
+    """Place a :class:`PairBatch` (or any leading-``B`` pytree) with its
+    batch axis split over ``axis``."""
+    s = NamedSharding(mesh, P(axis))
+    return jax.device_put(batch, s)
+
+
+def make_sharded_train_step(model, mesh, loss_on_s0=False, num_steps=None,
+                            detach=None, hits_ks=(), batch_axis=DATA_AXIS):
+    """Jit a train step with explicit mesh shardings.
+
+    Same contract as :func:`dgmc_tpu.train.make_train_step` — call it with a
+    state placed by :func:`replicate` and a batch placed by
+    :func:`shard_batch`.
+    """
+    step = _steps.make_train_step(model, loss_on_s0=loss_on_s0,
+                                  num_steps=num_steps, detach=detach,
+                                  hits_ks=hits_ks, jit=False)
+    repl = NamedSharding(mesh, P())
+    batched = NamedSharding(mesh, P(batch_axis))
+    return jax.jit(step,
+                   in_shardings=(repl, batched, repl),
+                   out_shardings=(repl, repl),
+                   donate_argnums=(0,))
+
+
+def make_sharded_eval_step(model, mesh, hits_ks=(1,), num_steps=None,
+                           detach=None, batch_axis=DATA_AXIS):
+    step = _steps.make_eval_step(model, hits_ks=hits_ks, num_steps=num_steps,
+                                 detach=detach, jit=False)
+    repl = NamedSharding(mesh, P())
+    batched = NamedSharding(mesh, P(batch_axis))
+    return jax.jit(step, in_shardings=(repl, batched, repl),
+                   out_shardings=repl)

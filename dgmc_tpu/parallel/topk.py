@@ -1,0 +1,90 @@
+"""Mesh-sharded top-k candidate search — the multi-chip KeOps replacement.
+
+Two shardings of the ``N_s x N_t`` similarity sweep (never materialized;
+each shard runs the blockwise running-top-k of ``dgmc_tpu/ops/topk.py``):
+
+- **Row sharding** (:func:`sharded_topk_rows`): source rows are split over a
+  mesh axis; every device scans the full target set for its rows. No
+  collectives at all — rows are independent. This is the default for
+  DBP15K-scale graphs (the "sequence parallelism" analog of this workload,
+  SURVEY.md §2.5).
+- **Column sharding** (:func:`sharded_topk_cols`): the *target* set is split;
+  every device computes a local top-k over its column shard, then one
+  ``all_gather`` of ``[N_s, k]`` candidates merges them into the global
+  top-k. Communication is ``O(N_s * k * n_dev)``, independent of ``N_t`` —
+  the right axis when targets dwarf sources or when ``h_t`` is produced
+  sharded (e.g. by a column-sharded ψ₁).
+
+Both produce indices bit-identical to ``dense_topk`` (tie-break included).
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from dgmc_tpu.ops.topk import chunked_topk
+from dgmc_tpu.parallel.mesh import MODEL_AXIS
+
+
+def sharded_topk_rows(mesh, h_s, h_t, k, t_mask=None, block=1024,
+                      axis=MODEL_AXIS):
+    """Top-k with source rows sharded over ``axis``. ``N_s`` must divide by
+    the axis size (pad rows host-side; padded rows are just extra work)."""
+    if t_mask is None:
+        t_mask = jnp.ones((h_t.shape[0], h_t.shape[1]), bool)
+
+    @functools.partial(
+        jax.shard_map, mesh=mesh,
+        in_specs=(P(None, axis, None), P(), P()),
+        out_specs=P(None, axis, None))
+    def inner(h_s_l, h_t_l, t_mask_l):
+        return chunked_topk(h_s_l, h_t_l, k, t_mask=t_mask_l, block=block)
+
+    return inner(h_s, h_t, t_mask)
+
+
+def sharded_topk_cols(mesh, h_s, h_t, k, t_mask=None, block=1024,
+                      axis=MODEL_AXIS):
+    """Top-k with target columns sharded over ``axis``; one all_gather of
+    per-shard candidates merges local winners into the global top-k."""
+    B, N_t = h_t.shape[0], h_t.shape[1]
+    if t_mask is None:
+        t_mask = jnp.ones((B, N_t), bool)
+    n_shards = mesh.shape[axis]
+    if N_t % n_shards:
+        raise ValueError(f'N_t={N_t} not divisible by {n_shards} shards')
+    shard_cols = N_t // n_shards
+    if k > shard_cols:
+        raise ValueError(f'k={k} exceeds columns per shard ({shard_cols})')
+
+    # check_vma off: every shard derives the identical merge from the
+    # all_gathered candidates, a replication the type system can't infer.
+    @functools.partial(
+        jax.shard_map, mesh=mesh,
+        in_specs=(P(), P(None, axis, None), P(None, axis)),
+        out_specs=P(), check_vma=False)
+    def inner(h_s_l, h_t_l, t_mask_l):
+        # Local blockwise running top-k over this device's column shard
+        # (never materializes the [N_s, shard_cols] score tile), lifted to
+        # global column indices.
+        my_shard = jax.lax.axis_index(axis)
+        vals, idx = chunked_topk(h_s_l, h_t_l, k, t_mask=t_mask_l,
+                                 block=block, return_values=True)
+        idx = idx + my_shard * shard_cols
+        # Merge candidates from all shards: [n_shards, B, N_s, k].
+        all_vals = jax.lax.all_gather(vals, axis)
+        all_idx = jax.lax.all_gather(idx, axis)
+        cat = lambda a: jnp.moveaxis(a, 0, -2).reshape(  # noqa: E731
+            a.shape[1], a.shape[2], -1)
+        # Order candidates by global column so equal values tie-break toward
+        # the lower index, exactly like a dense top_k over the full matrix.
+        flat_vals, flat_idx = cat(all_vals), cat(all_idx)
+        order = jnp.argsort(flat_idx, axis=-1)
+        flat_vals = jnp.take_along_axis(flat_vals, order, axis=-1)
+        flat_idx = jnp.take_along_axis(flat_idx, order, axis=-1)
+        best, pos = jax.lax.top_k(flat_vals, k)
+        return jnp.take_along_axis(flat_idx, pos, axis=-1)
+
+    return inner(h_s, h_t, t_mask)

@@ -42,7 +42,8 @@ class Checkpointer:
             raise FileNotFoundError(
                 f'no checkpoint found under {self.directory}')
         abstract = jax.tree.map(
-            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype)
+            lambda x: jax.ShapeDtypeStruct(
+                x.shape, x.dtype, sharding=getattr(x, 'sharding', None))
             if hasattr(x, 'shape') else x, state)
         return self._mgr.restore(
             step, args=self._ocp.args.StandardRestore(abstract))
