@@ -1,0 +1,13 @@
+from dgmc_tpu.datasets.dbp15k import DBP15K
+from dgmc_tpu.datasets.pascal_pf import PascalPF
+from dgmc_tpu.datasets.willow import WILLOWObjectClass
+from dgmc_tpu.datasets.pascal_voc import PascalVOCKeypoints
+from dgmc_tpu.datasets.features import VGG16Features
+
+__all__ = [
+    'DBP15K',
+    'PascalPF',
+    'WILLOWObjectClass',
+    'PascalVOCKeypoints',
+    'VGG16Features',
+]

@@ -1,0 +1,119 @@
+"""PascalPF (Proposal Flow) keypoint-pair dataset.
+
+Capability parity with PyG's ``PascalPF`` as consumed by the reference
+(reference ``examples/pascal_pf.py:8,74``): per category, keypoint sets
+read from the ``PF-dataset-PASCAL`` annotation ``.mat`` files, normalized
+into ``[-1, 1]``, plus the official evaluation pair list from
+``parsePascalVOC.mat``. Used zero-shot at test time, one pair at a time
+(reference ``examples/pascal_pf.py:115-123``).
+
+Expected raw layout (no downloads are attempted):
+
+    <root>/PF-dataset-PASCAL/Annotations/<category>/*.mat   (kps [M, 2|3])
+    <root>/PF-dataset-PASCAL/parsePascalVOC.mat             (pair list)
+"""
+
+import glob
+import os
+
+import numpy as np
+
+from dgmc_tpu.utils.data import Graph
+
+CATEGORIES = ('aeroplane', 'bicycle', 'bird', 'boat', 'bottle', 'bus', 'car',
+              'cat', 'chair', 'cow', 'diningtable', 'dog', 'horse',
+              'motorbike', 'person', 'pottedplant', 'sheep', 'sofa', 'train',
+              'tvmonitor')
+
+
+class PascalPF:
+    """One category of PascalPF: normalized keypoint clouds + test pairs.
+
+    ``self.items`` maps image name -> ``Graph`` (``pos`` only — graphs are
+    built by a transform, e.g. KNN, exactly as the reference applies its
+    transform pipeline at reference ``examples/pascal_pf.py:68-74``);
+    ``self.pairs`` is a list of (name_s, name_t) evaluation pairs.
+    """
+
+    def __init__(self, root, category, transform=None):
+        if category not in CATEGORIES:
+            raise ValueError(f'unknown category {category!r}')
+        self.root = os.path.expanduser(root)
+        self.category = category
+        self.transform = transform
+        base = os.path.join(self.root, 'PF-dataset-PASCAL')
+        if not os.path.isdir(base):
+            raise FileNotFoundError(
+                f'PascalPF raw data not found at {base}; place the '
+                f'PF-dataset-PASCAL release there (no downloads attempted).')
+        self._load(base)
+
+    def _load(self, base):
+        from scipy.io import loadmat
+        ann = os.path.join(base, 'Annotations', self.category)
+        self.items = {}
+        for path in sorted(glob.glob(os.path.join(ann, '*.mat'))):
+            m = loadmat(path)
+            kps = np.asarray(m['kps'], np.float32)[:, :2]
+            keep = ~np.isnan(kps).any(axis=1)
+            kps = kps[keep]
+            if kps.shape[0] == 0:
+                continue
+            # Normalize into [-1, 1] per item, preserving aspect.
+            center = (kps.max(0) + kps.min(0)) / 2
+            scale = (kps.max(0) - kps.min(0)).max() / 2
+            pos = (kps - center) / max(scale, 1e-6)
+            name = os.path.splitext(os.path.basename(path))[0]
+            # Keypoint identity index: row i in source matches row i in
+            # target for same-category PF pairs (the reference evaluates
+            # y = arange, reference examples/pascal_pf.py:121-122).
+            self.items[name] = Graph(edge_index=np.zeros((2, 0), np.int64),
+                                     pos=pos, y=np.arange(len(pos)),
+                                     name=name)
+
+        pairs_file = os.path.join(base, 'parsePascalVOC.mat')
+        self.pairs = []
+        if os.path.exists(pairs_file):
+            m = loadmat(pairs_file, simplify_cells=True)
+            entry = m['PascalVOC']
+            cat_idx = list(entry['class']).index(self.category)
+            pair_arr = np.asarray(entry['pair'][cat_idx], dtype=object)
+            # simplify_cells squeezes aggressively: a single pair may come
+            # back as a flat [2] array of name strings rather than a [1, 2]
+            # row list — renormalize to rows of two names.
+            if pair_arr.ndim == 1 and pair_arr.size == 2 and \
+                    all(isinstance(v, str) for v in pair_arr):
+                pair_arr = pair_arr[None, :]
+            for row in np.atleast_1d(pair_arr):
+                row = np.atleast_1d(np.asarray(row, dtype=object))
+                if row.size < 2:
+                    continue
+                a, b = str(row[0]), str(row[1])
+                if a in self.items and b in self.items:
+                    self.pairs.append((a, b))
+        if not self.pairs:
+            # No pair list (or none resolvable): consecutive same-category
+            # pairs.
+            names = sorted(self.items)
+            self.pairs = [(names[i], names[i + 1])
+                          for i in range(len(names) - 1)]
+
+    def get(self, name):
+        g = self.items[name]
+        return self.transform(g) if self.transform else g
+
+    def pair_graphs(self):
+        """Yield (graph_s, graph_t, y_col) for every evaluation pair; the
+        ground truth matches keypoint i to keypoint i (both PF items of a
+        category index the same keypoint set)."""
+        for a, b in self.pairs:
+            g_s, g_t = self.get(a), self.get(b)
+            n = min(g_s.pos.shape[0], g_t.pos.shape[0])
+            yield g_s, g_t, np.arange(n, dtype=np.int64)
+
+    def __len__(self):
+        return len(self.items)
+
+    def __repr__(self):
+        return (f'PascalPF({self.category}, items={len(self.items)}, '
+                f'pairs={len(self.pairs)})')

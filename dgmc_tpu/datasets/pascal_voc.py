@@ -1,0 +1,195 @@
+"""PascalVOC-with-Berkeley-keypoints dataset.
+
+Capability parity with PyG's ``PascalVOCKeypoints`` as consumed by the
+reference (reference ``examples/pascal.py:5,31-41``): 20 VOC categories;
+each sample is one object instance with its Berkeley keypoint annotations,
+cropped to the object bounding box; node features are VGG16 activations at
+the keypoints (``dgmc_tpu/datasets/features.py``); ``y`` holds the keypoint
+*class* index within the category's keypoint vocabulary (what
+``ValidPairDataset`` matches on, reference ``dgmc/utils/data.py:82-117``).
+
+Expected raw layout (no downloads attempted):
+
+    <root>/annotations/<category>/*.xml    Berkeley keypoint annotations:
+        <annotation><image>...</image>
+          <visible_bounds xmin= xmax= ymin= ymax=/>
+          <keypoints><keypoint name= x= y= visible=/>...</keypoints>
+        </annotation>
+    <root>/images/*.jpg                    VOC images (optional; zeros
+                                           otherwise)
+"""
+
+import glob
+import os
+import xml.etree.ElementTree as ET
+
+import numpy as np
+
+from dgmc_tpu.utils.data import Graph
+
+CATEGORIES = ('aeroplane', 'bicycle', 'bird', 'boat', 'bottle', 'bus', 'car',
+              'cat', 'chair', 'cow', 'diningtable', 'dog', 'horse',
+              'motorbike', 'person', 'pottedplant', 'sheep', 'sofa', 'train',
+              'tvmonitor')
+
+
+def _parse_annotation(path):
+    tree = ET.parse(path)
+    root = tree.getroot()
+    image = root.findtext('image', default='').strip()
+    vb = root.find('visible_bounds')
+    bounds = None
+    if vb is not None:
+        x0 = float(vb.get('xmin', 0))
+        y0 = float(vb.get('ymin', 0))
+        # Berkeley annotations carry width/height; tolerate xmax/ymax too.
+        if vb.get('width') is not None:
+            x1 = x0 + float(vb.get('width'))
+            y1 = y0 + float(vb.get('height', 0))
+        else:
+            x1 = float(vb.get('xmax', x0))
+            y1 = float(vb.get('ymax', y0))
+        bounds = (x0, y0, x1, y1)
+    kps = []
+    kp_root = root.find('keypoints')
+    if kp_root is not None:
+        for kp in kp_root.findall('keypoint'):
+            visible = kp.get('visible', '1')
+            if visible in ('0', 'false', 'False'):
+                continue
+            kps.append((kp.get('name'),
+                        float(kp.get('x')), float(kp.get('y'))))
+    return image, bounds, kps
+
+
+class PascalVOCKeypoints:
+    """One category of PascalVOC keypoint instances."""
+
+    def __init__(self, root, category, train=True, transform=None,
+                 pre_filter=None, features=None, device_features=None,
+                 train_fraction=0.8):
+        if category not in CATEGORIES:
+            raise ValueError(f'unknown category {category!r}')
+        self.root = os.path.expanduser(root)
+        self.category = category
+        self.transform = transform
+        if features is None:
+            from dgmc_tpu.datasets.features import VGG16Features
+            features = VGG16Features(weights=device_features or 'random')
+        self.features = features
+
+        ann_dir = os.path.join(self.root, 'annotations', category)
+        if not os.path.isdir(ann_dir):
+            raise FileNotFoundError(
+                f'Berkeley keypoint annotations not found at {ann_dir} '
+                f'(no downloads attempted).')
+
+        # The keypoint-name vocabulary of this category, fixed by sorted
+        # first appearance across the split — the class index ValidPairDataset
+        # matches on.
+        paths = sorted(glob.glob(os.path.join(ann_dir, '*.xml')))
+        names = set()
+        parsed = []
+        for p in paths:
+            image, bounds, kps = _parse_annotation(p)
+            parsed.append((p, image, bounds, kps))
+            names.update(n for n, _, _ in kps)
+        self.keypoint_names = sorted(names)
+        name_to_class = {n: i for i, n in enumerate(self.keypoint_names)}
+
+        # Deterministic train/test split over instances.
+        n_train = int(len(parsed) * train_fraction)
+        parsed = parsed[:n_train] if train else parsed[n_train:]
+
+        # VGG features are expensive (one forward per instance); cache them
+        # on disk keyed by the weight source, like the reference's processed
+        # files (PyG PascalVOCKeypoints caches its VGG features the same
+        # way).
+        cache = self._feature_cache(category)
+
+        self._graphs = []
+        dirty = False
+        for p, image, bounds, kps in parsed:
+            if not kps:
+                continue
+            pts = np.array([(x, y) for _, x, y in kps], np.float64)
+            y = np.array([name_to_class[n] for n, _, _ in kps], np.int64)
+            # Skip instances with duplicate keypoint classes (cannot define
+            # a bijective ground truth).
+            if len(np.unique(y)) != len(y):
+                continue
+            name = os.path.splitext(os.path.basename(p))[0]
+            if bounds is not None:
+                x0, y0, x1, y1 = bounds
+            else:
+                (x0, y0), (x1, y1) = pts.min(axis=0), pts.max(axis=0)
+            local = pts - np.array([x0, y0])
+            if name in cache:
+                x = cache[name]
+            else:
+                # Crop the instance to its (slightly padded) bounding box so
+                # keypoints are well separated on the conv feature maps —
+                # the reference pipeline's crop-to-bbox preprocessing.
+                img = self._image(image)
+                h, w = img.shape[:2]
+                pad = 0.05 * max(x1 - x0, y1 - y0)
+                cx0 = int(max(0, np.floor(x0 - pad)))
+                cy0 = int(max(0, np.floor(y0 - pad)))
+                cx1 = int(min(w, np.ceil(x1 + pad))) or w
+                cy1 = int(min(h, np.ceil(y1 + pad))) or h
+                if cx1 > cx0 and cy1 > cy0:
+                    crop = img[cy0:cy1, cx0:cx1]
+                    crop_pts = pts - np.array([cx0, cy0])
+                else:
+                    crop, crop_pts = img, pts
+                x = self.features(crop, crop_pts)
+                cache[name] = x
+                dirty = True
+            g = Graph(edge_index=np.zeros((2, 0), np.int64), x=x,
+                      pos=local.astype(np.float32), y=y, name=name)
+            if pre_filter is not None and not pre_filter(g):
+                continue
+            self._graphs.append(g)
+        if dirty:
+            self._save_feature_cache(category, cache)
+
+    def _feature_cache(self, category):
+        tag = getattr(self.features, 'tag', None)
+        if not tag or tag == 'none':
+            self._cache_path = None
+            return {}
+        d = os.path.join(self.root, 'processed')
+        self._cache_path = os.path.join(d, f'{category}_{tag}.npz')
+        if os.path.exists(self._cache_path):
+            with np.load(self._cache_path) as z:
+                return {k: z[k] for k in z.files}
+        return {}
+
+    def _save_feature_cache(self, category, cache):
+        if self._cache_path is None:
+            return
+        os.makedirs(os.path.dirname(self._cache_path), exist_ok=True)
+        np.savez(self._cache_path, **cache)
+
+    def _image(self, image_name):
+        from PIL import Image
+        for ext in ('.jpg', '.png'):
+            p = os.path.join(self.root, 'images', image_name + ext)
+            if os.path.exists(p):
+                return np.asarray(Image.open(p).convert('RGB'))
+        return np.zeros((256, 256, 3), np.uint8)
+
+    def __len__(self):
+        return len(self._graphs)
+
+    def __getitem__(self, idx):
+        g = self._graphs[idx]
+        return self.transform(g) if self.transform else g
+
+    @property
+    def num_node_features(self):
+        return self._graphs[0].x.shape[1]
+
+    def __repr__(self):
+        return (f'PascalVOCKeypoints({self.category}, {len(self)}, '
+                f'kps={len(self.keypoint_names)})')

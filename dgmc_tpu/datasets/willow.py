@@ -1,0 +1,111 @@
+"""WILLOW-ObjectClass keypoint dataset.
+
+Capability parity with PyG's ``WILLOWObjectClass`` as consumed by the
+reference (reference ``examples/willow.py:7,48``): 5 categories (face,
+motorbike, car, duck, winebottle), each image annotated with exactly 10
+keypoints; node features are VGG16 activations sampled at the keypoints
+(see ``dgmc_tpu/datasets/features.py``), positions are the keypoint
+coordinates, and the ground truth between any two same-category items is
+the identity over the 10 keypoints (reference ``examples/willow.py:94-97``).
+
+Expected raw layout (the official release; no downloads attempted):
+
+    <root>/WILLOW-ObjectClass/<Category>/*.png
+    <root>/WILLOW-ObjectClass/<Category>/*.mat   (pts_coord [2, 10])
+"""
+
+import glob
+import os
+
+import numpy as np
+
+from dgmc_tpu.utils.data import Graph
+
+CATEGORIES = ('face', 'motorbike', 'car', 'duck', 'winebottle')
+_DIRNAMES = {'face': 'Face', 'motorbike': 'Motorbike', 'car': 'Car',
+             'duck': 'Duck', 'winebottle': 'Winebottle'}
+NUM_KEYPOINTS = 10
+
+
+class WILLOWObjectClass:
+    """One category of WILLOW-ObjectClass as a list-like of ``Graph`` s."""
+
+    def __init__(self, root, category, transform=None, features=None,
+                 device_features=None):
+        if category not in CATEGORIES:
+            raise ValueError(f'unknown category {category!r}')
+        self.root = os.path.expanduser(root)
+        self.category = category
+        self.transform = transform
+        if features is None:
+            from dgmc_tpu.datasets.features import VGG16Features
+            features = VGG16Features(weights=device_features or 'random')
+        self.features = features
+        base = os.path.join(self.root, 'WILLOW-ObjectClass',
+                            _DIRNAMES[category])
+        if not os.path.isdir(base):
+            base_alt = os.path.join(self.root, 'WILLOW-ObjectClass', category)
+            if os.path.isdir(base_alt):
+                base = base_alt
+            else:
+                raise FileNotFoundError(
+                    f'WILLOW raw data not found at {base}; place the '
+                    f'WILLOW-ObjectClass release under {self.root} '
+                    f'(no downloads attempted).')
+        self._graphs = self._load(base)
+
+    def _load(self, base):
+        from PIL import Image
+        from scipy.io import loadmat
+        graphs = []
+        for mat_path in sorted(glob.glob(os.path.join(base, '*.mat'))):
+            m = loadmat(mat_path)
+            pts = np.asarray(m['pts_coord'], np.float64)[:2].T  # [10, 2] xy
+            name = os.path.splitext(os.path.basename(mat_path))[0]
+            img_path = os.path.join(base, name + '.png')
+            if os.path.exists(img_path):
+                img = np.asarray(Image.open(img_path).convert('RGB'))
+            else:
+                img = np.zeros((256, 256, 3), np.uint8)
+            x = self.features(img, pts)
+            # Positions normalized like the PyG processing: centered on the
+            # keypoint centroid (graph transforms rebuild edges from pos).
+            pos = (pts - pts.mean(axis=0)).astype(np.float32)
+            graphs.append(Graph(
+                edge_index=np.zeros((2, 0), np.int64), x=x, pos=pos,
+                y=np.arange(pts.shape[0], dtype=np.int64), name=name))
+        if not graphs:
+            raise FileNotFoundError(f'no .mat annotations under {base}')
+        return graphs
+
+    def __len__(self):
+        return len(self._graphs)
+
+    def __getitem__(self, idx):
+        g = self._graphs[idx]
+        return self.transform(g) if self.transform else g
+
+    def shuffled_split(self, n_train, seed=0):
+        """Random n_train / rest split (reference ``willow.py:144-146``)."""
+        order = np.random.RandomState(seed).permutation(len(self))
+        pick = lambda idxs: _Subset(self, idxs)  # noqa: E731
+        return pick(order[:n_train]), pick(order[n_train:])
+
+    @property
+    def num_node_features(self):
+        return self._graphs[0].x.shape[1]
+
+    def __repr__(self):
+        return f'WILLOWObjectClass({self.category}, {len(self)})'
+
+
+class _Subset:
+    def __init__(self, dataset, indices):
+        self.dataset = dataset
+        self.indices = list(indices)
+
+    def __len__(self):
+        return len(self.indices)
+
+    def __getitem__(self, i):
+        return self.dataset[self.indices[i]]

@@ -1,13 +1,16 @@
 from dgmc_tpu.utils.data import (Graph, GraphPair, PairDataset,
-                                 ValidPairDataset, pad_graphs,
-                                 pad_pair_batch, PairLoader)
+                                 ValidPairDataset, ConcatDataset,
+                                 pad_graphs, pad_pair_batch, PairLoader,
+                                 graph_limits)
 
 __all__ = [
     'Graph',
     'GraphPair',
     'PairDataset',
     'ValidPairDataset',
+    'ConcatDataset',
     'pad_graphs',
     'pad_pair_batch',
     'PairLoader',
+    'graph_limits',
 ]

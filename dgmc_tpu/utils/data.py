@@ -240,6 +240,37 @@ def pad_pair_batch(pairs: List[GraphPair], num_nodes_s, num_edges_s,
     return PairBatch(s=g_s, t=g_t, y=y, y_mask=y_mask)
 
 
+def graph_limits(datasets):
+    """Max node / edge counts across graph datasets — the static padding a
+    :class:`PairLoader` needs so one XLA program serves every batch."""
+    n = e = 1
+    for ds in datasets:
+        for i in range(len(ds)):
+            g = ds[i]
+            n = max(n, g.num_nodes)
+            e = max(e, g.num_edges)
+    return n, e
+
+
+class ConcatDataset:
+    """Concatenation of several pair datasets (the reference uses
+    ``torch.utils.data.ConcatDataset`` across categories, reference
+    ``examples/pascal.py:41``)."""
+
+    def __init__(self, datasets):
+        self.datasets = list(datasets)
+        self._cum = np.cumsum([0] + [len(d) for d in self.datasets])
+
+    def __len__(self):
+        return int(self._cum[-1])
+
+    def __getitem__(self, idx):
+        if idx < 0:
+            idx += len(self)
+        d = int(np.searchsorted(self._cum, idx, side='right')) - 1
+        return self.datasets[d][idx - int(self._cum[d])]
+
+
 class PairLoader:
     """Minimal shuffling batch iterator over a pair dataset, emitting
     fixed-shape :class:`PairBatch` es (one XLA program per loader).

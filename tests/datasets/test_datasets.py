@@ -1,0 +1,157 @@
+"""Dataset parser tests against small on-disk fixtures (the raw-file formats
+of DBP15K / PascalPF / WILLOW / PascalVOC-Berkeley; no network access)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from dgmc_tpu.datasets import (DBP15K, PascalPF, PascalVOCKeypoints,
+                               VGG16Features, WILLOWObjectClass)
+
+
+# ---------------------------------------------------------------------------
+# Fixtures
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def dbp_root(tmp_path):
+    d = tmp_path / 'zh_en'
+    d.mkdir()
+    # Graph 1: global entity ids 10, 11, 12; graph 2: 20, 21, 22, 23.
+    (d / 'ent_ids_1').write_text('10\te1\n11\te2\n12\te3\n')
+    (d / 'ent_ids_2').write_text('20\tf1\n21\tf2\n22\tf3\n23\tf4\n')
+    (d / 'triples_1').write_text('10\t0\t11\n11\t1\t12\n')
+    (d / 'triples_2').write_text('20\t0\t21\n21\t0\t22\n22\t1\t23\n')
+    (d / 'sup_pairs').write_text('10\t20\n11\t21\n')
+    (d / 'ref_pairs').write_text('12\t22\n')
+    vecs = [[float(i)] * 4 for i in range(30)]
+    (d / 'zh_vectorList.json').write_text(json.dumps(vecs))
+    (d / 'en_vectorList.json').write_text(json.dumps(vecs))
+    return tmp_path
+
+
+@pytest.fixture
+def pf_root(tmp_path):
+    from scipy.io import savemat
+    ann = tmp_path / 'PF-dataset-PASCAL' / 'Annotations' / 'car'
+    ann.mkdir(parents=True)
+    rng = np.random.RandomState(0)
+    for name in ['2008_a', '2008_b', '2008_c']:
+        kps = rng.rand(8, 2) * 100
+        savemat(str(ann / f'{name}.mat'), {'kps': kps})
+    return tmp_path
+
+
+@pytest.fixture
+def willow_root(tmp_path):
+    from PIL import Image
+    from scipy.io import savemat
+    base = tmp_path / 'WILLOW-ObjectClass' / 'Car'
+    base.mkdir(parents=True)
+    rng = np.random.RandomState(0)
+    for name in ['img1', 'img2']:
+        pts = rng.rand(2, 10) * 200
+        savemat(str(base / f'{name}.mat'), {'pts_coord': pts})
+        Image.fromarray(
+            rng.randint(0, 255, (64, 80, 3), dtype=np.uint8)).save(
+                str(base / f'{name}.png'))
+    return tmp_path
+
+
+@pytest.fixture
+def voc_root(tmp_path):
+    ann = tmp_path / 'annotations' / 'car'
+    ann.mkdir(parents=True)
+    kp_names = ['wheel_f', 'wheel_b', 'light', 'mirror']
+    rng = np.random.RandomState(0)
+    for i in range(4):
+        kps = '\n'.join(
+            f'<keypoint name="{n}" x="{10 + 5 * j + i}" y="{20 + 3 * j}" '
+            f'visible="1"/>'
+            for j, n in enumerate(kp_names))
+        (ann / f'inst_{i}.xml').write_text(f'''<annotation>
+  <image>2009_{i:04d}</image>
+  <visible_bounds xmin="5" ymin="10" xmax="120" ymax="90"/>
+  <keypoints>{kps}</keypoints>
+</annotation>''')
+    return tmp_path
+
+
+# ---------------------------------------------------------------------------
+# Tests
+# ---------------------------------------------------------------------------
+
+
+def test_dbp15k_parses(dbp_root):
+    ds = DBP15K(str(dbp_root), 'zh_en')
+    assert ds.num_nodes1 == 3 and ds.num_nodes2 == 4
+    assert ds.edge_index1.shape == (2, 2)
+    assert ds.edge_index2.shape == (2, 3)
+    # Local indices: global 10->0, 20->0 etc.
+    np.testing.assert_array_equal(ds.train_y, [[0, 1], [0, 1]])
+    np.testing.assert_array_equal(ds.test_y, [[2], [2]])
+    g1, g2 = ds.graphs()
+    assert g1.x.shape == (3, 4)       # W=1 word summed away
+    assert g1.x.dtype == np.float32
+    # Feature of entity with global id 11 (row 11 of vectorList) = 11.0.
+    np.testing.assert_allclose(g1.x[1], 11.0)
+
+
+def test_dbp15k_missing_raises(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        DBP15K(str(tmp_path), 'ja_en')
+
+
+def test_pascal_pf_parses(pf_root):
+    ds = PascalPF(str(pf_root), 'car')
+    assert len(ds) == 3
+    # No parsePascalVOC.mat -> consecutive fallback pairs.
+    assert len(ds.pairs) == 2
+    for g_s, g_t, y in ds.pair_graphs():
+        assert g_s.pos.shape[1] == 2
+        assert np.abs(g_s.pos).max() <= 1.0 + 1e-6
+        np.testing.assert_array_equal(y, np.arange(8))
+
+
+def test_willow_parses(willow_root):
+    ds = WILLOWObjectClass(str(willow_root), 'car',
+                           features=VGG16Features(weights='none'))
+    assert len(ds) == 2
+    g = ds[0]
+    assert g.x.shape == (10, 1024)
+    assert g.pos.shape == (10, 2)
+    np.testing.assert_allclose(g.pos.mean(axis=0), 0.0, atol=1e-4)
+    np.testing.assert_array_equal(g.y, np.arange(10))
+    train, test = ds.shuffled_split(1, seed=0)
+    assert len(train) == 1 and len(test) == 1
+
+
+def test_voc_parses_and_valid_pairs(voc_root):
+    ds = PascalVOCKeypoints(str(voc_root), 'car', train=True,
+                            features=VGG16Features(weights='none'))
+    assert len(ds) == 3          # 80% of 4
+    g = ds[0]
+    assert g.x.shape == (4, 1024)
+    assert sorted(g.y) == [0, 1, 2, 3]
+
+    from dgmc_tpu.utils import ValidPairDataset
+    pairs = ValidPairDataset(ds, ds)
+    assert len(pairs) == 9
+    p = pairs[1]
+    # Ground truth maps each source node to the target node of equal class.
+    assert (p.t.y[p.y_col] == p.s.y).all()
+
+
+def test_vgg_random_features_deterministic(willow_root):
+    f1 = VGG16Features(weights='random', input_size=64)
+    f2 = VGG16Features(weights='random', input_size=64)
+    img = np.random.RandomState(3).randint(0, 255, (50, 60, 3),
+                                           dtype=np.uint8)
+    kps = np.array([[5.0, 5.0], [30.0, 20.0]])
+    a, b = f1(img, kps), f2(img, kps)
+    assert a.shape == (2, 1024)
+    np.testing.assert_allclose(a, b)
+    assert np.isfinite(a).all() and np.abs(a).sum() > 0

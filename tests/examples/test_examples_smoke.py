@@ -1,0 +1,114 @@
+"""End-to-end smoke runs of every experiment script on tiny fixture data —
+the four workloads of SURVEY.md §2.2, exercised through their CLIs."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture
+def dbp_root(tmp_path):
+    rng = np.random.RandomState(0)
+    d = tmp_path / 'zh_en'
+    d.mkdir()
+    n1, n2 = 12, 14
+    (d / 'ent_ids_1').write_text(
+        ''.join(f'{i}\te{i}\n' for i in range(n1)))
+    (d / 'ent_ids_2').write_text(
+        ''.join(f'{100 + i}\tf{i}\n' for i in range(n2)))
+    (d / 'triples_1').write_text(''.join(
+        f'{rng.randint(n1)}\t0\t{rng.randint(n1)}\n' for _ in range(30)))
+    (d / 'triples_2').write_text(''.join(
+        f'{100 + rng.randint(n2)}\t0\t{100 + rng.randint(n2)}\n'
+        for _ in range(36)))
+    (d / 'sup_pairs').write_text(
+        ''.join(f'{i}\t{100 + i}\n' for i in range(6)))
+    (d / 'ref_pairs').write_text(
+        ''.join(f'{i}\t{100 + i}\n' for i in range(6, 12)))
+    vecs = rng.randn(120, 8).tolist()
+    (d / 'zh_vectorList.json').write_text(json.dumps(vecs))
+    (d / 'en_vectorList.json').write_text(json.dumps(vecs))
+    return tmp_path
+
+
+@pytest.fixture
+def voc_root(tmp_path):
+    from dgmc_tpu.datasets.pascal_voc import CATEGORIES
+    rng = np.random.RandomState(1)
+    kp_names = ['a', 'b', 'c', 'd', 'e', 'f']
+    for cat in CATEGORIES:
+        ann = tmp_path / 'annotations' / cat
+        ann.mkdir(parents=True)
+        for i in range(4):
+            pts = rng.rand(len(kp_names), 2) * 80 + 10
+            kps = '\n'.join(
+                f'<keypoint name="{n}" x="{pts[j, 0]:.1f}" '
+                f'y="{pts[j, 1]:.1f}" visible="1"/>'
+                for j, n in enumerate(kp_names))
+            (ann / f'{2008 + i}_{i:04d}.xml').write_text(
+                f'<annotation><image>im_{cat}_{i}</image>'
+                f'<visible_bounds xmin="0" ymin="0" xmax="100" ymax="100"/>'
+                f'<keypoints>{kps}</keypoints></annotation>')
+    return tmp_path
+
+
+@pytest.fixture
+def willow_root(tmp_path):
+    from PIL import Image
+    from scipy.io import savemat
+    from dgmc_tpu.datasets.willow import _DIRNAMES
+    rng = np.random.RandomState(2)
+    for dirname in _DIRNAMES.values():
+        base = tmp_path / 'WILLOW-ObjectClass' / dirname
+        base.mkdir(parents=True)
+        for i in range(22):
+            savemat(str(base / f'im{i:03d}.mat'),
+                    {'pts_coord': rng.rand(2, 10) * 100})
+            if i == 0:  # one real image is enough; the rest fall back
+                Image.fromarray(rng.randint(
+                    0, 255, (32, 32, 3), dtype=np.uint8)).save(
+                        str(base / f'im{i:03d}.png'))
+    return tmp_path
+
+
+def test_pascal_pf_runs():
+    from examples import pascal_pf
+    state = pascal_pf.main([
+        '--epochs', '1', '--batch_size', '8', '--dim', '16',
+        '--rnd_dim', '8', '--num_steps', '1',
+        '--data_root', '/nonexistent'])
+    assert state is not None
+
+
+def test_dbp15k_runs(dbp_root):
+    from examples import dbp15k
+    state = dbp15k.main([
+        '--category', 'zh_en', '--data_root', str(dbp_root),
+        '--dim', '8', '--rnd_dim', '4', '--num_layers', '1',
+        '--num_steps', '1', '--k', '2', '--epochs', '4',
+        '--phase1_epochs', '2'])
+    assert state is not None
+
+
+def test_pascal_runs(voc_root):
+    from examples import pascal
+    state = pascal.main([
+        '--data_root', str(voc_root), '--vgg_weights', 'none',
+        '--dim', '8', '--rnd_dim', '4', '--num_layers', '1',
+        '--num_steps', '1', '--batch_size', '8', '--epochs', '1',
+        '--test_samples', '8'])
+    assert state is not None
+
+
+def test_willow_runs(voc_root, willow_root):
+    from examples import willow
+    accs = willow.main([
+        '--voc_root', str(voc_root), '--willow_root', str(willow_root),
+        '--vgg_weights', 'none', '--dim', '8', '--rnd_dim', '4',
+        '--num_layers', '1', '--num_steps', '1', '--batch_size', '8',
+        '--pre_epochs', '1', '--epochs', '1', '--runs', '2',
+        '--test_samples', '8'])
+    assert accs.shape == (2, 5)
+    assert np.isfinite(accs).all()
