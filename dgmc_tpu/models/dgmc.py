@@ -110,6 +110,13 @@ class DGMC(nn.Module):
     # so a single huge pair (DBP15K-scale) spreads its activation state
     # across chips. GSPMD propagates the layout through the consensus loop.
     corr_sharding: Optional[object] = None
+    # Opt-in Pallas kernel for the dense consensus update: bounds the
+    # [B, N_s, N_t, R] difference tensor to one VMEM tile and rematerializes
+    # it tile-by-tile in the backward. Measured on-chip, XLA's own fusion of
+    # the unfused form is at least as fast at fitting sizes — use this for
+    # huge dense pairs where residual memory, not time, is the limit.
+    # Ignored (jnp path) when corr_sharding is set.
+    fused_consensus: bool = False
 
     def _constrain(self, a):
         if self.corr_sharding is None:
@@ -146,11 +153,18 @@ class DGMC(nn.Module):
         R_in = self.psi_2.in_channels
         R_out = self.psi_2.out_channels
 
-        mlp_hidden = nn.Dense(R_out, name='mlp_hidden')
-        mlp_out = nn.Dense(1, name='mlp_out')
+        # Explicit consensus-MLP params (not nn.Dense) so the fused Pallas
+        # kernel and the jnp path share one parameter set.
+        init = nn.initializers.lecun_normal()
+        mlp_w1 = self.param('mlp_hidden_kernel', init, (R_out, R_out))
+        mlp_b1 = self.param('mlp_hidden_bias', nn.initializers.zeros,
+                            (R_out,))
+        mlp_w2 = self.param('mlp_out_kernel', init, (R_out, 1))
+        mlp_b2 = self.param('mlp_out_bias', nn.initializers.zeros, (1,))
 
         def consensus_mlp(d):
-            return mlp_out(nn.relu(mlp_hidden(d)))[..., 0]
+            h = nn.relu(d @ mlp_w1 + mlp_b1)
+            return (h @ mlp_w2)[..., 0] + mlp_b2[0]
 
         def noise(step):
             key = self.make_rng('noise')
@@ -162,15 +176,23 @@ class DGMC(nn.Module):
             S_mask = s_mask[:, :, None] & t_mask[:, None, :]
             S_0 = masked_softmax(S_hat, S_mask)
 
+            use_fused = self.fused_consensus and self.corr_sharding is None
             for step in range(num_steps):
                 S = masked_softmax(S_hat, S_mask)
                 r_s = noise(step)
                 r_t = jnp.einsum('bst,bsr->btr', S, r_s)
                 o_s = self.psi_2(r_s, graph_s, train=train)
                 o_t = self.psi_2(r_t, graph_t, train=train)
-                D = o_s[:, :, None, :] - o_t[:, None, :, :]
+                if use_fused:
+                    from dgmc_tpu.ops.pallas import consensus_update
+                    delta = consensus_update(
+                        o_s, o_t, mlp_w1, mlp_b1, mlp_w2, mlp_b2,
+                        jax.default_backend() != 'tpu')  # interpret off-TPU
+                else:
+                    D = o_s[:, :, None, :] - o_t[:, None, :, :]
+                    delta = consensus_mlp(D)
                 S_hat = self._constrain(
-                    S_hat + jnp.where(S_mask, consensus_mlp(D), 0.0))
+                    S_hat + jnp.where(S_mask, delta, 0.0))
 
             S_L = masked_softmax(S_hat, S_mask)
             return (Correspondence(S_0, None, s_mask, t_mask),

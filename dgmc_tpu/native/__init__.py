@@ -1,0 +1,150 @@
+"""Native (C++) host runtime: batch collation via ctypes.
+
+The compute path is JAX/XLA/Pallas; the host runtime around it — here, the
+padded-batch collation that feeds the device — is native C++, mirroring the
+reference's reliance on native collation inside its data loader (SURVEY.md
+§2.3/§2.4). The shared library is compiled on first use with the system
+``g++`` (no pip installs) and cached next to this package; everything
+degrades to the pure-NumPy implementation when no compiler is available.
+"""
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, 'collate.cpp')
+_LIB_PATH = os.path.join(_HERE, 'libdgmc_collate.so')
+
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+
+def _build():
+    cmd = ['g++', '-O3', '-shared', '-fPIC', '-o', _LIB_PATH, _SRC]
+    subprocess.run(cmd, check=True, capture_output=True)
+
+
+def load_library():
+    """The collation library, building it on first use; None if unavailable."""
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        try:
+            if not os.path.exists(_LIB_PATH) or (
+                    os.path.getmtime(_LIB_PATH) < os.path.getmtime(_SRC)):
+                _build()
+            lib = ctypes.CDLL(_LIB_PATH)
+        except (OSError, subprocess.CalledProcessError):
+            return None
+
+        lib.pad_graph_batch.restype = ctypes.c_int
+        lib.pad_graph_batch.argtypes = [
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_void_p),                 # xs
+            ctypes.POINTER(ctypes.c_int64),                  # ns
+            ctypes.POINTER(ctypes.c_void_p),                 # senders
+            ctypes.POINTER(ctypes.c_void_p),                 # receivers
+            ctypes.POINTER(ctypes.c_int64),                  # es
+            ctypes.POINTER(ctypes.c_void_p),                 # eattrs
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+        ]
+        lib.pad_ground_truth.restype = None
+        lib.pad_ground_truth.argtypes = [
+            ctypes.c_int64, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_void_p, ctypes.c_void_p,
+        ]
+        _lib = lib
+        return _lib
+
+
+def available():
+    return load_library() is not None
+
+
+def _ptr_array(arrays):
+    """A C array of pointers into the given NumPy arrays (or None)."""
+    ptrs = (ctypes.c_void_p * len(arrays))()
+    for i, a in enumerate(arrays):
+        ptrs[i] = None if a is None else a.ctypes.data_as(ctypes.c_void_p)
+    return ptrs
+
+
+def pad_graphs_native(graphs, num_nodes, num_edges, feat_dim, edge_dim):
+    """C++-backed equivalent of the NumPy loop in
+    :func:`dgmc_tpu.utils.data.pad_graphs`. Returns the padded arrays dict
+    or None when the native library is unavailable."""
+    lib = load_library()
+    if lib is None:
+        return None
+
+    B = len(graphs)
+    xs, ns, senders, receivers, es, eattrs = [], [], [], [], [], []
+    for g in graphs:
+        x = None if g.x is None else np.ascontiguousarray(g.x, np.float32)
+        e = np.ascontiguousarray(g.edge_index, np.int64)
+        xs.append(x)
+        ns.append(g.num_nodes)
+        senders.append(np.ascontiguousarray(e[0]))
+        receivers.append(np.ascontiguousarray(e[1]))
+        es.append(g.num_edges)
+        eattrs.append(None if g.edge_attr is None else
+                      np.ascontiguousarray(g.edge_attr, np.float32))
+
+    x_out = np.zeros((B, num_nodes, feat_dim), np.float32)
+    senders_out = np.zeros((B, num_edges), np.int32)
+    receivers_out = np.zeros((B, num_edges), np.int32)
+    node_mask = np.zeros((B, num_nodes), np.uint8)
+    edge_mask = np.zeros((B, num_edges), np.uint8)
+    eattr_out = (np.zeros((B, num_edges, edge_dim), np.float32)
+                 if edge_dim else None)
+
+    rc = lib.pad_graph_batch(
+        B, num_nodes, num_edges, feat_dim, edge_dim or 0,
+        _ptr_array(xs), (ctypes.c_int64 * B)(*ns),
+        _ptr_array(senders), _ptr_array(receivers),
+        (ctypes.c_int64 * B)(*es), _ptr_array(eattrs),
+        x_out.ctypes.data_as(ctypes.c_void_p),
+        senders_out.ctypes.data_as(ctypes.c_void_p),
+        receivers_out.ctypes.data_as(ctypes.c_void_p),
+        node_mask.ctypes.data_as(ctypes.c_void_p),
+        edge_mask.ctypes.data_as(ctypes.c_void_p),
+        None if eattr_out is None else
+        eattr_out.ctypes.data_as(ctypes.c_void_p))
+    if rc != 0:
+        g = graphs[rc - 1]
+        raise ValueError(f'graph {rc - 1} ({g.num_nodes} nodes / '
+                         f'{g.num_edges} edges) exceeds padding '
+                         f'({num_nodes} / {num_edges})')
+    return dict(x=x_out, senders=senders_out, receivers=receivers_out,
+                node_mask=node_mask.astype(bool),
+                edge_mask=edge_mask.astype(bool),
+                edge_attr=eattr_out)
+
+
+def pad_ground_truth_native(y_cols, num_nodes):
+    """C++-backed GT padding: list of per-pair y_col arrays (or None) ->
+    (y[B, N] int32, y_mask[B, N] bool); None if unavailable."""
+    lib = load_library()
+    if lib is None:
+        return None
+    B = len(y_cols)
+    cols = [None if y is None else np.ascontiguousarray(y, np.int64)
+            for y in y_cols]
+    lens = [0 if y is None else len(y) for y in cols]
+    y_out = np.empty((B, num_nodes), np.int32)
+    mask_out = np.empty((B, num_nodes), np.uint8)
+    lib.pad_ground_truth(
+        B, num_nodes, _ptr_array(cols), (ctypes.c_int64 * B)(*lens),
+        y_out.ctypes.data_as(ctypes.c_void_p),
+        mask_out.ctypes.data_as(ctypes.c_void_p))
+    return y_out, mask_out.astype(bool)

@@ -1,0 +1,9 @@
+from dgmc_tpu.ops.pallas.consensus import (consensus_update,
+                                           consensus_update_reference,
+                                           fused_consensus_available)
+
+__all__ = [
+    'consensus_update',
+    'consensus_update_reference',
+    'fused_consensus_available',
+]

@@ -1,0 +1,150 @@
+"""Fused consensus-update kernel — the dense hot loop's memory wall.
+
+Every consensus step of the dense variant computes
+``S_hat += mlp(o_s[:, :, None, :] - o_t[:, None, :, :])`` (reference
+``dgmc/models/dgmc.py:178-179``), where the broadcast difference tensor
+``D`` has shape ``[B, N_s, N_t, R]`` — R times the size of the
+correspondence matrix itself. XLA materializes it in HBM; at DBP15K scale
+(15k x 20k x 32 floats) that's ~38 GB per step, the exact blow-up that
+forces the reference onto its sparse path.
+
+The Pallas kernel tiles ``(N_s, N_t)``, forms each ``[TILE_S, TILE_T, R]``
+difference block in VMEM only, runs the 2-layer MLP on the MXU
+(``[TILE_S*TILE_T, R] @ [R, R]`` then ``@ [R, 1]``), and writes the
+``[TILE_S, TILE_T]`` result — HBM traffic drops from ``O(N_s*N_t*R)`` to
+``O(N_s*N_t)``. The backward pass recomputes ``D`` tile-by-tile in a
+``lax.scan`` (flash-attention-style rematerialization), so the gradient
+never materializes ``D`` either.
+
+Falls back to a pure-jnp path off-TPU (``interpret=True`` under tests).
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+TILE_S = 128
+TILE_T = 128
+
+
+def _mlp_tile(d, w1, b1, w2, b2):
+    """2-layer MLP on a flattened difference tile. d: [S*T, R]."""
+    h = jnp.maximum(d @ w1 + b1, 0.0)
+    return h @ w2 + b2
+
+
+def _consensus_kernel(o_s_ref, o_t_ref, w1_ref, b1_ref, w2_ref, b2_ref,
+                      out_ref):
+    o_s = o_s_ref[0]          # [TILE_S, R]
+    o_t = o_t_ref[0]          # [TILE_T, R]
+    ts, tt = o_s.shape[0], o_t.shape[0]
+    d = (o_s[:, None, :] - o_t[None, :, :]).reshape(ts * tt, -1)
+    out = _mlp_tile(d, w1_ref[:], b1_ref[0], w2_ref[:], b2_ref[0])
+    out_ref[0] = out.reshape(ts, tt)
+
+
+def _forward_pallas(o_s, o_t, w1, b1, w2, b2, interpret=False):
+    B, N_s, R = o_s.shape
+    N_t = o_t.shape[1]
+    pad_s = (-N_s) % TILE_S
+    pad_t = (-N_t) % TILE_T
+    o_s_p = jnp.pad(o_s, ((0, 0), (0, pad_s), (0, 0)))
+    o_t_p = jnp.pad(o_t, ((0, 0), (0, pad_t), (0, 0)))
+    grid = (B, (N_s + pad_s) // TILE_S, (N_t + pad_t) // TILE_T)
+    out = pl.pallas_call(
+        _consensus_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, TILE_S, R), lambda b, i, j: (b, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, TILE_T, R), lambda b, i, j: (b, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((R, R), lambda b, i, j: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, R), lambda b, i, j: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((R, 1), lambda b, i, j: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1), lambda b, i, j: (0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, TILE_S, TILE_T),
+                               lambda b, i, j: (b, i, j),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((B, N_s + pad_s, N_t + pad_t),
+                                       o_s.dtype),
+        interpret=interpret,
+    )(o_s_p, o_t_p, w1, b1[None, :], w2, b2[None, :])
+    return out[:, :N_s, :N_t]
+
+
+def consensus_update_reference(o_s, o_t, w1, b1, w2, b2):
+    """Unfused jnp semantics (materializes D — for tests / CPU)."""
+    d = o_s[:, :, None, :] - o_t[:, None, :, :]
+    h = jnp.maximum(jnp.einsum('bstr,rq->bstq', d, w1) + b1, 0.0)
+    return jnp.einsum('bstq,qo->bsto', h, w2)[..., 0] + b2[0]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(6,))
+def consensus_update(o_s, o_t, w1, b1, w2, b2, interpret=False):
+    """``mlp(o_s[:, :, None] - o_t[:, None, :])`` -> ``[B, N_s, N_t]``
+    without materializing the difference tensor."""
+    return _forward_pallas(o_s, o_t, w1, b1, w2, b2, interpret=interpret)
+
+
+def _fwd(o_s, o_t, w1, b1, w2, b2, interpret=False):
+    out = _forward_pallas(o_s, o_t, w1, b1, w2, b2, interpret=interpret)
+    return out, (o_s, o_t, w1, b1, w2)
+
+
+def _bwd(interpret, res, g):
+    """Tile-recompute backward: scan over target tiles; D is rebuilt per
+    tile and never stored."""
+    o_s, o_t, w1, b1, w2 = res
+    B, N_s, R = o_s.shape
+    N_t = o_t.shape[1]
+
+    pad = (-N_t) % TILE_T
+    o_t_p = jnp.pad(o_t, ((0, 0), (0, pad), (0, 0)))
+    g_p = jnp.pad(g, ((0, 0), (0, 0), (0, pad)))
+    nblk = o_t_p.shape[1] // TILE_T
+    o_t_blocks = jnp.moveaxis(
+        o_t_p.reshape(B, nblk, TILE_T, R), 1, 0)          # [nblk,B,T,R]
+    g_blocks = jnp.moveaxis(
+        g_p.reshape(B, N_s, nblk, TILE_T), 2, 0)          # [nblk,B,S,T]
+
+    def step(carry, inp):
+        d_os, d_w1, d_b1, d_w2, d_b2 = carry
+        o_t_b, g_b = inp                                   # [B,T,R], [B,S,T]
+        d = o_s[:, :, None, :] - o_t_b[:, None, :, :]      # [B,S,T,R]
+        pre = jnp.einsum('bstr,rq->bstq', d, w1) + b1
+        h = jnp.maximum(pre, 0.0)
+        # out = h @ w2 + b2
+        d_h = g_b[..., None] * w2[:, 0]                    # [B,S,T,R]
+        d_pre = jnp.where(pre > 0, d_h, 0.0)
+        d_d = jnp.einsum('bstq,rq->bstr', d_pre, w1)
+        d_os = d_os + d_d.sum(axis=2)
+        d_ot_b = -d_d.sum(axis=1)                          # [B,T,R]
+        d_w1 = d_w1 + jnp.einsum('bstr,bstq->rq', d, d_pre)
+        d_b1 = d_b1 + d_pre.sum(axis=(0, 1, 2))
+        d_w2 = d_w2 + jnp.einsum('bstq,bst->q', h, g_b)[:, None]
+        d_b2 = d_b2 + g_b.sum()[None]
+        return (d_os, d_w1, d_b1, d_w2, d_b2), d_ot_b
+
+    zeros = (jnp.zeros_like(o_s), jnp.zeros_like(w1), jnp.zeros_like(b1),
+             jnp.zeros_like(w2), jnp.zeros((1,), o_s.dtype))
+    (d_os, d_w1, d_b1, d_w2, d_b2), d_ot_blocks = jax.lax.scan(
+        step, zeros, (o_t_blocks, g_blocks))
+    d_ot = jnp.moveaxis(d_ot_blocks, 0, 1).reshape(B, -1, R)[:, :N_t]
+    return d_os, d_ot, d_w1, d_b1, d_w2, d_b2
+
+
+consensus_update.defvjp(_fwd, _bwd)
+
+
+def fused_consensus_available():
+    """True when the default backend can run the compiled kernel."""
+    return jax.default_backend() == 'tpu'

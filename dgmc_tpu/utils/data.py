@@ -160,11 +160,13 @@ class ValidPairDataset:
 
 
 def pad_graphs(graphs: Sequence[Graph], num_nodes: int, num_edges: int,
-               feat_dim: Optional[int] = None):
+               feat_dim: Optional[int] = None, native: str = 'auto'):
     """Collate host graphs into the arrays of a device ``GraphBatch``.
 
-    Returns a dict of NumPy arrays (so callers can choose device placement /
-    dtype); ``dgmc_tpu.ops.GraphBatch(**out)`` is jit-ready.
+    ``native='auto'`` routes through the C++ collation engine
+    (``dgmc_tpu/native``) when its shared library is available, falling back
+    to the NumPy loop below; ``'never'`` forces NumPy (used by the parity
+    tests), ``'require'`` errors if the library is missing.
     """
     from dgmc_tpu.ops import GraphBatch
 
@@ -176,6 +178,15 @@ def pad_graphs(graphs: Sequence[Graph], num_nodes: int, num_edges: int,
         if g.edge_attr is not None:
             edge_dim = g.edge_attr.shape[1]
             break
+
+    if native != 'never':
+        from dgmc_tpu import native as native_mod
+        out = native_mod.pad_graphs_native(graphs, num_nodes, num_edges,
+                                           feat_dim, edge_dim)
+        if out is not None:
+            return GraphBatch(**out)
+        if native == 'require':
+            raise RuntimeError('native collation library unavailable')
 
     x = np.zeros((B, num_nodes, feat_dim), np.float32)
     senders = np.zeros((B, num_edges), np.int32)
@@ -222,12 +233,21 @@ jax.tree_util.register_pytree_node(
 
 
 def pad_pair_batch(pairs: List[GraphPair], num_nodes_s, num_edges_s,
-                   num_nodes_t=None, num_edges_t=None):
+                   num_nodes_t=None, num_edges_t=None, native: str = 'auto'):
     """Collate :class:`GraphPair` lists into a :class:`PairBatch`."""
     num_nodes_t = num_nodes_t or num_nodes_s
     num_edges_t = num_edges_t or num_edges_s
-    g_s = pad_graphs([p.s for p in pairs], num_nodes_s, num_edges_s)
-    g_t = pad_graphs([p.t for p in pairs], num_nodes_t, num_edges_t)
+    g_s = pad_graphs([p.s for p in pairs], num_nodes_s, num_edges_s,
+                     native=native)
+    g_t = pad_graphs([p.t for p in pairs], num_nodes_t, num_edges_t,
+                     native=native)
+
+    if native != 'never':
+        from dgmc_tpu import native as native_mod
+        out = native_mod.pad_ground_truth_native(
+            [p.y_col for p in pairs], num_nodes_s)
+        if out is not None:
+            return PairBatch(s=g_s, t=g_t, y=out[0], y_mask=out[1])
 
     B = len(pairs)
     y = np.full((B, num_nodes_s), -1, np.int32)
@@ -324,3 +344,41 @@ class PairLoader:
                 return
             yield pad_pair_batch([self.dataset[int(i)] for i in chunk],
                                  self.num_nodes, self.num_edges)
+
+
+class PrefetchLoader:
+    """Background-thread prefetch around any batch iterable: batch b+1 is
+    collated on host while batch b trains on device — the role the
+    reference delegates to torch DataLoader worker processes."""
+
+    def __init__(self, loader, depth=2):
+        self.loader = loader
+        self.depth = depth
+
+    def __len__(self):
+        return len(self.loader)
+
+    def __iter__(self):
+        import queue
+        import threading
+
+        q = queue.Queue(maxsize=self.depth)
+        DONE = object()
+
+        def worker():
+            try:
+                for batch in self.loader:
+                    q.put(batch)
+                q.put(DONE)
+            except BaseException as e:  # surface errors on the consumer side
+                q.put(e)
+
+        t = threading.Thread(target=worker, daemon=True)
+        t.start()
+        while True:
+            item = q.get()
+            if item is DONE:
+                return
+            if isinstance(item, BaseException):
+                raise item
+            yield item
