@@ -17,6 +17,16 @@ Expected raw layout (no downloads attempted):
         </annotation>
     <root>/images/*.jpg                    VOC images (optional; zeros
                                            otherwise)
+    <root>/ImageSets/Main/<category>_{train,val}.txt
+                                           official VOC split lists
+                                           (``image_id [label]`` lines;
+                                           label -1 = excluded). A plain id
+                                           list at <root>/splits/<category>_
+                                           {train,val}.txt also works. When
+                                           neither exists, a deterministic
+                                           fraction split is used with a
+                                           warning (not the official
+                                           protocol).
 """
 
 import glob
@@ -97,9 +107,31 @@ class PascalVOCKeypoints:
         self.keypoint_names = sorted(names)
         name_to_class = {n: i for i, n in enumerate(self.keypoint_names)}
 
-        # Deterministic train/test split over instances.
-        n_train = int(len(parsed) * train_fraction)
-        parsed = parsed[:n_train] if train else parsed[n_train:]
+        # Split: prefer the official VOC image-id lists (what PyG's
+        # PascalVOCKeypoints uses, so accuracies are comparable to the
+        # reference, reference ``examples/pascal.py:31-38``); fall back to a
+        # deterministic fraction split over instances only when no lists are
+        # present — that fallback is NOT the official protocol and may put
+        # instances of one image in both splits.
+        split_ids = self._load_split_ids(train)
+        if split_ids is not None:
+            kept = [rec for rec in parsed if rec[1] in split_ids]
+            if parsed and split_ids and not kept:
+                raise ValueError(
+                    f'split list for {category!r} matched 0 of '
+                    f'{len(parsed)} annotated instances — the list ids do '
+                    f'not correspond to the annotations\' <image> fields '
+                    f'(wrong VOC year, or ids carry file suffixes?)')
+            parsed = kept
+        else:
+            import warnings
+            warnings.warn(
+                f'No official split list found for {category!r} under '
+                f'{self.root}/ImageSets/Main; using a {train_fraction:.0%} '
+                f'fraction split — results are not comparable to the '
+                f'reference protocol.', stacklevel=2)
+            n_train = int(len(parsed) * train_fraction)
+            parsed = parsed[:n_train] if train else parsed[n_train:]
 
         # VGG features are expensive (one forward per instance); cache them
         # on disk keyed by the weight source, like the reference's processed
@@ -152,6 +184,33 @@ class PascalVOCKeypoints:
             self._graphs.append(g)
         if dirty:
             self._save_feature_cache(category, cache)
+
+    def _load_split_ids(self, train):
+        """Image ids from the official VOC split lists, if present.
+
+        Looks for ``<root>/ImageSets/Main/<category>_{train,val}.txt`` (VOC
+        layout: ``image_id [label]`` lines, label -1 meaning the category is
+        absent) or a plain id list at ``<root>/splits/<category>_*.txt``.
+        Returns None when neither exists.
+        """
+        name = 'train' if train else 'val'
+        candidates = [
+            os.path.join(self.root, 'ImageSets', 'Main',
+                         f'{self.category}_{name}.txt'),
+            os.path.join(self.root, 'splits', f'{self.category}_{name}.txt'),
+        ]
+        for path in candidates:
+            if not os.path.exists(path):
+                continue
+            ids = set()
+            with open(path) as f:
+                for line in f:
+                    parts = line.split()
+                    if not parts or (len(parts) >= 2 and parts[1] == '-1'):
+                        continue
+                    ids.add(parts[0])
+            return ids
+        return None
 
     def _feature_cache(self, category):
         tag = getattr(self.features, 'tag', None)

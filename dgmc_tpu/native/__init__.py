@@ -89,7 +89,20 @@ def pad_graphs_native(graphs, num_nodes, num_edges, feat_dim, edge_dim):
 
     B = len(graphs)
     xs, ns, senders, receivers, es, eattrs = [], [], [], [], [], []
-    for g in graphs:
+    for i, g in enumerate(graphs):
+        # The C++ path memcpys feat_dim/edge_dim-wide rows straight from
+        # these buffers, so a width mismatch that the NumPy path would catch
+        # as a broadcast error must be rejected here, not read out of bounds.
+        if g.x is not None and (g.x.ndim != 2 or g.x.shape[1] != feat_dim):
+            raise ValueError(
+                f'graph {i}: x has shape {g.x.shape}, expected '
+                f'[*, {feat_dim}]')
+        if g.edge_attr is not None and (
+                g.edge_attr.ndim != 2 or edge_dim is None or
+                g.edge_attr.shape[1] != edge_dim):
+            raise ValueError(
+                f'graph {i}: edge_attr has shape {g.edge_attr.shape}, '
+                f'expected [*, {edge_dim}]')
         x = None if g.x is None else np.ascontiguousarray(g.x, np.float32)
         e = np.ascontiguousarray(g.edge_index, np.int64)
         xs.append(x)

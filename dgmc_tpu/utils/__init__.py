@@ -1,7 +1,7 @@
 from dgmc_tpu.utils.data import (Graph, GraphPair, PairDataset,
                                  ValidPairDataset, ConcatDataset,
                                  pad_graphs, pad_pair_batch, PairLoader,
-                                 graph_limits)
+                                 PrefetchLoader, graph_limits)
 
 __all__ = [
     'Graph',
@@ -12,5 +12,6 @@ __all__ = [
     'pad_graphs',
     'pad_pair_batch',
     'PairLoader',
+    'PrefetchLoader',
     'graph_limits',
 ]

@@ -364,21 +364,38 @@ class PrefetchLoader:
 
         q = queue.Queue(maxsize=self.depth)
         DONE = object()
+        stop = threading.Event()
+
+        def put(item):
+            # Bounded put that gives up when the consumer is gone, so an
+            # abandoned iteration (break / exception) cannot pin the worker
+            # thread and its queued batches for the process lifetime.
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
 
         def worker():
             try:
                 for batch in self.loader:
-                    q.put(batch)
-                q.put(DONE)
+                    if not put(batch):
+                        return
+                put(DONE)
             except BaseException as e:  # surface errors on the consumer side
-                q.put(e)
+                put(e)
 
         t = threading.Thread(target=worker, daemon=True)
         t.start()
-        while True:
-            item = q.get()
-            if item is DONE:
-                return
-            if isinstance(item, BaseException):
-                raise item
-            yield item
+        try:
+            while True:
+                item = q.get()
+                if item is DONE:
+                    return
+                if isinstance(item, BaseException):
+                    raise item
+                yield item
+        finally:
+            stop.set()

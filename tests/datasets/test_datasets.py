@@ -130,8 +130,11 @@ def test_willow_parses(willow_root):
 
 
 def test_voc_parses_and_valid_pairs(voc_root):
-    ds = PascalVOCKeypoints(str(voc_root), 'car', train=True,
-                            features=VGG16Features(weights='none'))
+    # No split lists on disk -> fraction fallback, with an explicit warning
+    # that this is not the official protocol.
+    with pytest.warns(UserWarning, match='fraction split'):
+        ds = PascalVOCKeypoints(str(voc_root), 'car', train=True,
+                                features=VGG16Features(weights='none'))
     assert len(ds) == 3          # 80% of 4
     g = ds[0]
     assert g.x.shape == (4, 1024)
@@ -143,6 +146,27 @@ def test_voc_parses_and_valid_pairs(voc_root):
     p = pairs[1]
     # Ground truth maps each source node to the target node of equal class.
     assert (p.t.y[p.y_col] == p.s.y).all()
+
+
+def test_voc_official_split_lists(voc_root):
+    # With official VOC ImageSets lists present, the split follows the
+    # lists exactly (train ids in _train.txt; val ids in _val.txt with the
+    # -1 "category absent" rows excluded) and no fallback warning fires.
+    import warnings
+    sets = voc_root / 'ImageSets' / 'Main'
+    sets.mkdir(parents=True)
+    (sets / 'car_train.txt').write_text('2009_0000  1\n2009_0001  1\n')
+    (sets / 'car_val.txt').write_text('2009_0002  1\n2009_0003 -1\n')
+    with warnings.catch_warnings():
+        warnings.simplefilter('error')
+        train = PascalVOCKeypoints(str(voc_root), 'car', train=True,
+                                   features=VGG16Features(weights='none'))
+        val = PascalVOCKeypoints(str(voc_root), 'car', train=False,
+                                 features=VGG16Features(weights='none'))
+    assert len(train) == 2
+    assert {g.name for g in train._graphs} == {'inst_0', 'inst_1'}
+    assert len(val) == 1
+    assert val[0].name == 'inst_2'
 
 
 def test_vgg_random_features_deterministic(willow_root):

@@ -79,6 +79,43 @@ def test_pair_loader_fixed_shapes_and_short_batch():
     assert not batches[-1].y_mask[3:].any()
 
 
+def test_pad_graphs_width_mismatch_raises_on_both_paths():
+    # A graph narrower than feat_dim must raise on the native path (which
+    # would otherwise memcpy out of bounds) exactly like the NumPy path.
+    import pytest
+    from dgmc_tpu.utils.data import pad_graphs
+    good = toy_graph(n=4, c=3)
+    bad = toy_graph(n=4, c=2, seed=1)
+    for native in ('auto', 'never'):
+        with pytest.raises(ValueError):
+            pad_graphs([good, bad], num_nodes=6, num_edges=10, native=native)
+
+
+def test_prefetch_loader_full_iteration_and_abandon():
+    import threading
+    import time
+    from dgmc_tpu.utils import PrefetchLoader
+
+    ds = ListDataset([toy_graph(seed=i) for i in range(6)])
+    pair_ds = PairDataset(ds, ds, sample=True)
+    loader = PairLoader(pair_ds, batch_size=2, shuffle=False)
+
+    # Full iteration yields every batch.
+    batches = list(PrefetchLoader(loader, depth=1))
+    assert len(batches) == len(loader)
+
+    # Abandoning mid-iteration must release the worker thread (it would
+    # otherwise block forever on a full queue).
+    before = threading.active_count()
+    it = iter(PrefetchLoader(loader, depth=1))
+    next(it)
+    it.close()
+    deadline = time.time() + 5.0
+    while threading.active_count() > before and time.time() < deadline:
+        time.sleep(0.05)
+    assert threading.active_count() <= before
+
+
 def test_synthetic_pairs_with_transforms():
     from dgmc_tpu.data import (Compose, Constant, KNNGraph, Cartesian,
                                RandomGraphPairs)
