@@ -1,14 +1,29 @@
-"""Benchmark: flagship DGMC training throughput (pairs/sec) on one chip.
+"""Benchmark: flagship DGMC throughput on one chip, dense AND sparse.
 
-Workload: the pascal_pf-shaped dense matcher (SplineCNN ψ₁/ψ₂, 10 consensus
-steps — the reference's headline keypoint configuration, reference
-``examples/pascal_pf.py:81-83`` / ``examples/pascal.py:46-50``) training on
-synthetic geometric pairs padded to 64 nodes, batch 128. The reference
-publishes no wall-clock numbers (BASELINE.md), so the recorded first-round
-throughput (``BENCH_BASELINE.json``, written on first run) is the baseline
-later rounds must beat; ``vs_baseline`` is the ratio against it.
+Two workloads:
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+1. **Dense keypoint matching** (the primary metric): the pascal_pf-shaped
+   dense matcher (SplineCNN ψ₁/ψ₂, 10 consensus steps — the reference's
+   headline keypoint configuration, reference ``examples/pascal_pf.py:81-83``
+   / ``examples/pascal.py:46-50``) training on synthetic geometric pairs
+   padded to 64 nodes, batch 128. Reported as training pairs/sec.
+2. **DBP15K-scale sparse matching** (the ``sparse_dbp15k`` extras): the
+   sparse top-k matcher at genuine knowledge-graph scale — B=1,
+   N_s=15000, N_t=20000, k=10, RelCNN backbones with the reference's
+   DBP15K dimensions (reference ``examples/dbp15k.py:29-32``), random
+   features — one full training step (ψ₁ + chunked top-k + negatives/GT
+   injection + 10 consensus iterations + backward + Adam), plus the
+   standalone chunked-top-k sweep across block sizes. This is the workload
+   the sparse path and the sharded design exist for; it must fit and run
+   on a single chip.
+
+The reference publishes no wall-clock numbers (BASELINE.md), so the recorded
+first-round numbers (``BENCH_BASELINE.json``, written on first run per
+platform) are the baseline later rounds must beat; ``vs_baseline`` is the
+ratio against them (>1 is better for pairs/sec; for the sparse step the
+ratio is baseline_ms/current_ms so >1 is also better).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", extras...}.
 """
 
 import json
@@ -16,11 +31,19 @@ import os
 import time
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 BASELINE_FILE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                              'BENCH_BASELINE.json')
 
+# Measurement-protocol version: bump when the harness itself changes what is
+# inside the timed region (e.g. v2 moved the batch device-side before the
+# loop), so vs_baseline never credits a measurement change as a speedup —
+# a protocol mismatch reseeds the baseline instead.
+PROTOCOL = 2
+
+# Dense workload shape.
 BATCH = 128
 NUM_NODES = 64
 NUM_EDGES = 512
@@ -28,8 +51,42 @@ NUM_STEPS = 10
 WARMUP = 3
 ITERS = 20
 
+# Sparse workload shape (DBP15K zh_en scale).
+SP_N_S, SP_N_T = 15000, 20000
+SP_E_S, SP_E_T = 100000, 120000
+SP_DIM = 300
+SP_K = 10
+SP_TOPK_BLOCK = 1024
+SP_ITERS = 10
+TOPK_ITERS = 10
 
-def build():
+
+def _best_of(run_window, windows=3):
+    """Minimum wall-clock seconds of ``run_window()`` over several windows.
+
+    The tunneled chip is shared, so effective speed varies with external
+    load; the minimum is the least-contended estimate.
+    """
+    best = float('inf')
+    for _ in range(windows):
+        t0 = time.perf_counter()
+        run_window()
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def _fence(scalar):
+    """Force completion by fetching a scalar to host.
+
+    ``block_until_ready`` is the natural fence, but on the tunneled TPU
+    platform used here it intermittently returns before the computation has
+    actually run, producing absurd timings (sub-ms for a 15k x 20k training
+    step). A device-to-host fetch of one element cannot lie.
+    """
+    return float(scalar)
+
+
+def build_dense():
     from dgmc_tpu.data import (Cartesian, Compose, Constant, KNNGraph,
                                RandomGraphPairs)
     from dgmc_tpu.models import DGMC, SplineCNN
@@ -42,7 +99,7 @@ def build():
                           seed=0)
     loader = PairLoader(ds, BATCH, shuffle=False, num_nodes=NUM_NODES,
                         num_edges=NUM_EDGES)
-    batch = next(iter(loader))
+    batch = jax.device_put(next(iter(loader)))
 
     psi_1 = SplineCNN(1, 256, dim=2, num_layers=2, cat=False, lin=True,
                       dropout=0.0)
@@ -54,46 +111,162 @@ def build():
     return state, step, batch
 
 
-def main():
-    state, step, batch = build()
+def bench_dense():
+    state, step, batch = build_dense()
     key = jax.random.key(1)
 
     for _ in range(WARMUP):
         key, sub = jax.random.split(key)
         state, out = step(state, batch, sub)
-    jax.block_until_ready(out['loss'])
+    _fence(out['loss'])
 
-    t0 = time.perf_counter()
-    for _ in range(ITERS):
+    loss = np.nan
+
+    def window():
+        nonlocal state, key, loss
+        for _ in range(ITERS):
+            key, sub = jax.random.split(key)
+            state, out = step(state, batch, sub)
+        loss = _fence(out['loss'])
+
+    dt = _best_of(window)
+    assert np.isfinite(loss)
+    return BATCH * ITERS / dt
+
+
+def _kg_side(n, e, dim, rng):
+    from dgmc_tpu.ops import GraphBatch
+    return GraphBatch(
+        x=rng.randn(1, n, dim).astype(np.float32),
+        senders=rng.randint(0, n, (1, e)).astype(np.int32),
+        receivers=rng.randint(0, n, (1, e)).astype(np.int32),
+        node_mask=np.ones((1, n), bool),
+        edge_mask=np.ones((1, e), bool),
+        edge_attr=None)
+
+
+def bench_sparse():
+    """One DBP15K-scale sparse training step + the chunked top-k sweep."""
+    from dgmc_tpu.models import DGMC, RelCNN
+    from dgmc_tpu.ops.topk import chunked_topk
+    from dgmc_tpu.train import create_train_state, make_train_step
+    from dgmc_tpu.utils.data import PairBatch
+
+    rng = np.random.RandomState(0)
+    s = _kg_side(SP_N_S, SP_E_S, SP_DIM, rng)
+    t = _kg_side(SP_N_T, SP_E_T, SP_DIM, rng)
+    y = np.full((1, SP_N_S), -1, np.int32)
+    train_n = int(0.3 * SP_N_S)   # the reference's 30% seed alignment split
+    y[0, :train_n] = rng.permutation(SP_N_T)[:train_n]
+    batch = jax.device_put(PairBatch(s=s, t=t, y=y, y_mask=y >= 0))
+    jax.block_until_ready(batch)
+
+    psi_1 = RelCNN(SP_DIM, 256, num_layers=3, dropout=0.5)
+    psi_2 = RelCNN(32, 32, num_layers=3)
+    model = DGMC(psi_1, psi_2, num_steps=NUM_STEPS, k=SP_K,
+                 topk_block=SP_TOPK_BLOCK)
+
+    # Params are independent of graph size: init on a tiny batch to avoid
+    # compiling the init program at 20k-node scale.
+    tiny = PairBatch(s=_kg_side(32, 64, SP_DIM, rng),
+                     t=_kg_side(32, 64, SP_DIM, rng),
+                     y=np.zeros((1, 32), np.int32),
+                     y_mask=np.ones((1, 32), bool))
+    state = create_train_state(model, jax.random.key(0), tiny,
+                               learning_rate=1e-3)
+    step = make_train_step(model, loss_on_s0=False)
+
+    key = jax.random.key(1)
+    for _ in range(2):
         key, sub = jax.random.split(key)
         state, out = step(state, batch, sub)
-    jax.block_until_ready(out['loss'])
-    dt = time.perf_counter() - t0
+    _fence(out['loss'])
 
-    pairs_per_sec = BATCH * ITERS / dt
-    assert np.isfinite(float(out['loss']))
+    loss = np.nan
+
+    def window():
+        nonlocal state, key, loss
+        for _ in range(SP_ITERS):
+            key, sub = jax.random.split(key)
+            state, out = step(state, batch, sub)
+        loss = _fence(out['loss'])
+
+    step_ms = _best_of(window) / SP_ITERS * 1e3
+    assert np.isfinite(loss)
+
+    # Standalone candidate search across block sizes (the KeOps-replacement
+    # sweep; indices are identical across blocks, only the tiling differs).
+    h_s = jnp.asarray(rng.randn(1, SP_N_S, 256).astype(np.float32))
+    h_t = jnp.asarray(rng.randn(1, SP_N_T, 256).astype(np.float32))
+    topk_ms = {}
+    for block in (256, 1024, 4096):
+        f = jax.jit(lambda a, b, blk=block: chunked_topk(a, b, SP_K,
+                                                         block=blk))
+        _fence(f(h_s, h_t)[0, 0, 0])
+
+        def window(f=f):
+            for _ in range(TOPK_ITERS):
+                out = f(h_s, h_t)
+            _fence(out[0, 0, 0])
+
+        topk_ms[str(block)] = round(_best_of(window) / TOPK_ITERS * 1e3, 2)
+
+    stats = jax.local_devices()[0].memory_stats() or {}
+    peak = stats.get('peak_bytes_in_use')
+    return {
+        'shape': f'{SP_N_S}x{SP_N_T} k={SP_K} steps={NUM_STEPS}',
+        'step_ms': round(step_ms, 1),
+        'topk_ms': topk_ms,
+        'peak_hbm_gib': (round(peak / 2**30, 2) if peak else None),
+    }
+
+
+def main():
+    # Sparse first: the allocator's peak_bytes_in_use is process-lifetime,
+    # so the sparse leg must run before anything else allocates if its
+    # reported peak is to be attributable to the DBP15K workload.
+    try:
+        sparse = bench_sparse()
+    except Exception as e:  # never let the sparse leg kill the primary line
+        sparse = {'error': f'{type(e).__name__}: {e}'}
+    pairs_per_sec = bench_dense()
 
     platform = str(jax.devices()[0].platform)
-    baseline = None
+    stored = {}
     if os.path.exists(BASELINE_FILE):
         with open(BASELINE_FILE) as f:
             stored = json.load(f)
-        # A baseline recorded on another platform (e.g. CPU smoke run) would
-        # make vs_baseline meaningless — re-seed it instead.
-        if stored.get('device') == platform:
-            baseline = stored['value']
+        # A baseline recorded on another platform (e.g. CPU smoke run) or
+        # under a different measurement protocol would make vs_baseline
+        # meaningless — re-seed it instead.
+        if (stored.get('device') != platform or
+                stored.get('protocol') != PROTOCOL):
+            stored = {}
+
+    baseline = stored.get('value')
+    sparse_baseline_ms = stored.get('sparse_step_ms')
+    reseed = not stored
     if baseline is None:
         baseline = pairs_per_sec
+        reseed = True
+    if sparse_baseline_ms is None and 'step_ms' in sparse:
+        sparse_baseline_ms = sparse['step_ms']
+        reseed = True
+    if reseed:
         with open(BASELINE_FILE, 'w') as f:
-            json.dump({'metric': 'train_pairs_per_sec',
-                       'value': pairs_per_sec,
-                       'device': platform}, f)
+            json.dump({'metric': 'train_pairs_per_sec', 'value': baseline,
+                       'sparse_step_ms': sparse_baseline_ms,
+                       'device': platform, 'protocol': PROTOCOL}, f)
 
+    if 'step_ms' in sparse and sparse_baseline_ms:
+        sparse['vs_baseline'] = round(sparse_baseline_ms / sparse['step_ms'],
+                                      4)
     print(json.dumps({
         'metric': 'train_pairs_per_sec',
         'value': round(pairs_per_sec, 2),
         'unit': 'pairs/sec',
         'vs_baseline': round(pairs_per_sec / baseline, 4),
+        'sparse_dbp15k': sparse,
     }))
 
 
