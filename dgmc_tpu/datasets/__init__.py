@@ -3,8 +3,10 @@ from dgmc_tpu.datasets.pascal_pf import PascalPF
 from dgmc_tpu.datasets.willow import WILLOWObjectClass
 from dgmc_tpu.datasets.pascal_voc import PascalVOCKeypoints
 from dgmc_tpu.datasets.features import VGG16Features
+from dgmc_tpu.datasets.convert_vgg import convert_checkpoint
 
 __all__ = [
+    'convert_checkpoint',
     'DBP15K',
     'PascalPF',
     'WILLOWObjectClass',

@@ -1,0 +1,87 @@
+"""Convert a torchvision VGG16 checkpoint to the ``.npz`` weight layout
+consumed by :class:`dgmc_tpu.datasets.VGG16Features`.
+
+The reference's keypoint workloads take node features from torchvision's
+*pretrained* VGG16 (consumed via the PyG datasets at reference
+``examples/pascal.py:5`` and ``examples/willow.py:7-8``). This sandbox has
+no network access, so the pretrained weights cannot ship in-tree; this
+converter is the documented parity pipeline: download
+``vgg16-397923af.pth`` (the torchvision VGG16 checkpoint) on any machine,
+run::
+
+    dgmc-convert-vgg16 vgg16-397923af.pth vgg16.npz
+    python examples/pascal.py --vgg_weights vgg16.npz
+
+Only the 13 convolutional layers are kept (the classifier head is unused —
+the extractor taps relu4_2/relu5_1, ``features.py``). Weights stay in the
+torch ``[out, in, kh, kw]`` layout under the torchvision key names
+(``features.<i>.weight`` / ``.bias``); ``VGG16Features`` transposes to the
+HWIO layout XLA wants at load time.
+"""
+
+import argparse
+
+import numpy as np
+
+# torchvision VGG16 `features` indices of the 13 conv layers (the gaps are
+# ReLU/MaxPool entries of the nn.Sequential).
+CONV_INDICES = (0, 2, 5, 7, 10, 12, 14, 17, 19, 21, 24, 26, 28)
+# Per-conv (out_channels, in_channels) for shape validation, derived from
+# the VGG16 configuration (features.VGG_CFG).
+CONV_SHAPES = (
+    (64, 3), (64, 64), (128, 64), (128, 128), (256, 128), (256, 256),
+    (256, 256), (512, 256), (512, 512), (512, 512), (512, 512), (512, 512),
+    (512, 512),
+)
+
+
+def convert_state_dict(state_dict):
+    """Torchvision VGG16 state dict (or any mapping of array-likes with
+    ``features.<i>.weight/.bias`` keys) -> dict of float32 numpy arrays in
+    the documented npz layout. Validates that all 13 conv layers are
+    present with VGG16 shapes."""
+    out = {}
+    for idx, (c_out, c_in) in zip(CONV_INDICES, CONV_SHAPES):
+        for suffix, want in ((f'features.{idx}.weight', (c_out, c_in, 3, 3)),
+                             (f'features.{idx}.bias', (c_out,))):
+            if suffix not in state_dict:
+                raise KeyError(
+                    f'missing {suffix!r}: not a torchvision VGG16 '
+                    f'checkpoint (13 conv layers expected)')
+            arr = np.asarray(state_dict[suffix], dtype=np.float32)
+            if arr.shape != want:
+                raise ValueError(
+                    f'{suffix}: shape {arr.shape} != VGG16 {want}')
+            out[suffix] = arr
+    return out
+
+
+def convert_checkpoint(src_path, out_path):
+    """Load a ``.pth`` torchvision checkpoint (or an ``.npz`` mapping with
+    the same keys) and write the converted ``.npz``. Returns the output
+    path."""
+    if src_path.endswith('.npz'):
+        raw = dict(np.load(src_path))
+    else:
+        import torch
+        obj = torch.load(src_path, map_location='cpu', weights_only=True)
+        if hasattr(obj, 'state_dict'):
+            obj = obj.state_dict()
+        raw = {k: v.numpy() for k, v in obj.items()
+               if hasattr(v, 'numpy')}
+    np.savez(out_path, **convert_state_dict(raw))
+    return out_path
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        description='torchvision VGG16 checkpoint -> dgmc_tpu .npz weights')
+    parser.add_argument('src', help='vgg16-*.pth (torchvision state dict)')
+    parser.add_argument('out', help='output .npz path')
+    args = parser.parse_args(argv)
+    convert_checkpoint(args.src, args.out)
+    print(f'wrote {args.out}')
+
+
+if __name__ == '__main__':
+    main()

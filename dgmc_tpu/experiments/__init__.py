@@ -1,0 +1,20 @@
+"""The four experiment workloads (SURVEY.md §2.2), installable with console
+entry points (``dgmc-dbp15k``, ``dgmc-pascal``, ``dgmc-willow``,
+``dgmc-pascal-pf``) — capability parity with the reference's ``examples/``
+scripts (reference ``examples/{dbp15k,pascal,willow,pascal_pf}.py``).
+
+Each module exposes ``parse_args(argv)`` and ``main(argv=None)``; the
+repo-root ``examples/`` directory keeps thin launchers for the reference's
+``python examples/<name>.py`` invocation style. Workload modules are loaded
+lazily so each console script pays only its own import cost.
+"""
+
+import importlib
+
+__all__ = ['dbp15k', 'pascal', 'pascal_pf', 'willow']
+
+
+def __getattr__(name):
+    if name in __all__:
+        return importlib.import_module(f'{__name__}.{name}')
+    raise AttributeError(f'module {__name__!r} has no attribute {name!r}')

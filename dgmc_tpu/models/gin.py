@@ -23,7 +23,7 @@ from dgmc_tpu.ops.graph import gather_nodes, scatter_to_nodes
 
 
 class GINConv(nn.Module):
-    """``h_i' = MLP((1 + eps) * h_i + sum_{j -> i} h_j)`` with learnable eps."""
+    """``h_i' = MLP((1+eps) * h_i + sum_{j -> i} h_j)``, learnable eps."""
     mlp: nn.Module
 
     @nn.compact

@@ -15,9 +15,10 @@ import jax.numpy as jnp
 
 
 def segment_sum(data, segment_ids, num_segments):
-    """Sum ``data`` rows into ``num_segments`` buckets given by ``segment_ids``.
+    """Sum ``data`` rows into ``num_segments`` buckets per ``segment_ids``.
 
-    data: ``[E, ...]``, segment_ids: ``[E]`` int32. Returns ``[num_segments, ...]``.
+    data: ``[E, ...]``, segment_ids: ``[E]`` int32; returns
+    ``[num_segments, ...]``.
     """
     return jax.ops.segment_sum(data, segment_ids, num_segments=num_segments)
 

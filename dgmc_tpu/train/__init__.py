@@ -2,8 +2,9 @@ from dgmc_tpu.train.state import (TrainState, create_train_state,
                                   init_variables)
 from dgmc_tpu.train.steps import (make_train_step, make_eval_step,
                                   aggregate_eval)
-from dgmc_tpu.train.checkpoint import (Checkpointer, snapshot_params,
-                                       restore_params)
+from dgmc_tpu.train.checkpoint import (Checkpointer, resume_or_init,
+                                       snapshot_params, restore_params)
+from dgmc_tpu.train.observe import MetricLogger, StepTimer, trace
 
 __all__ = [
     'TrainState',
@@ -13,6 +14,10 @@ __all__ = [
     'make_eval_step',
     'aggregate_eval',
     'Checkpointer',
+    'resume_or_init',
     'snapshot_params',
     'restore_params',
+    'MetricLogger',
+    'StepTimer',
+    'trace',
 ]

@@ -56,6 +56,25 @@ class Checkpointer:
         self._mgr.close()
 
 
+def resume_or_init(ckpt_dir, state):
+    """Shared workload resume glue: open a :class:`Checkpointer` under
+    ``ckpt_dir`` (``None`` -> no checkpointing) and restore the latest saved
+    state if one exists.
+
+    Returns ``(ckpt, state, start_epoch)`` where ``start_epoch`` is the
+    first epoch still to run (1 for a fresh start).
+    """
+    if not ckpt_dir:
+        return None, state, 1
+    ckpt = Checkpointer(ckpt_dir)
+    latest = ckpt.latest_step()
+    if latest is None:
+        return ckpt, state, 1
+    state = ckpt.restore(state, latest)
+    print(f'Resumed from {ckpt.directory} at epoch {latest}.')
+    return ckpt, state, latest + 1
+
+
 def snapshot_params(state):
     """In-memory parameter snapshot (the reference's ``deepcopy(state_dict)``
     at ``examples/willow.py:90``). Buffers are copied, not aliased: the

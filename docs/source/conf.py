@@ -1,0 +1,28 @@
+"""Sphinx configuration — counterpart of the reference's docs build
+(reference ``docs/source/conf.py``; CI hook at ``.travis.yml:37-38``)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.abspath(os.path.join('..', '..')))
+
+import dgmc_tpu  # noqa: E402
+
+project = 'dgmc_tpu'
+author = 'dgmc_tpu developers'
+release = dgmc_tpu.__version__
+
+extensions = [
+    'sphinx.ext.autodoc',
+    'sphinx.ext.napoleon',
+    'sphinx.ext.viewcode',
+]
+
+autodoc_member_order = 'bysource'
+# jax/flax/optax/orbax are heavyweight; docs build imports the real ones
+# when available (CI installs the package), and these mocks keep the build
+# alive in minimal environments.
+autodoc_mock_imports = []
+
+html_theme = 'alabaster'
+exclude_patterns = []

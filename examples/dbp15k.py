@@ -1,123 +1,19 @@
-"""DBP15K cross-lingual entity alignment.
+"""Launcher for the DBP15K workload (reference
+``examples/dbp15k.py``).
 
-Capability parity with reference ``examples/dbp15k.py``: RelCNN ψ₁/ψ₂,
-sparse top-k=10 correspondences with ground-truth injection, two-phase
-schedule — 100 epochs of feature matching only (``num_steps=0``) then 100
-epochs of consensus refinement with ψ₁ detached — expressed here as explicit
-per-phase train steps instead of module-attribute mutation (reference
-``dbp15k.py:63-69``). Metrics: Hits@1 and Hits@10 on the test alignments.
-
-Optionally shards the correspondence activations over all available chips
-(``--model_shards N``) — the scale-out axis the reference lacks.
-
-Run: ``python examples/dbp15k.py --category zh_en [--data_root ../data/DBP15K]``
+The implementation lives in :mod:`dgmc_tpu.experiments.dbp15k`; after
+``pip install -e .`` it is also available as the ``dgmc-dbp15k`` console
+script. The repo root is put first on ``sys.path`` so the checkout always
+wins over any stale installed copy.
 """
 
-import argparse
 import os
 import sys
-import time
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-import jax
-import numpy as np
-
-from dgmc_tpu.models import DGMC, RelCNN
-from dgmc_tpu.train import create_train_state, make_train_step, make_eval_step
-from dgmc_tpu.utils.data import GraphPair, pad_pair_batch
-
-
-def parse_args(argv=None):
-    parser = argparse.ArgumentParser()
-    parser.add_argument('--category', type=str, required=True,
-                        choices=['zh_en', 'ja_en', 'fr_en'])
-    parser.add_argument('--dim', type=int, default=256)
-    parser.add_argument('--rnd_dim', type=int, default=32)
-    parser.add_argument('--num_layers', type=int, default=3)
-    parser.add_argument('--num_steps', type=int, default=10)
-    parser.add_argument('--k', type=int, default=10)
-    parser.add_argument('--lr', type=float, default=0.001)
-    parser.add_argument('--epochs', type=int, default=200)
-    parser.add_argument('--phase1_epochs', type=int, default=100)
-    parser.add_argument('--model_shards', type=int, default=0,
-                        help='shard correspondence rows over N devices '
-                             '(0 = no sharding)')
-    parser.add_argument('--data_root', type=str,
-                        default=os.path.join('..', 'data', 'DBP15K'))
-    parser.add_argument('--seed', type=int, default=0)
-    return parser.parse_args(argv)
-
-
-def load_batches(args):
-    """One full-graph pair batch (B=1) with train GT, plus the test GT."""
-    from dgmc_tpu.datasets import DBP15K
-    data = DBP15K(args.data_root, args.category)
-    g1, g2 = data.graphs(sum_embedding=True)
-
-    n1, n2 = g1.num_nodes, g2.num_nodes
-    y_train = np.full(n1, -1, np.int64)
-    y_train[data.train_y[0]] = data.train_y[1]
-    y_test = np.full(n1, -1, np.int64)
-    y_test[data.test_y[0]] = data.test_y[1]
-
-    def batch(y_col):
-        return pad_pair_batch([GraphPair(s=g1, t=g2, y_col=y_col)],
-                              num_nodes_s=n1, num_edges_s=g1.num_edges,
-                              num_nodes_t=n2, num_edges_t=g2.num_edges)
-
-    return batch(y_train), batch(y_test), g1.x.shape[1]
-
-
-def main(argv=None):
-    args = parse_args(argv)
-    train_batch, test_batch, in_dim = load_batches(args)
-
-    corr_sharding = None
-    if args.model_shards > 1:
-        from dgmc_tpu.parallel import corr_sharding as mk_corr, make_mesh
-        mesh = make_mesh(data=1, model=args.model_shards,
-                         devices=jax.devices()[:args.model_shards])
-        corr_sharding = mk_corr(mesh)
-
-    psi_1 = RelCNN(in_dim, args.dim, args.num_layers, batch_norm=False,
-                   cat=True, lin=True, dropout=0.5)
-    psi_2 = RelCNN(args.rnd_dim, args.rnd_dim, args.num_layers,
-                   batch_norm=False, cat=True, lin=True, dropout=0.0)
-    model = DGMC(psi_1, psi_2, num_steps=args.num_steps, k=args.k,
-                 corr_sharding=corr_sharding)
-
-    state = create_train_state(model, jax.random.key(args.seed), train_batch,
-                               learning_rate=args.lr)
-    # Phase 1: feature matching only. Phase 2: refinement with psi_1 frozen
-    # by stop_gradient — the reference's detach=True (dbp15k.py:67-68).
-    phase1 = make_train_step(model, num_steps=0)
-    phase2 = make_train_step(model, num_steps=args.num_steps, detach=True)
-    eval1 = make_eval_step(model, hits_ks=(10,), num_steps=0)
-    eval2 = make_eval_step(model, hits_ks=(10,), num_steps=args.num_steps)
-
-    print('Optimize initial feature matching...')
-    key = jax.random.key(args.seed + 1)
-    for epoch in range(1, args.epochs + 1):
-        refine = epoch > args.phase1_epochs
-        if epoch == args.phase1_epochs + 1:
-            print('Refine correspondence matrix...')
-        step = phase2 if refine else phase1
-        key, sub = jax.random.split(key)
-        t0 = time.time()
-        state, out = step(state, train_batch, sub)
-        loss = float(out['loss'])
-
-        if epoch % 10 == 0 or refine:
-            key, sub = jax.random.split(key)
-            ev = (eval2 if refine else eval1)(state, test_batch, sub)
-            n = max(float(ev['count']), 1.0)
-            print(f'{epoch:03d}: Loss: {loss:.4f}, '
-                  f'Hits@1: {float(ev["correct"]) / n:.4f}, '
-                  f'Hits@10: {float(ev["hits@10"]) / n:.4f} '
-                  f'({time.time() - t0:.1f}s)')
-    return state
-
+from dgmc_tpu.experiments.dbp15k import main, parse_args  # noqa: E402,F401
 
 if __name__ == '__main__':
     main()

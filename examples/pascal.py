@@ -1,131 +1,19 @@
-"""PascalVOC + Berkeley keypoint matching across 20 categories.
+"""Launcher for the PascalVOC keypoint workload (reference
+``examples/pascal.py``).
 
-Capability parity with reference ``examples/pascal.py``: SplineCNN ψ₁/ψ₂
-over Delaunay graphs with Cartesian (or Distance, ``--isotropic``) edge
-pseudo-coordinates; ``ValidPairDataset(sample=True)`` per category
-concatenated into one loader; loss on both ``S_0`` and ``S_L``; per-category
-eval sampling until ``--test_samples`` correspondences are seen
-(reference ``pascal.py:84-99``).
-
-Run: ``python examples/pascal.py [--data_root ../data/PascalVOC]``
+The implementation lives in :mod:`dgmc_tpu.experiments.pascal`; after
+``pip install -e .`` it is also available as the ``dgmc-pascal`` console
+script. The repo root is put first on ``sys.path`` so the checkout always
+wins over any stale installed copy.
 """
 
-import argparse
 import os
 import sys
-import time
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-import jax
-
-from dgmc_tpu.data import Cartesian, Compose, Delaunay, Distance, FaceToEdge
-from dgmc_tpu.models import DGMC, SplineCNN
-from dgmc_tpu.train import (create_train_state, make_train_step,
-                            make_eval_step)
-from dgmc_tpu.utils import (ConcatDataset, PairLoader, ValidPairDataset,
-                            graph_limits)
-
-
-def parse_args(argv=None):
-    parser = argparse.ArgumentParser()
-    parser.add_argument('--isotropic', action='store_true')
-    parser.add_argument('--dim', type=int, default=256)
-    parser.add_argument('--rnd_dim', type=int, default=128)
-    parser.add_argument('--num_layers', type=int, default=2)
-    parser.add_argument('--num_steps', type=int, default=10)
-    parser.add_argument('--lr', type=float, default=0.001)
-    parser.add_argument('--batch_size', type=int, default=512)
-    parser.add_argument('--epochs', type=int, default=15)
-    parser.add_argument('--test_samples', type=int, default=1000)
-    parser.add_argument('--data_root', type=str,
-                        default=os.path.join('..', 'data', 'PascalVOC'))
-    parser.add_argument('--vgg_weights', type=str, default='random',
-                        help="'random', 'none', or path to converted .npz")
-    parser.add_argument('--seed', type=int, default=0)
-    return parser.parse_args(argv)
-
-
-def main(argv=None):
-    args = parse_args(argv)
-    from dgmc_tpu.datasets import PascalVOCKeypoints, VGG16Features
-    from dgmc_tpu.datasets.pascal_voc import CATEGORIES
-
-    transform = Compose([
-        Delaunay(), FaceToEdge(),
-        Distance() if args.isotropic else Cartesian()])
-    features = VGG16Features(weights=args.vgg_weights)
-    pre_filter = lambda g: g.num_nodes > 0  # noqa: E731
-
-    train_sets, test_sets = [], []
-    for category in CATEGORIES:
-        tr = PascalVOCKeypoints(args.data_root, category, train=True,
-                                transform=transform, pre_filter=pre_filter,
-                                features=features)
-        te = PascalVOCKeypoints(args.data_root, category, train=False,
-                                transform=transform, pre_filter=pre_filter,
-                                features=features)
-        train_sets.append(ValidPairDataset(tr, tr, sample=True,
-                                           seed=args.seed))
-        test_sets.append(ValidPairDataset(te, te, sample=True,
-                                          seed=args.seed + 1))
-    num_nodes, num_edges = graph_limits([s.dataset_s for s in train_sets] +
-                                        [s.dataset_s for s in test_sets])
-    in_dim = train_sets[0].dataset_s.num_node_features
-    edge_dim = 1 if args.isotropic else 2
-
-    train_loader = PairLoader(ConcatDataset(train_sets), args.batch_size,
-                              shuffle=True, seed=args.seed,
-                              num_nodes=num_nodes, num_edges=num_edges)
-
-    psi_1 = SplineCNN(in_dim, args.dim, edge_dim, args.num_layers,
-                      cat=False, dropout=0.5)
-    psi_2 = SplineCNN(args.rnd_dim, args.rnd_dim, edge_dim, args.num_layers,
-                      cat=True, dropout=0.0)
-    model = DGMC(psi_1, psi_2, num_steps=args.num_steps)
-
-    batch0 = next(iter(train_loader))
-    state = create_train_state(model, jax.random.key(args.seed), batch0,
-                               learning_rate=args.lr)
-    step = make_train_step(model, loss_on_s0=True)
-    eval_step = make_eval_step(model)
-
-    key = jax.random.key(args.seed + 2)
-
-    def test(pairs):
-        nonlocal key
-        loader = PairLoader(pairs, args.batch_size, shuffle=False,
-                            num_nodes=num_nodes, num_edges=num_edges)
-        correct = n = 0.0
-        while n < args.test_samples:
-            seen = n
-            for batch in loader:
-                key, sub = jax.random.split(key)
-                out = eval_step(state, batch, sub)
-                correct += float(out['correct'])
-                n += float(out['count'])
-                if n >= args.test_samples:
-                    return correct / n
-            if n == seen:  # empty split / no valid GT: avoid spinning
-                break
-        return correct / max(n, 1)
-
-    for epoch in range(1, args.epochs + 1):
-        t0 = time.time()
-        total = 0.0
-        for batch in train_loader:
-            key, sub = jax.random.split(key)
-            state, out = step(state, batch, sub)
-            total += float(out['loss'])
-        print(f'Epoch: {epoch:02d}, Loss: {total / len(train_loader):.4f}, '
-              f'{time.time() - t0:.1f}s')
-
-        accs = [100 * test(ds) for ds in test_sets]
-        accs.append(sum(accs) / len(accs))
-        print(' '.join(c[:5].ljust(5) for c in CATEGORIES) + ' mean')
-        print(' '.join(f'{a:.1f}'.ljust(5) for a in accs))
-    return state
-
+from dgmc_tpu.experiments.pascal import main, parse_args  # noqa: E402,F401
 
 if __name__ == '__main__':
     main()

@@ -1,123 +1,19 @@
-"""PascalPF geometric matching: train on synthetic pairs, test zero-shot.
+"""Launcher for the PascalPF zero-shot workload (reference
+``examples/pascal_pf.py``).
 
-Capability parity with reference ``examples/pascal_pf.py``: SplineCNN ψ₁/ψ₂
-over KNN(8) graphs with Cartesian pseudo-coordinates, trained purely on
-random point-cloud pairs (30-60 inliers, 0-20 outliers, σ=0.05 jitter) and
-evaluated zero-shot on real PascalPF pairs per category. Flag surface
-matches the reference parser (``pascal_pf.py:12-20``).
-
-Run: ``python examples/pascal_pf.py [--data_root ../data/PascalPF]``
-(the real-data eval is skipped with a notice when the dataset is absent —
-this environment does not download datasets).
+The implementation lives in :mod:`dgmc_tpu.experiments.pascal_pf`; after
+``pip install -e .`` it is also available as the ``dgmc-pascal-pf`` console
+script. The repo root is put first on ``sys.path`` so the checkout always
+wins over any stale installed copy.
 """
 
-import argparse
 import os
 import sys
-import time
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-import jax
-import numpy as np
-
-from dgmc_tpu.data import (Cartesian, Compose, Constant, KNNGraph,
-                           RandomGraphPairs)
-from dgmc_tpu.models import DGMC, SplineCNN, metrics
-from dgmc_tpu.utils import PairLoader, pad_pair_batch
-from dgmc_tpu.utils.data import GraphPair
-from dgmc_tpu.train import create_train_state, make_train_step
-
-
-def parse_args(argv=None):
-    parser = argparse.ArgumentParser()
-    parser.add_argument('--dim', type=int, default=256)
-    parser.add_argument('--rnd_dim', type=int, default=64)
-    parser.add_argument('--num_layers', type=int, default=2)
-    parser.add_argument('--num_steps', type=int, default=10)
-    parser.add_argument('--lr', type=float, default=0.001)
-    parser.add_argument('--batch_size', type=int, default=64)
-    parser.add_argument('--epochs', type=int, default=32)
-    parser.add_argument('--data_root', type=str,
-                        default=os.path.join('..', 'data', 'PascalPF'))
-    parser.add_argument('--seed', type=int, default=0)
-    return parser.parse_args(argv)
-
-
-def build(args):
-    transform = Compose([Constant(), KNNGraph(k=8), Cartesian()])
-    train_dataset = RandomGraphPairs(30, 60, 0, 20, transform=transform,
-                                     seed=args.seed)
-    train_loader = PairLoader(train_dataset, args.batch_size, shuffle=True,
-                              seed=args.seed, num_nodes=80, num_edges=640)
-
-    psi_1 = SplineCNN(1, args.dim, 2, args.num_layers, cat=False,
-                      dropout=0.0)
-    psi_2 = SplineCNN(args.rnd_dim, args.rnd_dim, 2, args.num_layers,
-                      cat=True, dropout=0.0)
-    model = DGMC(psi_1, psi_2, num_steps=args.num_steps)
-    return model, train_loader, transform
-
-
-def main(argv=None):
-    args = parse_args(argv)
-    model, train_loader, transform = build(args)
-
-    batch0 = next(iter(train_loader))
-    state = create_train_state(model, jax.random.key(args.seed), batch0,
-                               learning_rate=args.lr)
-    # Reference trains on loss(S_0) + loss(S_L) when refining
-    # (pascal_pf.py:102-103).
-    step = make_train_step(model, loss_on_s0=True)
-    eval_fn = jax.jit(lambda s, b, k: model.apply(
-        {'params': s.params}, b.s, b.t, train=False, rngs={'noise': k}))
-
-    try:
-        from dgmc_tpu.datasets import PascalPF
-        from dgmc_tpu.datasets.pascal_pf import CATEGORIES
-        test_datasets = [PascalPF(args.data_root, c, transform)
-                         for c in CATEGORIES]
-    except FileNotFoundError as e:
-        print(f'[pascal_pf] real-data eval disabled: {e}')
-        test_datasets = []
-
-    key = jax.random.key(args.seed + 1)
-    for epoch in range(1, args.epochs + 1):
-        train_loader.dataset.set_epoch(epoch)
-        t0 = time.time()
-        tot_loss = tot_correct = tot_n = 0.0
-        for batch in train_loader:
-            key, sub = jax.random.split(key)
-            state, out = step(state, batch, sub)
-            tot_loss += float(out['loss'])
-            tot_correct += float(out['acc']) * float(batch.y_mask.sum())
-            tot_n += float(batch.y_mask.sum())
-        print(f'Epoch: {epoch:02d}, Loss: {tot_loss / len(train_loader):.4f},'
-              f' Acc: {tot_correct / max(tot_n, 1):.2f},'
-              f' {time.time() - t0:.1f}s')
-
-        if test_datasets:
-            accs = []
-            for ds in test_datasets:
-                correct = n = 0.0
-                # One static shape per category: pad every pair to the
-                # category max so eval compiles once per category.
-                n_pad = max(g.pos.shape[0] for g in ds.items.values())
-                e_pad = 8 * n_pad
-                for i, (g_s, g_t, y) in enumerate(ds.pair_graphs()):
-                    pair = GraphPair(s=g_s, t=g_t, y_col=y)
-                    b = pad_pair_batch([pair], n_pad, e_pad)
-                    key, sub = jax.random.split(key)
-                    _, S_L = eval_fn(state, b, sub)
-                    correct += float(metrics.acc(S_L, b.y, b.y_mask,
-                                                 reduction='sum'))
-                    n += float(b.y_mask.sum())
-                accs.append(100 * correct / max(n, 1))
-            accs.append(sum(accs) / len(accs))
-            print(' '.join(c[:5].ljust(5) for c in CATEGORIES) + ' mean')
-            print(' '.join(f'{a:.1f}'.ljust(5) for a in accs))
-    return state
-
+from dgmc_tpu.experiments.pascal_pf import main, parse_args  # noqa: E402,F401
 
 if __name__ == '__main__':
     main()

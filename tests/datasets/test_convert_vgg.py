@@ -1,0 +1,114 @@
+"""VGG16 weight conversion: torchvision checkpoint -> npz -> extractor
+activation parity against an independent torch forward of the same weights.
+"""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip('torch')  # CI's [test] extra has no torch
+import torch.nn.functional as F  # noqa: E402
+
+from dgmc_tpu.datasets import VGG16Features, convert_checkpoint
+from dgmc_tpu.datasets.convert_vgg import (CONV_INDICES, CONV_SHAPES,
+                                           convert_state_dict)
+from dgmc_tpu.datasets.features import (IMAGENET_MEAN, IMAGENET_STD,
+                                        TAP_RELU4_2, TAP_RELU5_1, VGG_CFG)
+
+
+def synthetic_state_dict(seed=0):
+    """A torchvision-VGG16-shaped state dict with small random weights
+    (plus classifier entries the converter must ignore)."""
+    rng = np.random.RandomState(seed)
+    sd = {}
+    for idx, (c_out, c_in) in zip(CONV_INDICES, CONV_SHAPES):
+        sd[f'features.{idx}.weight'] = torch.tensor(
+            (rng.randn(c_out, c_in, 3, 3)
+             * np.sqrt(2.0 / (9 * c_in))).astype(np.float32))
+        sd[f'features.{idx}.bias'] = torch.tensor(
+            (rng.randn(c_out) * 0.01).astype(np.float32))
+    sd['classifier.0.weight'] = torch.zeros(8, 8)
+    return sd
+
+
+def torch_taps(sd, img01):
+    """Independent torch forward of the conv stack: img01 [H, W, 3] in
+    [0, 1] -> (relu4_2, relu5_1) activation maps [h, w, C]."""
+    x = (img01 - IMAGENET_MEAN) / IMAGENET_STD
+    x = torch.tensor(x.transpose(2, 0, 1)[None])
+    taps, ci = [], 0
+    for c in VGG_CFG:
+        if c == 'M':
+            x = F.max_pool2d(x, 2)
+            continue
+        idx = CONV_INDICES[ci]
+        x = F.relu(F.conv2d(x, sd[f'features.{idx}.weight'],
+                            sd[f'features.{idx}.bias'], padding=1))
+        if ci in (TAP_RELU4_2, TAP_RELU5_1):
+            taps.append(x[0].numpy().transpose(1, 2, 0))
+        if ci == TAP_RELU5_1:
+            break
+        ci += 1
+    return taps
+
+
+def bilinear(fmap, coords01):
+    """The extractor's sampling formula, independently in numpy."""
+    h, w = fmap.shape[:2]
+    xf = coords01[:, 0] * (w - 1)
+    yf = coords01[:, 1] * (h - 1)
+    x0 = np.clip(np.floor(xf).astype(int), 0, w - 2)
+    y0 = np.clip(np.floor(yf).astype(int), 0, h - 2)
+    dx = (xf - x0)[:, None]
+    dy = (yf - y0)[:, None]
+    return ((1 - dy) * ((1 - dx) * fmap[y0, x0] + dx * fmap[y0, x0 + 1]) +
+            dy * ((1 - dx) * fmap[y0 + 1, x0] + dx * fmap[y0 + 1, x0 + 1]))
+
+
+def test_convert_and_activation_parity(tmp_path):
+    sd = synthetic_state_dict()
+    src = tmp_path / 'vgg16.pth'
+    torch.save(sd, str(src))
+    out = convert_checkpoint(str(src), str(tmp_path / 'vgg16.npz'))
+
+    npz = np.load(out)
+    assert len(npz.files) == 26  # 13 convs x (weight, bias), head dropped
+    np.testing.assert_array_equal(npz['features.0.weight'],
+                                  sd['features.0.weight'].numpy())
+
+    rng = np.random.RandomState(1)
+    image = rng.randint(0, 255, (48, 64, 3)).astype(np.uint8)
+    kps = np.array([[5.0, 7.0], [40.0, 30.0], [63.0, 47.0]], np.float32)
+
+    extractor = VGG16Features(weights=out, input_size=64)
+    got = extractor(image, kps)
+    assert got.shape == (3, 1024)
+
+    # Expected: PIL resize to 64x64 (as the extractor does), torch convs,
+    # numpy bilinear taps.
+    from PIL import Image
+    img01 = np.asarray(
+        Image.fromarray(image).resize((64, 64)), np.float32) / 255.0
+    t4, t5 = torch_taps(sd, img01)
+    coords = kps / np.array([63.0, 47.0], np.float32)
+    want = np.concatenate([bilinear(t4, coords), bilinear(t5, coords)], -1)
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+
+def test_convert_rejects_non_vgg(tmp_path):
+    sd = synthetic_state_dict()
+    del sd['features.28.bias']
+    with pytest.raises(KeyError, match='features.28.bias'):
+        convert_state_dict(sd)
+
+    sd = synthetic_state_dict()
+    sd['features.0.weight'] = torch.zeros(64, 3, 5, 5)
+    with pytest.raises(ValueError, match='shape'):
+        convert_state_dict(sd)
+
+
+def test_convert_cli(tmp_path):
+    from dgmc_tpu.datasets import convert_vgg
+    src = tmp_path / 'vgg16.pth'
+    torch.save(synthetic_state_dict(), str(src))
+    convert_vgg.main([str(src), str(tmp_path / 'out.npz')])
+    assert VGG16Features(weights=str(tmp_path / 'out.npz')).tag == 'out'

@@ -1,0 +1,183 @@
+"""Golden-value quality gates (SURVEY.md §4 plan; VERDICT round-1 item 5).
+
+Two guarantees the finiteness/shape smoke tests cannot give:
+
+1. **Hand-computed consensus iteration** — with trivial injected backbones
+   (identity ψ₁, a degree-colouring ψ₂ that ignores its input, hand-set
+   consensus-MLP parameters), one dense consensus step has a closed-form
+   numpy value. Any rewiring of the update (softmax → project → ψ₂ → D →
+   MLP → additive logit update → softmax; reference
+   ``dgmc/models/dgmc.py:167-179``) changes these numbers.
+
+2. **Matching-quality floor** — the pascal_pf-style synthetic protocol
+   (train on random geometric pairs, evaluate on unseen pairs; reference
+   ``examples/pascal_pf.py:115-123``) must reach a recorded Hits@1
+   threshold in a fixed training budget. Fails if matching quality (not
+   just plumbing) regresses.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+
+from dgmc_tpu.models import DGMC
+from dgmc_tpu.ops import GraphBatch
+
+
+class IdentityPsi1(nn.Module):
+    """ψ₁ that emits the node features unchanged."""
+    in_channels: int
+    out_channels: int
+
+    @nn.compact
+    def __call__(self, x, graph, train=False):
+        return x
+
+
+class DegreePsi2(nn.Module):
+    """ψ₂ that ignores its input and colours node ``i`` with its in-degree,
+    broadcast over ``out_channels`` — a fixed, hand-computable colouring, so
+    the consensus update is deterministic (the random indicator functions
+    cancel out of the expectation entirely)."""
+    in_channels: int
+    out_channels: int
+
+    @nn.compact
+    def __call__(self, x, graph, train=False):
+        ones = jnp.where(graph.edge_mask, 1.0, 0.0)
+        deg = jax.vmap(
+            lambda w, r: jax.ops.segment_sum(
+                w, r, num_segments=graph.node_mask.shape[1]))(
+                    ones, graph.receivers)
+        return jnp.broadcast_to(deg[..., None],
+                                deg.shape + (self.out_channels,))
+
+
+def line_graph(n, feats):
+    """Directed path 0→1→…→n-1 with the given node features, B=1."""
+    senders = np.arange(n - 1, dtype=np.int32)[None]
+    receivers = np.arange(1, n, dtype=np.int32)[None]
+    return GraphBatch(
+        x=np.asarray(feats, np.float32)[None],
+        senders=senders, receivers=receivers,
+        node_mask=np.ones((1, n), bool),
+        edge_mask=np.ones((1, n - 1), bool),
+        edge_attr=None)
+
+
+def softmax(z):
+    e = np.exp(z - z.max(axis=-1, keepdims=True))
+    return e / e.sum(axis=-1, keepdims=True)
+
+
+def test_consensus_iteration_golden():
+    R = 3
+    # Source: path of 3 nodes, features chosen so S_hat is asymmetric.
+    x_s = [[1.0, 0.0], [0.0, 1.0], [1.0, 1.0]]
+    x_t = [[1.0, 1.0], [1.0, 0.0], [0.0, 2.0]]
+    g_s, g_t = line_graph(3, x_s), line_graph(3, x_t)
+
+    model = DGMC(IdentityPsi1(2, 2), DegreePsi2(R, R), num_steps=1, k=-1)
+    # Hand-set consensus MLP: hidden = relu(d * I + 0), out = mean over R.
+    variables = {'params': {
+        'mlp_hidden_kernel': jnp.eye(R),
+        'mlp_hidden_bias': jnp.zeros((R,)),
+        'mlp_out_kernel': jnp.full((R, 1), 1.0 / R),
+        'mlp_out_bias': jnp.zeros((1,)),
+    }}
+    S_0, S_L = model.apply(variables, g_s, g_t,
+                           rngs={'noise': jax.random.PRNGKey(0)})
+
+    # ---- The same computation by hand ----
+    H_s, H_t = np.asarray(x_s), np.asarray(x_t)
+    S_hat0 = H_s @ H_t.T
+    want_S0 = softmax(S_hat0)
+    # In-degrees of the directed 3-path: node 0 has none.
+    deg = np.array([0.0, 1.0, 1.0])
+    # o = deg broadcast to R dims; D[i, j] = o_s[i] - o_t[j] (all R equal).
+    d = deg[:, None] - deg[None, :]               # [N_s, N_t]
+    delta = np.maximum(d, 0.0)                    # relu, then mean over R
+    want_SL = softmax(S_hat0 + delta)
+
+    np.testing.assert_allclose(np.asarray(S_0.val[0]), want_S0, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(S_L.val[0]), want_SL, atol=1e-6)
+    # The golden values themselves, pinned (recomputed above for clarity):
+    np.testing.assert_allclose(
+        want_SL[1], [0.46831053, 0.06337894, 0.46831053], atol=1e-6)
+
+
+def test_consensus_iteration_golden_sparse_matches():
+    """The sparse path with k=N must land on the same golden values."""
+    R = 3
+    x_s = [[1.0, 0.0], [0.0, 1.0], [1.0, 1.0]]
+    x_t = [[1.0, 1.0], [1.0, 0.0], [0.0, 2.0]]
+    g_s, g_t = line_graph(3, x_s), line_graph(3, x_t)
+    variables = {'params': {
+        'mlp_hidden_kernel': jnp.eye(R),
+        'mlp_hidden_bias': jnp.zeros((R,)),
+        'mlp_out_kernel': jnp.full((R, 1), 1.0 / R),
+        'mlp_out_bias': jnp.zeros((1,)),
+    }}
+    model = DGMC(IdentityPsi1(2, 2), DegreePsi2(R, R), num_steps=1, k=3)
+    _, S_L = model.apply(variables, g_s, g_t,
+                         rngs={'noise': jax.random.PRNGKey(0)})
+    dense = np.asarray(S_L.to_dense()[0])
+    np.testing.assert_allclose(
+        dense[1], [0.46831053, 0.06337894, 0.46831053], atol=1e-6)
+    np.testing.assert_allclose(
+        dense[2], [0.66524096, 0.09003057, 0.24472847], atol=1e-6)
+
+
+def test_synthetic_matching_quality_floor():
+    """Train the flagship dense matcher on synthetic geometric pairs for a
+    fixed 100-step budget; unseen-pair Hits@1 must stay ≥ 0.6.
+
+    Recorded calibration at the time of writing (CPU, this exact config):
+    trained ≈ 0.68, untrained ≈ 0.07, and a longer budget plateaus ≈ 0.7 —
+    so 0.6 is a tight floor for a one-minute test, far above any broken
+    consensus/matching wiring."""
+    from dgmc_tpu.data import (Cartesian, Compose, Constant, KNNGraph,
+                               RandomGraphPairs)
+    from dgmc_tpu.models import SplineCNN
+    from dgmc_tpu.train import (create_train_state, make_eval_step,
+                                make_train_step)
+    from dgmc_tpu.utils import PairLoader
+
+    transform = Compose([Constant(), KNNGraph(k=8), Cartesian()])
+    ds = RandomGraphPairs(min_inliers=20, max_inliers=40, min_outliers=0,
+                          max_outliers=4, transform=transform, length=64,
+                          seed=0)
+    loader = PairLoader(ds, 16, shuffle=True, seed=0,
+                        num_nodes=48, num_edges=400)
+    eval_ds = RandomGraphPairs(min_inliers=20, max_inliers=40,
+                               min_outliers=0, max_outliers=4,
+                               transform=transform, length=32, seed=99)
+    eval_loader = PairLoader(eval_ds, 16, shuffle=False,
+                             num_nodes=48, num_edges=400)
+
+    psi_1 = SplineCNN(1, 128, dim=2, num_layers=2, cat=False, dropout=0.0)
+    psi_2 = SplineCNN(32, 32, dim=2, num_layers=2, cat=True)
+    model = DGMC(psi_1, psi_2, num_steps=3, k=-1)
+
+    batch0 = next(iter(loader))
+    state = create_train_state(model, jax.random.key(0), batch0,
+                               learning_rate=1e-3)
+    step = make_train_step(model, loss_on_s0=True)
+    eval_step = make_eval_step(model)
+
+    key = jax.random.key(1)
+    for epoch in range(25):  # 25 epochs x 4 batches = 100 steps
+        ds.set_epoch(epoch)  # fresh pairs per epoch, as pascal_pf trains
+        for batch in loader:
+            key, sub = jax.random.split(key)
+            state, out = step(state, batch, sub)
+
+    correct = count = 0.0
+    for batch in eval_loader:
+        key, sub = jax.random.split(key)
+        ev = eval_step(state, batch, sub)
+        correct += float(ev['correct'])
+        count += float(ev['count'])
+    acc = correct / count
+    assert acc >= 0.6, f'matching quality regressed: Hits@1={acc:.3f}'

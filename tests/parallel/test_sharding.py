@@ -7,7 +7,8 @@ import numpy as np
 import pytest
 
 from dgmc_tpu.models import DGMC
-from dgmc_tpu.parallel import (corr_sharding, make_mesh, make_sharded_train_step,
+from dgmc_tpu.parallel import (corr_sharding, make_mesh,
+                               make_sharded_train_step,
                                replicate, shard_batch)
 from dgmc_tpu.train import create_train_state, make_train_step
 

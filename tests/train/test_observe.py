@@ -1,0 +1,47 @@
+"""Observability units: timer fencing, JSONL metric sink, trace no-op."""
+
+import json
+
+import jax.numpy as jnp
+
+from dgmc_tpu.train import MetricLogger, StepTimer, trace
+
+
+def test_step_timer_fences_and_summarizes():
+    t = StepTimer()
+    for i in range(3):
+        t.start()
+        x = jnp.ones((8, 8)) * i
+        t.stop(fence=x.sum())
+    s = t.summary()
+    assert s['steps'] == 3
+    assert s['mean_s'] > 0 and s['max_s'] >= s['p50_s']
+
+
+def test_metric_logger_writes_jsonl(tmp_path):
+    path = tmp_path / 'm.jsonl'
+    with MetricLogger(str(path)) as log:
+        log.log(1, loss=jnp.float32(0.5), acc=0.25, phase=1)
+        log.log(2, loss=0.4)
+    recs = [json.loads(ln) for ln in path.read_text().splitlines()]
+    assert [r['step'] for r in recs] == [1, 2]
+    assert recs[0]['loss'] == 0.5 and recs[0]['phase'] == 1
+    assert 'time' in recs[1]
+
+
+def test_metric_logger_disabled_is_noop():
+    log = MetricLogger(None)
+    log.log(1, loss=0.1)  # must not raise or create anything
+    log.close()
+
+
+def test_trace_noop_without_dir():
+    with trace(None):
+        pass
+
+
+def test_trace_writes_profile(tmp_path):
+    d = tmp_path / 'prof'
+    with trace(str(d)):
+        jnp.ones((4, 4)).sum().block_until_ready()
+    assert any(d.rglob('*'))

@@ -64,7 +64,8 @@ def test_pad_pair_batch_shapes_and_masks():
     assert batch.s.senders.shape == (3, 10)
     assert batch.y.shape == (3, 6)
     assert batch.y_mask[:, :4].all() and not batch.y_mask[:, 4:].any()
-    assert batch.s.node_mask[:, :4].all() and not batch.s.node_mask[:, 4:].any()
+    assert batch.s.node_mask[:, :4].all()
+    assert not batch.s.node_mask[:, 4:].any()
 
 
 def test_pair_loader_fixed_shapes_and_short_batch():
