@@ -1,0 +1,102 @@
+"""Locate the dense-workload bottleneck on the real chip.
+
+bench.py measures ~330 train pairs/sec for the dense flagship (batch 128,
+64 nodes, 10 consensus steps) — ~1% of the chip's nominal FLOPs. This
+script decomposes a step: dispatch+fence floor, forward vs train,
+consensus-step count scaling, and single- vs multi-step-per-dispatch, to
+tell tunnel overhead apart from on-chip inefficiency.
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def fence(x):
+    return float(x)
+
+
+def best_of(run, windows=3):
+    best = float('inf')
+    for _ in range(windows):
+        t0 = time.perf_counter()
+        run()
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def main():
+    import bench
+
+    # 1. Dispatch + fence floor: a trivial jitted add, fetched.
+    f = jax.jit(lambda a, b: a + b)
+    x = jnp.ones(()); y = jnp.ones(())
+    fence(f(x, y))
+    n = 50
+    dt = best_of(lambda: [fence(f(x, y)) for _ in range(n)])
+    print(f'dispatch+fence round-trip: {dt / n * 1e3:.2f} ms')
+
+    # Async pipelining: N dispatches, one fence.
+    def pipelined():
+        out = x
+        for _ in range(n):
+            out = f(out, y)
+        fence(out)
+    dt = best_of(pipelined)
+    print(f'pipelined dispatch: {dt / n * 1e3:.2f} ms/call')
+
+    state, step, batch = bench.build_dense()
+    key = jax.random.key(1)
+
+    def run_steps(num, state, key):
+        out = None
+        for _ in range(num):
+            key, sub = jax.random.split(key)
+            state, out = step(state, batch, sub)
+        fence(out['loss'])
+        return state, key
+
+    state, key = run_steps(3, state, key)  # warmup/compile
+    dt = best_of(lambda: run_steps(10, state, key)[0])
+    print(f'train step (10 consensus): {dt / 10 * 1e3:.1f} ms')
+
+    # Forward-only at eval (no grad, no optimizer).
+    from dgmc_tpu.train import make_eval_step
+    from dgmc_tpu.models import DGMC, SplineCNN
+    psi_1 = SplineCNN(1, 256, dim=2, num_layers=2, cat=False, lin=True,
+                      dropout=0.0)
+    psi_2 = SplineCNN(64, 64, dim=2, num_layers=2, cat=True, lin=True)
+    for steps in (0, 10):
+        model = DGMC(psi_1, psi_2, num_steps=steps, k=-1)
+        ev = make_eval_step(model)
+        fence(ev(state, batch, key)['count'])
+        dt = best_of(lambda: [fence(ev(state, batch, key)['count'])
+                              for _ in range(10)])
+        print(f'eval fwd num_steps={steps}: {dt / 10 * 1e3:.1f} ms')
+
+    # Train with num_steps=0 (psi_1 + S_0 loss only).
+    from dgmc_tpu.train import make_train_step
+    model0 = DGMC(psi_1, psi_2, num_steps=0, k=-1)
+    step0 = make_train_step(model0, loss_on_s0=True)
+    st0 = state
+    k0 = key
+    for _ in range(2):
+        k0, sub = jax.random.split(k0)
+        st0, out = step0(st0, batch, sub)
+    fence(out['loss'])
+
+    def run0():
+        nonlocal st0, k0
+        out = None
+        for _ in range(10):
+            k0, sub = jax.random.split(k0)
+            st0, out = step0(st0, batch, sub)
+        fence(out['loss'])
+    dt = best_of(run0)
+    print(f'train step (0 consensus): {dt / 10 * 1e3:.1f} ms')
+
+
+if __name__ == '__main__':
+    main()

@@ -1,0 +1,103 @@
+"""On-chip measurement: fused (Pallas) vs unfused dense consensus update.
+
+The dense consensus step materializes ``D = o_s[:, :, None] - o_t[:, None]``
+of shape ``[B, N_s, N_t, R]`` (reference ``dgmc/models/dgmc.py:178``) — R
+times the correspondence matrix. The Pallas kernel
+(``dgmc_tpu/ops/pallas/consensus.py``) forms D tile-by-tile in VMEM instead.
+This script measures both paths (forward + backward, the training shape of
+the computation) across sizes from comfortably-fitting to memory-bound, and
+writes ``benchmarks/fused_consensus_tpu.json`` — the recorded evidence
+behind the size-dispatch threshold in ``dgmc_tpu/models/dgmc.py``.
+
+Run on the real chip: ``python benchmarks/fused_consensus_bench.py``.
+"""
+
+import json
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dgmc_tpu.ops.pallas.consensus import (consensus_update,
+                                           consensus_update_reference)
+
+OUT = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                   'fused_consensus_tpu.json')
+
+# (B, N, R): D-tensor sizes 64 MB -> 8.6 GB.
+SIZES = [
+    (8, 256, 32),
+    (1, 1024, 64),
+    (1, 2048, 128),
+    (1, 4096, 128),
+]
+ITERS = 10
+
+
+def measure(fn, *args):
+    """Best-of-3 windows of ITERS forward+backward steps; returns ms/step.
+    A scalar fetch fences each window (block_until_ready is unreliable on
+    the tunneled platform, see bench.py)."""
+    grad = jax.jit(jax.grad(
+        lambda o_s, o_t, w1, b1, w2, b2:
+            fn(o_s, o_t, w1, b1, w2, b2).sum(), argnums=(0, 1, 2)))
+    out = grad(*args)
+    float(out[0].sum())  # compile + fence
+    best = float('inf')
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(ITERS):
+            out = grad(*args)
+        float(out[0].sum())
+        best = min(best, time.perf_counter() - t0)
+    return best / ITERS * 1e3
+
+
+def peak_hbm():
+    stats = jax.local_devices()[0].memory_stats() or {}
+    return stats.get('peak_bytes_in_use')
+
+
+def main():
+    assert jax.default_backend() == 'tpu', 'measure on the real chip'
+    results = []
+    for B, N, R in SIZES:
+        rng = np.random.RandomState(0)
+        o_s = jnp.asarray(rng.randn(B, N, R).astype(np.float32))
+        o_t = jnp.asarray(rng.randn(B, N, R).astype(np.float32))
+        w1 = jnp.asarray(rng.randn(R, R).astype(np.float32) / np.sqrt(R))
+        b1 = jnp.zeros((R,), jnp.float32)
+        w2 = jnp.asarray(rng.randn(R, 1).astype(np.float32) / np.sqrt(R))
+        b2 = jnp.zeros((1,), jnp.float32)
+        d_gib = B * N * N * R * 4 / 2**30
+
+        entry = {'B': B, 'N': N, 'R': R, 'D_gib': round(d_gib, 3)}
+        try:
+            entry['unfused_ms'] = round(
+                measure(consensus_update_reference,
+                        o_s, o_t, w1, b1, w2, b2), 2)
+        except Exception as e:
+            entry['unfused_ms'] = None
+            entry['unfused_error'] = f'{type(e).__name__}: {e}'[:200]
+        try:
+            entry['fused_ms'] = round(
+                measure(lambda *a: consensus_update(*a, False),
+                        o_s, o_t, w1, b1, w2, b2), 2)
+        except Exception as e:
+            entry['fused_ms'] = None
+            entry['fused_error'] = f'{type(e).__name__}: {e}'[:200]
+        entry['peak_hbm_gib_so_far'] = (
+            round(peak_hbm() / 2**30, 2) if peak_hbm() else None)
+        results.append(entry)
+        print(json.dumps(entry))
+
+    with open(OUT, 'w') as f:
+        json.dump({'device': str(jax.devices()[0].device_kind),
+                   'iters': ITERS, 'results': results}, f, indent=1)
+    print(f'wrote {OUT}')
+
+
+if __name__ == '__main__':
+    main()

@@ -1,0 +1,191 @@
+"""Race exact top-k engines at DBP15K scale (15k x 20k, k=10).
+
+Three candidates, all with semantics identical to ``dense_topk`` including
+tie order (lower target index wins on equal scores):
+
+- ``sort``: the current scan — concat carry + full score tile, one
+  ``lax.top_k`` over ``block + k`` per tile (sorts the whole tile).
+- ``tilesort``: per-tile ``lax.top_k`` down to k, then a tiny merge of
+  ``2k`` with the carry.
+- ``itermax``: k rounds of (argmax, mask) per tile — O(k·block) VPU work
+  instead of a sort — then the same tiny merge.
+
+Writes ``benchmarks/topk_tpu.json`` with ms/call for each engine x block
+size; the winner becomes ``chunked_topk``'s implementation.
+"""
+
+import functools
+import json
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+OUT = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                   'topk_tpu.json')
+
+N_S, N_T, C, K = 15000, 20000, 256, 10
+ITERS = 10
+
+
+def _prep(h_s, h_t, t_mask, block):
+    B = h_s.shape[0]
+    N_t = h_t.shape[1]
+    if t_mask is None:
+        t_mask = jnp.ones((B, N_t), dtype=bool)
+    pad = (-N_t) % block
+    if pad:
+        h_t = jnp.pad(h_t, ((0, 0), (0, pad), (0, 0)))
+        t_mask = jnp.pad(t_mask, ((0, 0), (0, pad)))
+    nb = h_t.shape[1] // block
+    C_ = h_t.shape[2]
+    ht_b = h_t.reshape(B, nb, block, C_).transpose(1, 0, 2, 3)
+    m_b = t_mask.reshape(B, nb, block).transpose(1, 0, 2)
+    starts = jnp.arange(nb, dtype=jnp.int32) * block
+    return ht_b, m_b, starts
+
+
+def _merge(vals, idx, tile_vals, tile_idx, k):
+    """Merge carry (k, sorted) with a tile's top-k (sorted): carry first so
+    earlier blocks win ties, exactly like one top_k over the union."""
+    all_vals = jnp.concatenate([vals, tile_vals], axis=-1)
+    all_idx = jnp.concatenate([idx, tile_idx], axis=-1)
+    new_vals, pos = jax.lax.top_k(all_vals, k)
+    return new_vals, jnp.take_along_axis(all_idx, pos, axis=-1)
+
+
+@functools.partial(jax.jit, static_argnames=('k', 'block'))
+def topk_sort(h_s, h_t, k, t_mask=None, block=1024):
+    B, N_s, _ = h_s.shape
+    ht_b, m_b, starts = _prep(h_s, h_t, t_mask, block)
+    neg = jnp.finfo(h_s.dtype).min
+
+    def step(carry, inp):
+        vals, idx = carry
+        ht, m, start = inp
+        scores = jnp.einsum('bsc,btc->bst', h_s, ht)
+        scores = jnp.where(m[:, None, :], scores, neg)
+        cand = jnp.broadcast_to(start + jnp.arange(block, dtype=jnp.int32),
+                                scores.shape)
+        av = jnp.concatenate([vals, scores], axis=-1)
+        ai = jnp.concatenate([idx, cand], axis=-1)
+        nv, pos = jax.lax.top_k(av, k)
+        return (nv, jnp.take_along_axis(ai, pos, axis=-1)), None
+
+    init = (jnp.full((B, N_s, k), -jnp.inf, h_s.dtype),
+            jnp.zeros((B, N_s, k), jnp.int32))
+    (vals, idx), _ = jax.lax.scan(step, init, (ht_b, m_b, starts))
+    return idx
+
+
+@functools.partial(jax.jit, static_argnames=('k', 'block'))
+def topk_tilesort(h_s, h_t, k, t_mask=None, block=1024):
+    B, N_s, _ = h_s.shape
+    ht_b, m_b, starts = _prep(h_s, h_t, t_mask, block)
+    neg = jnp.finfo(h_s.dtype).min
+    kk = min(k, block)
+
+    def step(carry, inp):
+        vals, idx = carry
+        ht, m, start = inp
+        scores = jnp.einsum('bsc,btc->bst', h_s, ht)
+        scores = jnp.where(m[:, None, :], scores, neg)
+        tv, tp = jax.lax.top_k(scores, kk)       # tile-local, idx-asc ties
+        ti = start + tp.astype(jnp.int32)
+        return _merge(vals, idx, tv, ti, k), None
+
+    init = (jnp.full((B, N_s, k), -jnp.inf, h_s.dtype),
+            jnp.zeros((B, N_s, k), jnp.int32))
+    (vals, idx), _ = jax.lax.scan(step, init, (ht_b, m_b, starts))
+    return idx
+
+
+def _itermax(scores, start, k):
+    """k rounds of (argmax, mask-out). argmax takes the first maximum, so
+    ties resolve to the lowest index — the lax.top_k rule."""
+    block = scores.shape[-1]
+    cols = jnp.arange(block, dtype=jnp.int32)
+    neg_inf = -jnp.inf
+
+    def one(s, _):
+        p = jnp.argmax(s, axis=-1)
+        v = jnp.take_along_axis(s, p[..., None], axis=-1)[..., 0]
+        s = jnp.where(cols == p[..., None], neg_inf, s)
+        return s, (v, p)
+
+    _, (tv, tp) = jax.lax.scan(one, scores, None, length=k)
+    tv = jnp.moveaxis(tv, 0, -1)                # [B, N_s, k]
+    tp = jnp.moveaxis(tp, 0, -1)
+    return tv, start + tp.astype(jnp.int32)
+
+
+@functools.partial(jax.jit, static_argnames=('k', 'block'))
+def topk_itermax(h_s, h_t, k, t_mask=None, block=1024):
+    B, N_s, _ = h_s.shape
+    ht_b, m_b, starts = _prep(h_s, h_t, t_mask, block)
+    neg = jnp.finfo(h_s.dtype).min
+
+    def step(carry, inp):
+        vals, idx = carry
+        ht, m, start = inp
+        scores = jnp.einsum('bsc,btc->bst', h_s, ht)
+        scores = jnp.where(m[:, None, :], scores, neg)
+        tv, ti = _itermax(scores, start, min(k, block))
+        return _merge(vals, idx, tv, ti, k), None
+
+    init = (jnp.full((B, N_s, k), -jnp.inf, h_s.dtype),
+            jnp.zeros((B, N_s, k), jnp.int32))
+    (vals, idx), _ = jax.lax.scan(step, init, (ht_b, m_b, starts))
+    return idx
+
+
+ENGINES = {'sort': topk_sort, 'tilesort': topk_tilesort,
+           'itermax': topk_itermax}
+
+
+def main():
+    rng = np.random.RandomState(0)
+    h_s = jnp.asarray(rng.randn(1, N_S, C).astype(np.float32))
+    h_t = jnp.asarray(rng.randn(1, N_T, C).astype(np.float32))
+
+    # Correctness gate first (tiny, with ties, on whatever backend).
+    hs_small = jnp.asarray(rng.randint(0, 3, (2, 17, 8)).astype(np.float32))
+    ht_small = jnp.asarray(rng.randint(0, 3, (2, 23, 8)).astype(np.float32))
+    mask = jnp.asarray(rng.rand(2, 23) > 0.2)
+    dense = jnp.einsum('bsc,btc->bst', hs_small, ht_small)
+    dense = jnp.where(mask[:, None, :], dense,
+                      jnp.finfo(jnp.float32).min)
+    want = jax.lax.top_k(dense, 5)[1]
+    for name, fn in ENGINES.items():
+        got = fn(hs_small, ht_small, 5, t_mask=mask, block=8)
+        assert np.array_equal(np.asarray(got), np.asarray(want)), name
+    print('correctness (incl. ties): all engines match dense_topk')
+
+    results = {}
+    for name, fn in ENGINES.items():
+        results[name] = {}
+        for block in (1024, 2048, 4096):
+            f = lambda: fn(h_s, h_t, K, block=block)
+            float(f()[0, 0, 0])  # compile + fence
+            best = float('inf')
+            for _ in range(3):
+                t0 = time.perf_counter()
+                for _ in range(ITERS):
+                    out = f()
+                float(out[0, 0, 0])
+                best = min(best, time.perf_counter() - t0)
+            ms = best / ITERS * 1e3
+            results[name][str(block)] = round(ms, 2)
+            print(f'{name} block={block}: {ms:.1f} ms')
+
+    with open(OUT, 'w') as f:
+        json.dump({'device': str(jax.devices()[0].device_kind),
+                   'shape': f'{N_S}x{N_T} C={C} k={K}',
+                   'ms': results}, f, indent=1)
+    print(f'wrote {OUT}')
+
+
+if __name__ == '__main__':
+    main()

@@ -110,13 +110,17 @@ class DGMC(nn.Module):
     # so a single huge pair (DBP15K-scale) spreads its activation state
     # across chips. GSPMD propagates the layout through the consensus loop.
     corr_sharding: Optional[object] = None
-    # Opt-in Pallas kernel for the dense consensus update: bounds the
+    # Pallas kernel for the dense consensus update: bounds the
     # [B, N_s, N_t, R] difference tensor to one VMEM tile and rematerializes
-    # it tile-by-tile in the backward. Measured on-chip, XLA's own fusion of
-    # the unfused form is at least as fast at fitting sizes — use this for
-    # huge dense pairs where residual memory, not time, is the limit.
-    # Ignored (jnp path) when corr_sharding is set.
-    fused_consensus: bool = False
+    # it tile-by-tile in the backward. ``None`` (default) auto-enables it on
+    # TPU whenever both sides fill the 128x128 kernel tile: measured
+    # on-chip it then beats XLA's fusion of the unfused form at every size
+    # tried — 7.0 vs 13.9 ms fwd+bwd at [8, 256, 256, 32] through 31.3 vs
+    # 37.6 ms at [1, 4096, 4096, 128] (an 8 GiB D tensor it never
+    # materializes); below tile size the padded tiles waste the MXU and the
+    # unfused form wins (benchmarks/fused_consensus_tpu.json, bench.py).
+    # Forced off when corr_sharding is set (GSPMD owns the layout there).
+    fused_consensus: Optional[bool] = None
 
     def _constrain(self, a):
         if self.corr_sharding is None:
@@ -176,7 +180,13 @@ class DGMC(nn.Module):
             S_mask = s_mask[:, :, None] & t_mask[:, None, :]
             S_0 = masked_softmax(S_hat, S_mask)
 
-            use_fused = self.fused_consensus and self.corr_sharding is None
+            if self.fused_consensus is None:
+                from dgmc_tpu.ops.pallas.consensus import TILE_S, TILE_T
+                use_fused = (jax.default_backend() == 'tpu'
+                             and N_s >= TILE_S and N_t >= TILE_T)
+            else:
+                use_fused = self.fused_consensus
+            use_fused = use_fused and self.corr_sharding is None
             for step in range(num_steps):
                 S = masked_softmax(S_hat, S_mask)
                 r_s = noise(step)
@@ -199,9 +209,13 @@ class DGMC(nn.Module):
                     Correspondence(S_L, None, s_mask, t_mask))
 
         # ---- Sparse (top-k) variant ----
+        # Inside a GSPMD-partitioned program (corr_sharding) the scan path
+        # must be used: pallas_call has no partitioning rule.
         S_idx = self._constrain(
             chunked_topk(h_s, h_t, self.k, t_mask=t_mask,
-                         block=self.topk_block))
+                         block=self.topk_block,
+                         pallas=False if self.corr_sharding is not None
+                         else None))
 
         if train and y is not None:
             if y_mask is None:

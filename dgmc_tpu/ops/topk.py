@@ -8,10 +8,19 @@ the MXU, and carry a running top-k per source row — the same
 row-statistics-carry trick flash-attention uses. HBM footprint is
 ``O(N_s * (k + block))`` instead of ``O(N_s * N_t)``.
 
-Tie-breaking matches the dense path exactly: ``jax.lax.top_k`` prefers lower
-positions on equal values, and the running carry is concatenated *before*
-each new tile, so earlier target indices always win ties — identical to
-``dense_topk`` on the full matrix.
+Per tile, the k best entries are extracted by **k rounds of (argmax,
+mask-out)** — O(k·block) cheap VPU work — rather than a ``lax.top_k`` sort
+of the whole tile; the tile's k survivors then merge with the running carry
+through one tiny ``top_k`` over ``2k``. Raced on-chip at DBP15K scale
+(15000x20000, C=256, k=10) this is 2.5x the sort formulation: 86 ms vs
+211 ms per call at block=1024 (``benchmarks/topk_tpu.json``,
+``benchmarks/topk_bench.py``).
+
+Tie-breaking matches the dense path exactly: ``argmax`` takes the *first*
+maximum (lowest target index, the ``lax.top_k`` rule), and the merge
+concatenates the running carry *before* the tile survivors, so earlier
+target indices always win ties — bit-identical to ``dense_topk`` on the
+full matrix, which the dense≡sparse(k=N) contract tests rely on.
 """
 
 import functools
@@ -34,16 +43,42 @@ def dense_topk(h_s, h_t, k, t_mask=None):
     return jax.lax.top_k(scores, k)[1]
 
 
-@functools.partial(jax.jit, static_argnames=('k', 'block', 'return_values'))
-def chunked_topk(h_s, h_t, k, t_mask=None, block=1024, return_values=False):
+@functools.partial(jax.jit,
+                   static_argnames=('k', 'block', 'return_values', 'pallas'))
+def chunked_topk(h_s, h_t, k, t_mask=None, block=1024, return_values=False,
+                 pallas=None):
     """Blockwise running top-k of ``h_s @ h_t^T`` along the target axis.
 
     Produces indices identical to :func:`dense_topk` (including tie order)
     while only ever holding one ``[B, N_s, block]`` score tile. With
     ``return_values`` the running scores come back too (``(vals, idx)``) —
     used by the distributed column-sharded merge.
+
+    The candidate search is pure *selection* and is non-differentiable by
+    design on every path (the reference uses KeOps ``argKmin`` outside
+    autograd the same way, reference ``dgmc/models/dgmc.py:85-94``);
+    gradients flow through the differentiable re-gather of the selected
+    rows, never through the search.
+
+    ``pallas=None`` auto-dispatches to the VMEM-resident Pallas kernel
+    (:mod:`dgmc_tpu.ops.pallas.topk`) on TPU — 21 ms vs 82 ms for this
+    scan at 15000x20000 — outside ``shard_map``'s manual mode; results are
+    bit-identical either way. Pass ``pallas=False`` inside
+    GSPMD-partitioned programs (pallas_call has no partitioning rule;
+    :class:`~dgmc_tpu.models.DGMC` does this when ``corr_sharding`` is
+    set).
     """
+    h_s = jax.lax.stop_gradient(h_s)
+    h_t = jax.lax.stop_gradient(h_t)
     B, N_s, C = h_s.shape
+    if pallas is None:
+        pallas = (jax.default_backend() == 'tpu'
+                  and not jax.typeof(h_s).vma)
+    if pallas:
+        from dgmc_tpu.ops.pallas.topk import BLOCK_T, pallas_topk
+        if k <= BLOCK_T:
+            return pallas_topk(h_s, h_t, k, t_mask=t_mask,
+                               return_values=return_values)
     N_t = h_t.shape[1]
     if t_mask is None:
         t_mask = jnp.ones((B, N_t), dtype=bool)
@@ -70,17 +105,33 @@ def chunked_topk(h_s, h_t, k, t_mask=None, block=1024, return_values=False):
         init_vals = jax.lax.pcast(init_vals, vma, to='varying')
         init_idx = jax.lax.pcast(init_idx, vma, to='varying')
 
+    kk = min(k, block)
+    cols = jnp.arange(block, dtype=jnp.int32)
+
+    def tile_topk(scores):
+        """k rounds of (argmax, mask-out): the tile's k best, sorted desc
+        with lowest-index tie preference (exactly lax.top_k's order) at
+        O(k*block) VPU cost instead of a sort."""
+        def one(s, _):
+            p = jnp.argmax(s, axis=-1)
+            v = jnp.take_along_axis(s, p[..., None], axis=-1)[..., 0]
+            s = jnp.where(cols == p[..., None], -jnp.inf, s)
+            return s, (v, p)
+
+        _, (tv, tp) = jax.lax.scan(one, scores, None, length=kk)
+        return jnp.moveaxis(tv, 0, -1), jnp.moveaxis(tp, 0, -1)
+
     def step(carry, inp):
         vals, idx = carry
         ht_b, m_b, start = inp
         scores = jnp.einsum('bsc,btc->bst', h_s, ht_b)
         scores = jnp.where(m_b[:, None, :], scores, neg)
-        cand_idx = (start + jnp.arange(block, dtype=jnp.int32))
-        cand_idx = jnp.broadcast_to(cand_idx, (B, N_s, block))
+        tile_vals, tile_pos = tile_topk(scores)
+        tile_idx = start + tile_pos.astype(jnp.int32)
         # Carry first: on ties, earlier (lower-index) entries win, matching
         # lax.top_k over the full matrix.
-        all_vals = jnp.concatenate([vals, scores], axis=-1)
-        all_idx = jnp.concatenate([idx, cand_idx], axis=-1)
+        all_vals = jnp.concatenate([vals, tile_vals], axis=-1)
+        all_idx = jnp.concatenate([idx, tile_idx], axis=-1)
         new_vals, pos = jax.lax.top_k(all_vals, k)
         new_idx = jnp.take_along_axis(all_idx, pos, axis=-1)
         return (new_vals, new_idx), None
