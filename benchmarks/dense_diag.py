@@ -7,27 +7,19 @@ consensus-step count scaling, and single- vs multi-step-per-dispatch, to
 tell tunnel overhead apart from on-chip inefficiency.
 """
 
-import time
+import os
+import sys
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 
-def fence(x):
-    return float(x)
-
-
-def best_of(run, windows=3):
-    best = float('inf')
-    for _ in range(windows):
-        t0 = time.perf_counter()
-        run()
-        best = min(best, time.perf_counter() - t0)
-    return best
+from timing import best_of, fence  # noqa: E402
 
 
 def main():
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
     import bench
 
     # 1. Dispatch + fence floor: a trivial jitted add, fetched.
@@ -50,17 +42,22 @@ def main():
     state, step, batch = bench.build_dense()
     key = jax.random.key(1)
 
-    def run_steps(num, state, key):
+    def run_steps(num):
+        # The step donates its input state; thread it across windows.
+        nonlocal_state = run_steps.state
+        k = run_steps.key
         out = None
         for _ in range(num):
-            key, sub = jax.random.split(key)
-            state, out = step(state, batch, sub)
+            k, sub = jax.random.split(k)
+            nonlocal_state, out = step(nonlocal_state, batch, sub)
         fence(out['loss'])
-        return state, key
+        run_steps.state, run_steps.key = nonlocal_state, k
 
-    state, key = run_steps(3, state, key)  # warmup/compile
-    dt = best_of(lambda: run_steps(10, state, key)[0])
+    run_steps.state, run_steps.key = state, key
+    run_steps(3)  # warmup/compile
+    dt = best_of(lambda: run_steps(10))
     print(f'train step (10 consensus): {dt / 10 * 1e3:.1f} ms')
+    state = run_steps.state
 
     # Forward-only at eval (no grad, no optimizer).
     from dgmc_tpu.train import make_eval_step

@@ -14,7 +14,7 @@ Run on the real chip: ``python benchmarks/fused_consensus_bench.py``.
 
 import json
 import os
-import time
+import sys
 
 import jax
 import jax.numpy as jnp
@@ -22,6 +22,9 @@ import numpy as np
 
 from dgmc_tpu.ops.pallas.consensus import (consensus_update,
                                            consensus_update_reference)
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from timing import best_of, fence  # noqa: E402
 
 OUT = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                    'fused_consensus_tpu.json')
@@ -44,15 +47,15 @@ def measure(fn, *args):
         lambda o_s, o_t, w1, b1, w2, b2:
             fn(o_s, o_t, w1, b1, w2, b2).sum(), argnums=(0, 1, 2)))
     out = grad(*args)
-    float(out[0].sum())  # compile + fence
-    best = float('inf')
-    for _ in range(3):
-        t0 = time.perf_counter()
+    fence(out[0].sum())  # compile + fence
+
+    def window():
+        out = None
         for _ in range(ITERS):
             out = grad(*args)
-        float(out[0].sum())
-        best = min(best, time.perf_counter() - t0)
-    return best / ITERS * 1e3
+        fence(out[0].sum())
+
+    return best_of(window) / ITERS * 1e3
 
 
 def peak_hbm():

@@ -1,0 +1,75 @@
+"""Decompose the DBP15K-scale sparse training step (bench.py: ~473 ms).
+
+Components: candidate search (Pallas top-k, ~21 ms), psi_1 RelCNN at
+15k/20k nodes, 10 consensus iterations (scatter r_t, psi_2, gather,
+MLP), loss/optimizer. Uses long fenced windows (the tunnel fence costs
+~120 ms, so short windows lie — see benchmarks/dense_diag.py).
+"""
+
+import os
+import sys
+
+import jax
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
+    __file__))))
+
+from timing import best_of, fence  # noqa: E402
+
+
+def main():
+    import bench
+    from dgmc_tpu.models import DGMC, RelCNN
+    from dgmc_tpu.train import create_train_state, make_train_step
+    from dgmc_tpu.utils.data import PairBatch
+
+    rng = np.random.RandomState(0)
+    s = bench._kg_side(bench.SP_N_S, bench.SP_E_S, bench.SP_DIM, rng)
+    t = bench._kg_side(bench.SP_N_T, bench.SP_E_T, bench.SP_DIM, rng)
+    y = np.full((1, bench.SP_N_S), -1, np.int32)
+    train_n = int(0.3 * bench.SP_N_S)
+    y[0, :train_n] = rng.permutation(bench.SP_N_T)[:train_n]
+    batch = jax.device_put(PairBatch(s=s, t=t, y=y, y_mask=y >= 0))
+    jax.block_until_ready(batch)
+
+    tiny = PairBatch(s=bench._kg_side(32, 64, bench.SP_DIM, rng),
+                     t=bench._kg_side(32, 64, bench.SP_DIM, rng),
+                     y=np.zeros((1, 32), np.int32),
+                     y_mask=np.ones((1, 32), bool))
+
+    def run_config(label, num_steps, iters=10):
+        psi_1 = RelCNN(bench.SP_DIM, 256, num_layers=3, dropout=0.5)
+        psi_2 = RelCNN(32, 32, num_layers=3)
+        model = DGMC(psi_1, psi_2, num_steps=num_steps, k=bench.SP_K,
+                     topk_block=bench.SP_TOPK_BLOCK)
+        state = create_train_state(model, jax.random.key(0), tiny,
+                                   learning_rate=1e-3)
+        step = make_train_step(model, loss_on_s0=False)
+        key = jax.random.key(1)
+        for _ in range(2):
+            key, sub = jax.random.split(key)
+            state, out = step(state, batch, sub)
+        fence(out['loss'])
+
+        def window():
+            nonlocal state, key
+            out = None
+            for _ in range(iters):
+                key, sub = jax.random.split(key)
+                state, out = step(state, batch, sub)
+            fence(out['loss'])
+        ms = best_of(window) / iters * 1e3
+        print(f'{label}: {ms:.1f} ms/step')
+        return ms
+
+    full = run_config('full step (10 consensus)', 10)
+    zero = run_config('no consensus (psi_1 + topk + loss)', 0)
+    one = run_config('1 consensus iteration', 1)
+    print(f'-> per consensus iteration: {(full - zero) / 10:.1f} ms '
+          f'(check vs 1-step delta {one - zero:.1f} ms)')
+    print(f'-> psi_1 + topk + loss + optimizer: {zero:.1f} ms')
+
+
+if __name__ == '__main__':
+    main()

@@ -1,0 +1,33 @@
+"""Shared measurement harness for every script in this directory.
+
+The methodology is load-bearing for all numbers recorded in the
+checked-in JSONs: on the tunneled TPU platform ``block_until_ready``
+intermittently returns before execution finishes, so every timed window is
+fenced by a scalar device-to-host fetch (which cannot lie), and the
+reported figure is the best of several windows because the chip is shared
+and effective speed varies with external load. A fenced round trip costs
+~120 ms here, so short windows overstate per-call cost — amortize over
+enough iterations (see dense_diag.py findings).
+"""
+
+import time
+
+
+def fence(x):
+    """Force completion by fetching one scalar to the host."""
+    return float(x)
+
+
+def best_of(run, windows=3):
+    """Minimum wall-clock seconds of ``run()`` over several windows."""
+    best = float('inf')
+    for _ in range(windows):
+        t0 = time.perf_counter()
+        run()
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def best_ms_per_iter(make_run, iters, windows=3):
+    """ms/iteration for a ``make_run(iters)`` callable, best of windows."""
+    return best_of(lambda: make_run(iters), windows) / iters * 1e3

@@ -17,11 +17,14 @@ size; the winner becomes ``chunked_topk``'s implementation.
 import functools
 import json
 import os
-import time
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+import sys
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from timing import best_of, fence  # noqa: E402
 
 OUT = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                    'topk_tpu.json')
@@ -168,15 +171,15 @@ def main():
         results[name] = {}
         for block in (1024, 2048, 4096):
             f = lambda: fn(h_s, h_t, K, block=block)
-            float(f()[0, 0, 0])  # compile + fence
-            best = float('inf')
-            for _ in range(3):
-                t0 = time.perf_counter()
+            fence(f()[0, 0, 0])  # compile + fence
+
+            def window(f=f):
+                out = None
                 for _ in range(ITERS):
                     out = f()
-                float(out[0, 0, 0])
-                best = min(best, time.perf_counter() - t0)
-            ms = best / ITERS * 1e3
+                fence(out[0, 0, 0])
+
+            ms = best_of(window) / ITERS * 1e3
             results[name][str(block)] = round(ms, 2)
             print(f'{name} block={block}: {ms:.1f} ms')
 

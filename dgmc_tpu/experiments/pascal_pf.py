@@ -16,7 +16,6 @@ import os
 import time
 
 import jax
-import numpy as np
 
 from dgmc_tpu.data import (Cartesian, Compose, Constant, KNNGraph,
                            RandomGraphPairs)

@@ -146,8 +146,31 @@ class DGMC(nn.Module):
         num_steps = self.num_steps if num_steps is None else num_steps
         detach = self.detach if detach is None else detach
 
-        h_s = self.psi_1(graph_s.x, graph_s, train=train)
-        h_t = self.psi_1(graph_t.x, graph_t, train=train)
+        if self.corr_sharding is not None:
+            # Pallas kernels have no GSPMD partitioning rule. DGMC forces
+            # its own kernels off under corr_sharding, auto-dispatched
+            # backbone kernels are silenced via the trace-time context
+            # below, and an *explicit* fused=True is rejected loudly (a
+            # silent pallas_call inside the partitioned program would
+            # crash or replicate at partition time).
+            for role, m in (('psi_1', self.psi_1), ('psi_2', self.psi_2)):
+                if getattr(m, 'fused', None) is True:
+                    raise ValueError(
+                        f'corr_sharding is incompatible with {role} '
+                        f'fused=True: Pallas routing kernels cannot run '
+                        f'inside GSPMD-partitioned programs')
+
+        def run_psi(m, *args, **kw):
+            """Invoke a backbone; under corr_sharding, silence its
+            auto-dispatched Pallas kernels for the GSPMD program."""
+            if self.corr_sharding is None:
+                return m(*args, **kw)
+            from dgmc_tpu.ops.pallas.dispatch import disable_fused_kernels
+            with disable_fused_kernels():
+                return m(*args, **kw)
+
+        h_s = run_psi(self.psi_1, graph_s.x, graph_s, train=train)
+        h_t = run_psi(self.psi_1, graph_t.x, graph_t, train=train)
         if detach:
             h_s = jax.lax.stop_gradient(h_s)
             h_t = jax.lax.stop_gradient(h_t)
@@ -182,8 +205,13 @@ class DGMC(nn.Module):
 
             if self.fused_consensus is None:
                 from dgmc_tpu.ops.pallas.consensus import TILE_S, TILE_T
+                # R ceiling: the kernel holds two [TILE_S*TILE_T, R] f32
+                # tiles in VMEM (64 KiB x R each); measurements cover
+                # R <= 128 (benchmarks/fused_consensus_tpu.json) and
+                # R = 256 would blow the 16 MB scoped-VMEM limit.
                 use_fused = (jax.default_backend() == 'tpu'
-                             and N_s >= TILE_S and N_t >= TILE_T)
+                             and N_s >= TILE_S and N_t >= TILE_T
+                             and R_out <= 128)
             else:
                 use_fused = self.fused_consensus
             use_fused = use_fused and self.corr_sharding is None
@@ -191,8 +219,8 @@ class DGMC(nn.Module):
                 S = masked_softmax(S_hat, S_mask)
                 r_s = noise(step)
                 r_t = jnp.einsum('bst,bsr->btr', S, r_s)
-                o_s = self.psi_2(r_s, graph_s, train=train)
-                o_t = self.psi_2(r_t, graph_t, train=train)
+                o_s = run_psi(self.psi_2, r_s, graph_s, train=train)
+                o_t = run_psi(self.psi_2, r_t, graph_t, train=train)
                 if use_fused:
                     from dgmc_tpu.ops.pallas import consensus_update
                     delta = consensus_update(
@@ -254,8 +282,8 @@ class DGMC(nn.Module):
 
             r_t = jax.vmap(scat)(contrib.reshape(B, N_s * K, R_in),
                                  S_idx.reshape(B, N_s * K))
-            o_s = self.psi_2(r_s, graph_s, train=train)
-            o_t = self.psi_2(r_t, graph_t, train=train)
+            o_s = run_psi(self.psi_2, r_s, graph_s, train=train)
+            o_t = run_psi(self.psi_2, r_t, graph_t, train=train)
             o_t_cand = gather_t(o_t, S_idx)
             D = o_s[:, :, None, :] - o_t_cand
             S_hat = self._constrain(S_hat + consensus_mlp(D))

@@ -1,0 +1,32 @@
+"""Trace-time switch for auto-dispatched Pallas kernels.
+
+Pallas ``custom_call``s have no GSPMD partitioning rule, so every kernel
+that auto-enables on TPU must stay off inside partitioned programs.
+``shard_map``'s manual mode is detectable from ``jax.typeof(x).vma``, but
+GSPMD auto-partitioning (``corr_sharding``) is not visible from inside a
+module — so the orchestrator (:class:`~dgmc_tpu.models.DGMC`) wraps its
+partitioned region in :func:`disable_fused_kernels`, and each auto gate
+consults :func:`fused_kernels_allowed`. Explicitly requested kernels
+(``fused=True``) are not silenced — DGMC rejects those loudly instead.
+"""
+
+import contextlib
+import contextvars
+
+_fused_ok = contextvars.ContextVar('dgmc_tpu_fused_kernels_ok',
+                                   default=True)
+
+
+@contextlib.contextmanager
+def disable_fused_kernels():
+    """Trace-time context: auto-dispatched Pallas kernels pick their
+    fallback path inside this block."""
+    token = _fused_ok.set(False)
+    try:
+        yield
+    finally:
+        _fused_ok.reset(token)
+
+
+def fused_kernels_allowed():
+    return _fused_ok.get()

@@ -2,7 +2,6 @@
 of DBP15K / PascalPF / WILLOW / PascalVOC-Berkeley; no network access)."""
 
 import json
-import os
 
 import numpy as np
 import pytest

@@ -3,7 +3,6 @@ the four workloads of SURVEY.md §2.2, exercised through their CLIs."""
 
 import jax
 import json
-import os
 
 import numpy as np
 import pytest

@@ -7,7 +7,6 @@ ground truth is missing; hits@all is exactly 1.0."""
 import jax
 import jax.numpy as jnp
 import numpy as np
-import pytest
 
 from dgmc_tpu.models import DGMC, GIN
 from dgmc_tpu.models.dgmc import include_gt

@@ -5,7 +5,6 @@ the unfused model exactly (interpret mode on CPU)."""
 import jax
 import jax.numpy as jnp
 import numpy as np
-import pytest
 
 from dgmc_tpu.ops.pallas import (consensus_update,
                                  consensus_update_reference)

@@ -1,0 +1,143 @@
+"""Fused SplineConv routing kernel: forward and backward must match the
+gather+scatter formulation exactly (interpret mode on CPU; the compiled
+kernel was verified bit-identical on the real chip, where it lifts the
+dense flagship from ~330 to ~1200 training pairs/sec)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dgmc_tpu.models.spline import SplineConv
+from dgmc_tpu.ops import GraphBatch
+from dgmc_tpu.ops.graph import scatter_to_nodes
+from dgmc_tpu.ops.pallas.spline import (route_aggregate,
+                                        route_aggregate_fits)
+from dgmc_tpu.ops.spline import open_spline_basis
+
+
+def problem(B=3, N=24, E=80, C=8, O=16, seed=0, mask_frac=0.2):
+    rng = np.random.RandomState(seed)
+    x = jnp.asarray(rng.randn(B, N, C).astype(np.float32))
+    senders = jnp.asarray(rng.randint(0, N, (B, E)).astype(np.int32))
+    receivers = jnp.asarray(rng.randint(0, N, (B, E)).astype(np.int32))
+    emask = jnp.asarray(rng.rand(B, E) > mask_frac)
+    attr = jnp.asarray(rng.rand(B, E, 2).astype(np.float32))
+    W = jnp.asarray(rng.randn(25, C, O).astype(np.float32) * 0.1)
+    t = (x @ W.transpose(1, 0, 2).reshape(C, 25 * O)).reshape(B, N * 25, O)
+    basis, combo = open_spline_basis(attr, 5, 1)
+    flat = senders[..., None] * 25 + combo
+    return t, flat, basis, receivers, emask, N, E, O
+
+
+def reference(t, flat, basis, receivers, emask, N, E, O):
+    B = t.shape[0]
+    A = flat.shape[2]
+    picked = jnp.take_along_axis(
+        t, flat.reshape(B, E * A, 1), axis=1).reshape(B, E, A, O)
+    msgs = jnp.einsum('bea,beao->beo', basis, picked)
+    return scatter_to_nodes(msgs, receivers, emask, N, aggr='mean')
+
+
+def test_forward_matches_gather_scatter():
+    t, flat, basis, rcv, em, N, E, O = problem()
+    got = route_aggregate(t, flat, basis, rcv, em, N, True)
+    want = reference(t, flat, basis, rcv, em, N, E, O)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=1e-5)
+
+
+def test_backward_matches_gather_scatter():
+    t, flat, basis, rcv, em, N, E, O = problem(seed=1)
+
+    def fused_loss(t):
+        return (route_aggregate(t, flat, basis, rcv, em, N, True) ** 2).sum()
+
+    def ref_loss(t):
+        return (reference(t, flat, basis, rcv, em, N, E, O) ** 2).sum()
+
+    g1 = jax.grad(fused_loss)(t)
+    g2 = jax.grad(ref_loss)(t)
+    np.testing.assert_allclose(np.asarray(g1), np.asarray(g2), atol=1e-4)
+
+
+def test_all_edges_masked_node_gives_zero():
+    t, flat, basis, rcv, em, N, E, O = problem(seed=2, mask_frac=1.01)
+    got = route_aggregate(t, flat, basis, rcv, em, N, True)
+    assert not np.asarray(em).any()
+    np.testing.assert_array_equal(np.asarray(got), 0.0)
+
+
+def test_m_axis_padding():
+    """M = N * 25 not a multiple of the kernel's M tile: results must be
+    unaffected by the zero-padding."""
+    t, flat, basis, rcv, em, N, E, O = problem(N=11, E=40, seed=3)
+    assert (11 * 25) % 256 != 0
+    got = route_aggregate(t, flat, basis, rcv, em, N, True)
+    want = reference(t, flat, basis, rcv, em, N, E, O)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=1e-5)
+
+
+def test_splineconv_fused_flag_dispatch():
+    """fused=True routes through the kernel (interpret off-TPU is not
+    wired into the module, so force it via the function); fused=False and
+    the CPU auto default agree with each other."""
+    rng = np.random.RandomState(4)
+    B, N, E, C = 2, 16, 48, 8
+    x = jnp.asarray(rng.randn(B, N, C).astype(np.float32))
+    gb = GraphBatch(
+        x=x,
+        senders=jnp.asarray(rng.randint(0, N, (B, E)).astype(np.int32)),
+        receivers=jnp.asarray(rng.randint(0, N, (B, E)).astype(np.int32)),
+        node_mask=jnp.ones((B, N), bool),
+        edge_mask=jnp.asarray(rng.rand(B, E) > 0.2),
+        edge_attr=jnp.asarray(rng.rand(B, E, 2).astype(np.float32)))
+    conv = SplineConv(8, dim=2, fused=False)
+    vs = conv.init(jax.random.PRNGKey(0), x, gb)
+    auto = SplineConv(8, dim=2)  # CPU auto => unfused
+    np.testing.assert_allclose(np.asarray(conv.apply(vs, x, gb)),
+                               np.asarray(auto.apply(vs, x, gb)),
+                               atol=1e-6)
+
+
+def test_fits_gate():
+    assert route_aggregate_fits(64, 512, 25, 256)
+    assert not route_aggregate_fits(15000, 100000, 25, 32)
+    assert not route_aggregate_fits(64, 2048, 25, 512)   # E*O too wide
+    assert not route_aggregate_fits(1024, 2048, 25, 32)  # N*E too big
+
+
+def test_dispatch_context_silences_auto_but_not_explicit():
+    from dgmc_tpu.ops.pallas.dispatch import (disable_fused_kernels,
+                                              fused_kernels_allowed)
+    assert fused_kernels_allowed()
+    with disable_fused_kernels():
+        assert not fused_kernels_allowed()
+        with disable_fused_kernels():
+            assert not fused_kernels_allowed()
+        assert not fused_kernels_allowed()
+    assert fused_kernels_allowed()
+
+
+def test_dgmc_rejects_explicit_fused_under_corr_sharding():
+    import pytest
+    from dgmc_tpu.models import DGMC
+    from dgmc_tpu.models.spline import SplineCNN
+    import jax.sharding as shd
+    mesh = jax.sharding.Mesh(np.array(jax.devices()[:1]), ('model',))
+    sharding = shd.NamedSharding(mesh, shd.PartitionSpec(None, 'model'))
+    psi_1 = SplineCNN(1, 8, dim=2, num_layers=1, fused=True)
+    psi_2 = SplineCNN(4, 4, dim=2, num_layers=1)
+    model = DGMC(psi_1, psi_2, num_steps=1, corr_sharding=sharding)
+    rng = np.random.RandomState(0)
+    B, N, E = 1, 8, 16
+    gb = GraphBatch(
+        x=jnp.ones((B, N, 1)),
+        senders=jnp.asarray(rng.randint(0, N, (B, E)).astype(np.int32)),
+        receivers=jnp.asarray(rng.randint(0, N, (B, E)).astype(np.int32)),
+        node_mask=jnp.ones((B, N), bool),
+        edge_mask=jnp.ones((B, E), bool),
+        edge_attr=jnp.asarray(rng.rand(B, E, 2).astype(np.float32)))
+    with pytest.raises(ValueError, match='fused=True'):
+        model.init({'params': jax.random.PRNGKey(0),
+                    'noise': jax.random.PRNGKey(1)}, gb, gb)

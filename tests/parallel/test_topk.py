@@ -1,7 +1,6 @@
 """Mesh-sharded top-k must be bit-identical to the dense reference
 semantics, ties included, on the virtual 8-device CPU mesh."""
 
-import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
