@@ -22,7 +22,8 @@ from dgmc_tpu.data import (Cartesian, Compose, Constant, KNNGraph,
 from dgmc_tpu.models import DGMC, SplineCNN, metrics
 from dgmc_tpu.utils import PairLoader, pad_pair_batch
 from dgmc_tpu.utils.data import GraphPair
-from dgmc_tpu.train import create_train_state, make_train_step
+from dgmc_tpu.train import (MetricLogger, create_train_state,
+                            make_train_step, trace)
 
 
 def parse_args(argv=None):
@@ -37,6 +38,11 @@ def parse_args(argv=None):
     parser.add_argument('--data_root', type=str,
                         default=os.path.join('..', 'data', 'PascalPF'))
     parser.add_argument('--seed', type=int, default=0)
+    parser.add_argument('--profile', type=str, default=None,
+                        help='emit a jax.profiler trace of one training '
+                             'epoch into this directory')
+    parser.add_argument('--metrics_log', type=str, default=None,
+                        help='append per-epoch metrics to this JSONL file')
     return parser.parse_args(argv)
 
 
@@ -77,20 +83,26 @@ def main(argv=None):
         print(f'[pascal_pf] real-data eval disabled: {e}')
         test_datasets = []
 
+    logger = MetricLogger(args.metrics_log)
+    profile_epoch = min(2, args.epochs)
     key = jax.random.key(args.seed + 1)
     for epoch in range(1, args.epochs + 1):
         train_loader.dataset.set_epoch(epoch)
         t0 = time.time()
         tot_loss = tot_correct = tot_n = 0.0
-        for batch in train_loader:
-            key, sub = jax.random.split(key)
-            state, out = step(state, batch, sub)
-            tot_loss += float(out['loss'])
-            tot_correct += float(out['acc']) * float(batch.y_mask.sum())
-            tot_n += float(batch.y_mask.sum())
-        print(f'Epoch: {epoch:02d}, Loss: {tot_loss / len(train_loader):.4f},'
-              f' Acc: {tot_correct / max(tot_n, 1):.2f},'
+        with trace(args.profile if epoch == profile_epoch else None):
+            for batch in train_loader:
+                key, sub = jax.random.split(key)
+                state, out = step(state, batch, sub)
+                tot_loss += float(out['loss'])
+                tot_correct += float(out['acc']) * float(batch.y_mask.sum())
+                tot_n += float(batch.y_mask.sum())
+        loss = tot_loss / len(train_loader)
+        acc = tot_correct / max(tot_n, 1)
+        print(f'Epoch: {epoch:02d}, Loss: {loss:.4f},'
+              f' Acc: {acc:.2f},'
               f' {time.time() - t0:.1f}s')
+        logger.log(epoch, loss=loss, train_acc=acc)
 
         if test_datasets:
             accs = []
@@ -112,6 +124,8 @@ def main(argv=None):
             accs.append(sum(accs) / len(accs))
             print(' '.join(c[:5].ljust(5) for c in CATEGORIES) + ' mean')
             print(' '.join(f'{a:.1f}'.ljust(5) for a in accs))
+            logger.log(epoch, mean_acc=accs[-1])
+    logger.close()
     return state
 
 

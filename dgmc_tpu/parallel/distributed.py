@@ -1,0 +1,68 @@
+"""Multi-host (multi-process) initialization.
+
+The reference has no distributed backend at all (SURVEY.md §2.5); here the
+communication layer is XLA collectives over ICI/DCN, so scaling beyond one
+host only needs the JAX distributed runtime brought up before any backend
+touch — after that, ``jax.devices()`` spans the slice/pod and the same
+``make_mesh`` + sharding annotations drive cross-host collectives with no
+NCCL/MPI analog to manage.
+
+Typical usage (same script on every host)::
+
+    from dgmc_tpu.parallel import initialize_distributed, make_mesh
+    initialize_distributed()   # pods/SLURM/MPI auto-detected; no-op solo
+    mesh = make_mesh(model=8)  # now spans all hosts' devices
+
+On clusters JAX cannot auto-detect, pass ``coordinator_address``,
+``num_processes`` and ``process_id`` explicitly.
+"""
+
+from typing import Optional
+
+import jax
+
+_initialized = False
+
+
+def _already_initialized() -> bool:
+    """True when some other component already brought the runtime up."""
+    state = getattr(jax.distributed, 'global_state', None)
+    return getattr(state, 'client', None) is not None
+
+
+def initialize_distributed(coordinator_address: Optional[str] = None,
+                           num_processes: Optional[int] = None,
+                           process_id: Optional[int] = None) -> int:
+    """Bring up the JAX distributed runtime (idempotent).
+
+    Must run before any JAX backend initialization. With no arguments,
+    cluster detection is delegated to ``jax.distributed.initialize`` (TPU
+    pods, SLURM, Open MPI, ...); in a plain single-process launch that
+    detection fails and this becomes a no-op returning 1, so scripts can
+    call it unconditionally. Safe to call when a launcher already
+    initialized the runtime. Returns the process count.
+    """
+    global _initialized
+    if _initialized or _already_initialized():
+        _initialized = True
+        return jax.process_count()
+    explicit = (coordinator_address is not None
+                or num_processes not in (None, 1))
+    if explicit:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id)
+    else:
+        try:
+            jax.distributed.initialize()
+        except ValueError:
+            # No cluster environment detected: single-process launch.
+            pass
+    _initialized = True
+    return jax.process_count()
+
+
+def is_coordinator() -> bool:
+    """True on the process that should write checkpoints / logs."""
+    return jax.process_index() == 0

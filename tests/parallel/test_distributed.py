@@ -1,0 +1,61 @@
+"""Multi-host initialization glue: single-process no-op semantics,
+idempotence, and delegation of cluster detection to JAX (a real pod cannot
+run here; the contract is that scripts call initialize_distributed
+unconditionally)."""
+
+import jax
+import pytest
+
+from dgmc_tpu.parallel import distributed, initialize_distributed
+from dgmc_tpu.parallel import is_coordinator
+
+
+@pytest.fixture(autouse=True)
+def fresh(monkeypatch):
+    monkeypatch.setattr(distributed, '_initialized', False)
+    monkeypatch.setattr(distributed, '_already_initialized', lambda: False)
+
+
+def test_single_process_noop_and_idempotent(monkeypatch):
+    def detect_fail(**kw):  # what bare initialize() does with no cluster
+        raise ValueError('coordinator_address should be defined.')
+
+    monkeypatch.setattr(jax.distributed, 'initialize', detect_fail)
+    assert initialize_distributed() == 1
+    assert initialize_distributed() == 1  # idempotent, no second attempt
+    assert is_coordinator()
+
+
+def test_cluster_detection_is_delegated(monkeypatch):
+    """With no args, bare jax.distributed.initialize() runs — JAX's own
+    cluster auto-detection (SLURM/MPI/TPU pods) decides."""
+    called = []
+    monkeypatch.setattr(jax.distributed, 'initialize',
+                        lambda **kw: called.append(kw))
+    initialize_distributed()
+    assert called == [{}]
+
+
+def test_coordinator_args_are_forwarded(monkeypatch):
+    calls = {}
+
+    def fake_init(coordinator_address=None, num_processes=None,
+                  process_id=None):
+        calls.update(addr=coordinator_address, n=num_processes,
+                     pid=process_id)
+
+    monkeypatch.setattr(jax.distributed, 'initialize', fake_init)
+    initialize_distributed('host:1234', 4, 2)
+    assert calls == {'addr': 'host:1234', 'n': 4, 'pid': 2}
+
+
+def test_external_initialization_is_respected(monkeypatch):
+    """A launcher that already brought the runtime up must not trigger a
+    second initialize (which would raise)."""
+    monkeypatch.setattr(distributed, '_already_initialized', lambda: True)
+
+    def boom(**kw):
+        raise AssertionError('re-initialized an initialized runtime')
+
+    monkeypatch.setattr(jax.distributed, 'initialize', boom)
+    assert initialize_distributed() == 1
