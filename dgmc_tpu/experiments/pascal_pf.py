@@ -3,8 +3,9 @@
 Capability parity with reference ``examples/pascal_pf.py``: SplineCNN ψ₁/ψ₂
 over KNN(8) graphs with Cartesian pseudo-coordinates, trained purely on
 random point-cloud pairs (30-60 inliers, 0-20 outliers, σ=0.05 jitter) and
-evaluated zero-shot on real PascalPF pairs per category. Flag surface
-matches the reference parser (``pascal_pf.py:12-20``).
+evaluated zero-shot on real PascalPF pairs per category. The flag surface
+covers the reference parser (``pascal_pf.py:12-20``) plus the framework's
+observability extras (``--profile``, ``--metrics_log``).
 
 Run: ``python examples/pascal_pf.py [--data_root ../data/PascalPF]``
 (the real-data eval is skipped with a notice when the dataset is absent —

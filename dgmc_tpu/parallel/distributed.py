@@ -25,9 +25,23 @@ _initialized = False
 
 
 def _already_initialized() -> bool:
-    """True when some other component already brought the runtime up."""
-    state = getattr(jax.distributed, 'global_state', None)
-    return getattr(state, 'client', None) is not None
+    """True when some other component already brought the runtime up.
+
+    JAX keeps this state in a private module (there is no public query),
+    so probe defensively — a failed probe just means the RuntimeError
+    fallback in :func:`initialize_distributed` handles it instead.
+    """
+    try:
+        from jax._src import distributed as _dist
+        return _dist.global_state.client is not None
+    except Exception:
+        return False
+
+
+# RuntimeError messages that mean "nothing to do", not "broken config":
+# the runtime is already up, or the XLA backend is already initialized in
+# a single-process script that called us late.
+_BENIGN = ('only be called once', 'before any JAX calls')
 
 
 def initialize_distributed(coordinator_address: Optional[str] = None,
@@ -35,7 +49,7 @@ def initialize_distributed(coordinator_address: Optional[str] = None,
                            process_id: Optional[int] = None) -> int:
     """Bring up the JAX distributed runtime (idempotent).
 
-    Must run before any JAX backend initialization. With no arguments,
+    Best called before any JAX backend initialization. With no arguments,
     cluster detection is delegated to ``jax.distributed.initialize`` (TPU
     pods, SLURM, Open MPI, ...); in a plain single-process launch that
     detection fails and this becomes a no-op returning 1, so scripts can
@@ -47,18 +61,23 @@ def initialize_distributed(coordinator_address: Optional[str] = None,
         _initialized = True
         return jax.process_count()
     explicit = (coordinator_address is not None
-                or num_processes not in (None, 1))
-    if explicit:
-        jax.distributed.initialize(
-            coordinator_address=coordinator_address,
-            num_processes=num_processes,
-            process_id=process_id)
-    else:
-        try:
-            jax.distributed.initialize()
-        except ValueError:
-            # No cluster environment detected: single-process launch.
-            pass
+                or num_processes not in (None, 1)
+                or process_id is not None)
+    try:
+        if explicit:
+            jax.distributed.initialize(
+                coordinator_address=coordinator_address,
+                num_processes=num_processes,
+                process_id=process_id)
+        else:
+            try:
+                jax.distributed.initialize()
+            except ValueError:
+                # No cluster environment detected: single-process launch.
+                pass
+    except RuntimeError as e:
+        if not any(m in str(e) for m in _BENIGN):
+            raise
     _initialized = True
     return jax.process_count()
 

@@ -147,3 +147,16 @@ def test_dbp15k_resumes_mid_schedule(dbp_root, tmp_path, capsys):
 
     lines = (tmp_path / 'metrics.jsonl').read_text().splitlines()
     assert any(json.loads(ln).get('phase') == 2 for ln in lines)
+
+
+def test_dbp15k_model_shards_cli(dbp_root):
+    """The --model_shards flag drives the GSPMD corr-sharded path (the
+    scale-out axis the reference lacks); on the virtual 8-device CPU
+    platform two model shards must train and evaluate end to end."""
+    from examples import dbp15k
+    state = dbp15k.main([
+        '--category', 'zh_en', '--data_root', str(dbp_root),
+        '--dim', '8', '--rnd_dim', '4', '--num_layers', '1',
+        '--num_steps', '1', '--k', '2', '--epochs', '2',
+        '--phase1_epochs', '1', '--model_shards', '2'])
+    assert state is not None
