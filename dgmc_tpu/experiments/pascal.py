@@ -15,6 +15,7 @@ import os
 import time
 
 import jax
+import jax.numpy as jnp
 
 from dgmc_tpu.data import Cartesian, Compose, Delaunay, Distance, FaceToEdge
 from dgmc_tpu.models import DGMC, SplineCNN
@@ -100,19 +101,24 @@ def main(argv=None):
         nonlocal key
         loader = PairLoader(pairs, args.batch_size, shuffle=False,
                             num_nodes=num_nodes, num_edges=num_edges)
-        correct = n = 0.0
+        # Correct-counts accumulate device-side; only the running sample
+        # count is fetched per batch (one round trip instead of two — the
+        # count gates the reference's sample-until-N protocol, reference
+        # pascal.py:88-99).
+        correct = jnp.zeros(())
+        n = 0.0
         while n < args.test_samples:
             seen = n
             for batch in loader:
                 key, sub = jax.random.split(key)
                 out = eval_step(state, batch, sub)
-                correct += float(out['correct'])
+                correct = correct + out['correct']
                 n += float(out['count'])
                 if n >= args.test_samples:
-                    return correct / n
+                    return float(correct) / n
             if n == seen:  # empty split / no valid GT: avoid spinning
                 break
-        return correct / max(n, 1)
+        return float(correct) / max(n, 1)
 
     # Auto-resume at epoch granularity. Unlike dbp15k the per-epoch PRNG
     # stream depends on the shuffled batch count, so a resumed run's stream
@@ -126,13 +132,15 @@ def main(argv=None):
         logger.log(start_epoch - 1, event='resume')
     for epoch in range(start_epoch, args.epochs + 1):
         t0 = time.time()
-        total = 0.0
+        total = jnp.zeros(())  # device-side; one fetch per epoch
         with trace(args.profile if epoch == profile_epoch else None):
             for batch in train_loader:
                 key, sub = jax.random.split(key)
                 state, out = step(state, batch, sub)
-                total += float(out['loss'])
-        loss = total / len(train_loader)
+                total = total + out['loss']
+            if args.profile and epoch == profile_epoch:
+                float(total)  # keep the trace open until execution ends
+        loss = float(total) / len(train_loader)
         print(f'Epoch: {epoch:02d}, Loss: {loss:.4f}, '
               f'{time.time() - t0:.1f}s')
 

@@ -17,6 +17,7 @@ import os
 import time
 
 import jax
+import jax.numpy as jnp
 
 from dgmc_tpu.data import (Cartesian, Compose, Constant, KNNGraph,
                            RandomGraphPairs)
@@ -90,16 +91,24 @@ def main(argv=None):
     for epoch in range(1, args.epochs + 1):
         train_loader.dataset.set_epoch(epoch)
         t0 = time.time()
-        tot_loss = tot_correct = tot_n = 0.0
+        # Accumulate device-side; a single batched fetch per epoch (every
+        # scalar fetch is a full round trip on tunneled devices).
+        tot_loss = jnp.zeros(())
+        tot_correct = jnp.zeros(())
+        tot_n = 0.0
         with trace(args.profile if epoch == profile_epoch else None):
             for batch in train_loader:
                 key, sub = jax.random.split(key)
                 state, out = step(state, batch, sub)
-                tot_loss += float(out['loss'])
-                tot_correct += float(out['acc']) * float(batch.y_mask.sum())
-                tot_n += float(batch.y_mask.sum())
-        loss = tot_loss / len(train_loader)
-        acc = tot_correct / max(tot_n, 1)
+                tot_loss = tot_loss + out['loss']
+                n_b = float(batch.y_mask.sum())
+                tot_correct = tot_correct + out['acc'] * n_b
+                tot_n += n_b
+            if args.profile and epoch == profile_epoch:
+                float(tot_loss)  # keep the trace open until execution ends
+        host = jax.device_get({'l': tot_loss, 'c': tot_correct})
+        loss = float(host['l']) / len(train_loader)
+        acc = float(host['c']) / max(tot_n, 1)
         print(f'Epoch: {epoch:02d}, Loss: {loss:.4f},'
               f' Acc: {acc:.2f},'
               f' {time.time() - t0:.1f}s')
@@ -118,10 +127,10 @@ def main(argv=None):
                     b = pad_pair_batch([pair], n_pad, e_pad)
                     key, sub = jax.random.split(key)
                     _, S_L = eval_fn(state, b, sub)
-                    correct += float(metrics.acc(S_L, b.y, b.y_mask,
-                                                 reduction='sum'))
+                    correct = correct + metrics.acc(S_L, b.y, b.y_mask,
+                                                    reduction='sum')
                     n += float(b.y_mask.sum())
-                accs.append(100 * correct / max(n, 1))
+                accs.append(100 * float(correct) / max(n, 1))
             accs.append(sum(accs) / len(accs))
             print(' '.join(c[:5].ljust(5) for c in CATEGORIES) + ' mean')
             print(' '.join(f'{a:.1f}'.ljust(5) for a in accs))

@@ -17,6 +17,7 @@ import os
 import time
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from dgmc_tpu.data import Cartesian, Compose, Delaunay, Distance, FaceToEdge
@@ -109,13 +110,13 @@ def main(argv=None):
     print('Pretraining model on PascalVOC...')
     for epoch in range(1, args.pre_epochs + 1):
         t0 = time.time()
-        total = 0.0
+        total = jnp.zeros(())  # device-side; one fetch per epoch
         for batch in pretrain_loader:
             key, sub = jax.random.split(key)
             state, out = step(state, batch, sub)
-            total += float(out['loss'])
+            total = total + out['loss']
         print(f'Epoch: {epoch:02d}, '
-              f'Loss: {total / len(pretrain_loader):.4f}, '
+              f'Loss: {float(total) / len(pretrain_loader):.4f}, '
               f'{time.time() - t0:.1f}s')
     snapshot = snapshot_params(state)
     print('Done!')
@@ -149,13 +150,15 @@ def main(argv=None):
                 b = pad_pair_batch([pair], num_nodes, num_edges)
                 key, sub = jax.random.split(key)
                 out = eval_step(run_state, b, sub)
-                correct += float(out['correct'])
+                # Device-side correct; only the protocol-gating count is
+                # fetched per pair.
+                correct = correct + out['correct']
                 n += float(out['count'])
                 if n >= args.test_samples:
-                    return correct / n
+                    return float(correct) / n
             if n == seen:  # empty split: avoid spinning forever
                 break
-        return correct / max(n, 1)
+        return float(correct) / max(n, 1)
 
     def run(i):
         nonlocal key

@@ -38,10 +38,13 @@ def _already_initialized() -> bool:
         return False
 
 
-# RuntimeError messages that mean "nothing to do", not "broken config":
-# the runtime is already up, or the XLA backend is already initialized in
-# a single-process script that called us late.
-_BENIGN = ('only be called once', 'before any JAX calls')
+# RuntimeError message meaning the runtime is already up — benign on any
+# path (a launcher beat us to it). The "called after backend init" error
+# is benign ONLY for the auto-detect path (a single-process script calling
+# late); an explicit multi-process request that cannot be honored must
+# fail loudly, not degrade into isolated single-process jobs.
+_BENIGN_ALWAYS = ('only be called once',)
+_BENIGN_AUTO = ('only be called once', 'before any JAX calls')
 
 
 def initialize_distributed(coordinator_address: Optional[str] = None,
@@ -63,21 +66,24 @@ def initialize_distributed(coordinator_address: Optional[str] = None,
     explicit = (coordinator_address is not None
                 or num_processes not in (None, 1)
                 or process_id is not None)
-    try:
-        if explicit:
+    if explicit:
+        try:
             jax.distributed.initialize(
                 coordinator_address=coordinator_address,
                 num_processes=num_processes,
                 process_id=process_id)
-        else:
-            try:
-                jax.distributed.initialize()
-            except ValueError:
-                # No cluster environment detected: single-process launch.
-                pass
-    except RuntimeError as e:
-        if not any(m in str(e) for m in _BENIGN):
-            raise
+        except RuntimeError as e:
+            if not any(m in str(e) for m in _BENIGN_ALWAYS):
+                raise
+    else:
+        try:
+            jax.distributed.initialize()
+        except ValueError:
+            # No cluster environment detected: single-process launch.
+            pass
+        except RuntimeError as e:
+            if not any(m in str(e) for m in _BENIGN_AUTO):
+                raise
     _initialized = True
     return jax.process_count()
 

@@ -77,3 +77,19 @@ def test_genuine_failures_propagate(monkeypatch):
     monkeypatch.setattr(jax.distributed, 'initialize', broken)
     with pytest.raises(RuntimeError, match='unreachable'):
         initialize_distributed('host:1234', 4, 0)
+
+
+def test_explicit_path_fails_loudly_after_backend_init(monkeypatch):
+    """An explicit multi-process request that cannot be honored (backend
+    already up) must raise, not silently degrade to isolated
+    single-process jobs."""
+    monkeypatch.setattr(distributed, '_already_initialized', lambda: False)
+
+    def late(**kw):
+        raise RuntimeError(
+            'jax.distributed.initialize() must be called before any JAX '
+            'calls that might initialise the XLA backend')
+
+    monkeypatch.setattr(jax.distributed, 'initialize', late)
+    with pytest.raises(RuntimeError, match='before any JAX calls'):
+        initialize_distributed('host:1234', 4, 0)
