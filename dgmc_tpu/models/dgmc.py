@@ -205,11 +205,15 @@ class DGMC(nn.Module):
 
             if self.fused_consensus is None:
                 from dgmc_tpu.ops.pallas.consensus import TILE_S, TILE_T
+                from dgmc_tpu.ops.pallas.dispatch import (
+                    fused_kernels_allowed)
                 # R ceiling: the kernel holds two [TILE_S*TILE_T, R] f32
                 # tiles in VMEM (64 KiB x R each); measurements cover
                 # R <= 128 (benchmarks/fused_consensus_tpu.json) and
                 # R = 256 would blow the 16 MB scoped-VMEM limit.
                 use_fused = (jax.default_backend() == 'tpu'
+                             and fused_kernels_allowed()
+                             and not jax.typeof(h_s).vma
                              and N_s >= TILE_S and N_t >= TILE_T
                              and R_out <= 128)
             else:

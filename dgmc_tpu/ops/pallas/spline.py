@@ -33,7 +33,6 @@ import functools
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
@@ -134,11 +133,15 @@ def route_aggregate(t, flat, basis, receivers, edge_mask, num_nodes,
     t: ``[B, M, O]`` node-through-all-kernels features (``M = N * K^D``);
     flat: ``[B, E, A]`` flattened (sender, knot) indices; basis:
     ``[B, E, A]`` weights; receivers ``[B, E]``; edge_mask ``[B, E]``.
-    Returns ``[B, N, O]``. Linear in ``t``; routing inputs carry no
-    gradients (they derive from edge data).
+    Returns ``[B, N, O]``. Bilinear in ``(t, basis)``: ``t`` cotangents come
+    from the tiled backward kernel; ``basis`` cotangents (gradients w.r.t.
+    edge attributes, which the unfused gather+einsum path propagates too)
+    are computed analytically — but only when ``basis`` is actually being
+    differentiated (``symbolic_zeros`` perturbation flag), so the common
+    training path, where edge attributes are data, pays nothing for them.
     """
-    out, _ = _fwd(t, flat, basis, receivers, edge_mask, num_nodes,
-                  interpret)
+    out, _ = _fwd_impl(t, flat, basis, receivers, edge_mask, num_nodes,
+                       interpret)
     return out
 
 
@@ -152,7 +155,7 @@ def _prep(flat, basis, receivers, edge_mask):
             jax.lax.stop_gradient(basis_t), rcv, emask_f)
 
 
-def _fwd(t, flat, basis, receivers, edge_mask, num_nodes, interpret):
+def _fwd_impl(t, flat, basis, receivers, edge_mask, num_nodes, interpret):
     B, M, O = t.shape
     pad = (-M) % M_TILE
     t_p = jnp.pad(t, ((0, 0), (0, pad), (0, 0))) if pad else t
@@ -175,8 +178,25 @@ def _fwd(t, flat, basis, receivers, edge_mask, num_nodes, interpret):
     return out, (M, flat_t, basis_t, rcv, emask_f)
 
 
+def _fwd(t, flat, basis, receivers, edge_mask, num_nodes, interpret):
+    # symbolic_zeros=True: every differentiable-position arg arrives as a
+    # CustomVJPPrimal carrying a .perturbed flag. ``t`` is saved for the
+    # analytic basis cotangent only when basis is actually differentiated.
+    vals = (t.value, flat.value, basis.value, receivers.value,
+            edge_mask.value)
+    out, res = _fwd_impl(*vals, num_nodes, interpret)
+    extra = vals if basis.perturbed else None
+    return out, (res, extra)
+
+
+def _symzero(shape, dtype):
+    from jax.custom_derivatives import SymbolicZero
+    aval = jax.typeof(jax.ShapeDtypeStruct(shape, dtype))
+    return SymbolicZero(aval.to_tangent_aval())
+
+
 def _bwd(num_nodes, interpret, res, g):
-    M, flat_t, basis_t, rcv, emask_f = res
+    (M, flat_t, basis_t, rcv, emask_f), extra = res
     B, _, O = g.shape
     E = flat_t.shape[2]
     pad = (-M) % M_TILE
@@ -193,14 +213,30 @@ def _bwd(num_nodes, interpret, res, g):
         scratch_shapes=[pltpu.VMEM((E, O), jnp.float32)],
         interpret=interpret,
     )(g, flat_t, basis_t, rcv, emask_f)[:, :M]
-    zeros_f = jnp.zeros((B, E, flat_t.shape[1]), jnp.float32)
-    zeros_i = np.zeros((B, E, flat_t.shape[1]), dtype=jax.dtypes.float0)
-    zeros_r = np.zeros((B, E), dtype=jax.dtypes.float0)
-    zeros_m = np.zeros((B, E), dtype=jax.dtypes.float0)
-    return d_t, zeros_i, zeros_f, zeros_r, zeros_m
+
+    A = flat_t.shape[1]
+    if extra is None:
+        d_basis = _symzero((B, E, A), jnp.float32)
+    else:
+        # d_basis[b,e,a] = mask_e * sum_o (g/deg)[b, rcv_e, o]
+        #                           * t[b, flat[b,e,a], o]
+        # — the same cotangent the unfused gather+einsum path produces.
+        t_v, flat_v, basis_v, receivers_v, edge_mask_v = extra
+        emask = edge_mask_v.astype(g.dtype)
+        deg = jax.vmap(lambda r, m: jax.ops.segment_sum(
+            m, r, num_segments=num_nodes))(receivers_v, emask)
+        g_norm = g / jnp.maximum(deg, 1.0)[..., None]
+        dmsgs = jnp.take_along_axis(g_norm, receivers_v[..., None], axis=1)
+        picked = jnp.take_along_axis(
+            t_v, flat_v.reshape(B, E * A, 1), axis=1).reshape(B, E, A, O)
+        d_basis = (jnp.einsum('beo,beao->bea', dmsgs, picked)
+                   * emask[..., None]).astype(basis_v.dtype)
+
+    return (d_t, _symzero((B, E, A), jnp.int32), d_basis,
+            _symzero((B, E), jnp.int32), _symzero((B, E), jnp.bool_))
 
 
-route_aggregate.defvjp(_fwd, _bwd)
+route_aggregate.defvjp(_fwd, _bwd, symbolic_zeros=True)
 
 
 def route_aggregate_fits(num_nodes, num_edges, kd, out_features):

@@ -43,8 +43,6 @@ def dense_topk(h_s, h_t, k, t_mask=None):
     return jax.lax.top_k(scores, k)[1]
 
 
-@functools.partial(jax.jit,
-                   static_argnames=('k', 'block', 'return_values', 'pallas'))
 def chunked_topk(h_s, h_t, k, t_mask=None, block=1024, return_values=False,
                  pallas=None):
     """Blockwise running top-k of ``h_s @ h_t^T`` along the target axis.
@@ -67,13 +65,28 @@ def chunked_topk(h_s, h_t, k, t_mask=None, block=1024, return_values=False,
     GSPMD-partitioned programs (pallas_call has no partitioning rule;
     :class:`~dgmc_tpu.models.DGMC` does this when ``corr_sharding`` is
     set).
+
+    The auto decision is resolved *here*, in an un-jitted wrapper, and
+    passed down as a static arg: it reads a trace-time contextvar
+    (:func:`~dgmc_tpu.ops.pallas.dispatch.fused_kernels_allowed`) that a
+    nested ``jax.jit`` cache would otherwise bake into a cached jaxpr and
+    never consult again.
     """
+    if pallas is None:
+        from dgmc_tpu.ops.pallas import dispatch
+        pallas = (dispatch.fused_kernels_allowed()
+                  and jax.default_backend() == 'tpu'
+                  and not jax.typeof(h_s).vma)
+    return _chunked_topk(h_s, h_t, k, t_mask, block, return_values,
+                         bool(pallas))
+
+
+@functools.partial(jax.jit,
+                   static_argnames=('k', 'block', 'return_values', 'pallas'))
+def _chunked_topk(h_s, h_t, k, t_mask, block, return_values, pallas):
     h_s = jax.lax.stop_gradient(h_s)
     h_t = jax.lax.stop_gradient(h_t)
     B, N_s, C = h_s.shape
-    if pallas is None:
-        pallas = (jax.default_backend() == 'tpu'
-                  and not jax.typeof(h_s).vma)
     if pallas:
         from dgmc_tpu.ops.pallas.topk import BLOCK_T, pallas_topk
         if k <= BLOCK_T:

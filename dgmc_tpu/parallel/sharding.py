@@ -21,8 +21,27 @@ protocol — that is the point of the XLA-collective design.
 import jax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from dgmc_tpu.ops.pallas.dispatch import disable_fused_kernels
 from dgmc_tpu.parallel.mesh import DATA_AXIS
 from dgmc_tpu.train import steps as _steps
+
+
+def _gspmd_safe(step, mesh):
+    """Trace ``step`` with auto-dispatched Pallas kernels silenced whenever
+    the mesh actually partitions (``pallas_call`` has no GSPMD partitioning
+    rule — inside a partitioned program it crashes or silently replicates).
+    ``jax.typeof(...).vma`` only detects ``shard_map`` manual mode, not
+    ``jax.jit(in_shardings=...)`` auto-partitioning, so every auto gate must
+    be turned off here at trace time. A single-device mesh never partitions,
+    so the kernels stay on there."""
+    if mesh.size <= 1:
+        return step
+
+    def traced(*args):
+        with disable_fused_kernels():
+            return step(*args)
+
+    return traced
 
 
 def replicate(tree, mesh):
@@ -51,7 +70,7 @@ def make_sharded_train_step(model, mesh, loss_on_s0=False, num_steps=None,
                                   hits_ks=hits_ks, jit=False)
     repl = NamedSharding(mesh, P())
     batched = NamedSharding(mesh, P(batch_axis))
-    return jax.jit(step,
+    return jax.jit(_gspmd_safe(step, mesh),
                    in_shardings=(repl, batched, repl),
                    out_shardings=(repl, repl),
                    donate_argnums=(0,))
@@ -63,5 +82,6 @@ def make_sharded_eval_step(model, mesh, hits_ks=(1,), num_steps=None,
                                  detach=detach, jit=False)
     repl = NamedSharding(mesh, P())
     batched = NamedSharding(mesh, P(batch_axis))
-    return jax.jit(step, in_shardings=(repl, batched, repl),
+    return jax.jit(_gspmd_safe(step, mesh),
+                   in_shardings=(repl, batched, repl),
                    out_shardings=repl)

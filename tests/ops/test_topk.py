@@ -36,3 +36,25 @@ def test_tie_breaking_prefers_lower_index():
     idx_c = chunked_topk(h_s, h_t, 4, block=4)
     np.testing.assert_array_equal(idx_d, np.tile(np.arange(4), (1, 3, 1)))
     np.testing.assert_array_equal(idx_c, idx_d)
+
+
+def test_auto_gate_resolved_per_call_not_cached(monkeypatch):
+    """The pallas auto-dispatch decision must be re-read on every call: a
+    jitted wrapper would bake the trace-time contextvar into a cached jaxpr
+    and never consult disable_fused_kernels() again (the nested-jit cache
+    ignores contextvars)."""
+    from dgmc_tpu.ops.pallas import dispatch
+
+    calls = []
+    real = dispatch.fused_kernels_allowed
+
+    def counting():
+        calls.append(True)
+        return real()
+
+    monkeypatch.setattr(dispatch, 'fused_kernels_allowed', counting)
+    h_s = jnp.ones((1, 4, 2))
+    h_t = jnp.ones((1, 8, 2))
+    chunked_topk(h_s, h_t, 2, block=4)
+    chunked_topk(h_s, h_t, 2, block=4)  # same shapes: jit cache hit inside
+    assert len(calls) == 2

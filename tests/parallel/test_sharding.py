@@ -69,3 +69,30 @@ def test_corr_sharding_matches_unconstrained(k):
     _, sh_out = sh_step(state_sh, replicate(batch, mesh), key)
     assert float(sh_out['loss']) == pytest.approx(float(ref_out['loss']),
                                                   rel=1e-4)
+
+
+def test_gspmd_safe_disables_auto_kernels_at_trace_time():
+    """jax.jit(in_shardings=...) partitioning is invisible to
+    jax.typeof(...).vma, so the sharded step builders must silence every
+    auto-dispatched Pallas gate while tracing (a pallas_call inside a
+    GSPMD-partitioned program crashes or silently replicates)."""
+    import jax.numpy as jnp
+
+    from dgmc_tpu.ops.pallas.dispatch import fused_kernels_allowed
+    from dgmc_tpu.parallel.sharding import _gspmd_safe
+
+    seen = []
+
+    def probe(x):
+        seen.append(fused_kernels_allowed())
+        return x * 2
+
+    mesh = make_mesh(data=4, model=2)
+    jax.jit(_gspmd_safe(probe, mesh))(jnp.ones(8))
+    assert seen == [False]
+
+    # A single-device mesh never partitions: kernels stay enabled.
+    seen.clear()
+    mesh1 = make_mesh(data=1, model=1, devices=jax.devices()[:1])
+    jax.jit(_gspmd_safe(probe, mesh1))(jnp.ones(8))
+    assert seen == [True]
