@@ -1,0 +1,61 @@
+"""Micro-benchmark: RelCNN psi_2 forward+backward, separate vs unioned.
+
+Probes why merging the per-step psi_2 pair applications changed the
+DBP15K-scale consensus iteration cost (benchmarks/sparse_diag.py).
+"""
+
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
+    __file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from timing import best_of, fence  # noqa: E402
+
+
+def main():
+    import bench
+    from dgmc_tpu.models import RelCNN
+    from dgmc_tpu.ops.graph import pair_apply, union_pair_graphs
+
+    rng = np.random.RandomState(0)
+    g_s = jax.device_put(bench._kg_side(bench.SP_N_S, bench.SP_E_S, 32, rng))
+    g_t = jax.device_put(bench._kg_side(bench.SP_N_T, bench.SP_E_T, 32, rng))
+    g_u = jax.device_put(union_pair_graphs(g_s, g_t))
+    jax.block_until_ready((g_s, g_t, g_u))
+
+    psi = RelCNN(32, 32, num_layers=3)
+    params = psi.init(jax.random.PRNGKey(0), g_s.x, g_s)
+
+    def sep_loss(p, xs, xt):
+        os_ = psi.apply(p, xs, g_s)
+        ot_ = psi.apply(p, xt, g_t)
+        return os_.sum() + ot_.sum()
+
+    def uni_loss(p, xs, xt):
+        os_, ot_ = pair_apply(lambda x, g: psi.apply(p, x, g), g_u, xs, xt)
+        return os_.sum() + ot_.sum()
+
+    xs, xt = g_s.x, g_t.x
+    for name, fn in (('separate', sep_loss), ('union', uni_loss)):
+        for mode, f in (('fwd', jax.jit(fn)),
+                        ('fwd+bwd', jax.jit(jax.grad(fn)))):
+            out = f(params, xs, xt)
+            fence(jax.tree_util.tree_leaves(out)[0].ravel()[0])
+
+            def window(f=f):
+                out = None
+                for _ in range(20):
+                    out = f(params, xs, xt)
+                fence(jax.tree_util.tree_leaves(out)[0].ravel()[0])
+            ms = best_of(window) / 20 * 1e3
+            print(f'{name:9s} {mode:8s}: {ms:6.2f} ms')
+
+
+if __name__ == '__main__':
+    main()

@@ -1,0 +1,67 @@
+"""Probe the TPU cost model of the graph primitive ops.
+
+Times gather (take_along_axis) and segment_sum at DBP15K-like sizes,
+varying table size, update count, width, sortedness, and the
+indices_are_sorted/unique hints — to find which formulation the rest of
+the framework should standardize on.
+"""
+
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from timing import best_of, fence  # noqa: E402
+
+
+def timeit(name, f, *args):
+    f = jax.jit(f)
+    out = f(*args)
+    fence(out.ravel()[0])
+
+    def window():
+        o = None
+        for _ in range(30):
+            o = f(*args)
+        fence(o.ravel()[0])
+    ms = best_of(window) / 30 * 1e3
+    print(f'{name:48s}: {ms:6.2f} ms')
+
+
+def main():
+    rng = np.random.RandomState(0)
+    for n, e, c in ((20000, 120000, 32), (35000, 220000, 32),
+                    (20000, 120000, 256)):
+        print(f'--- N={n} E={e} C={c} ---')
+        x = jnp.asarray(rng.randn(n, c).astype(np.float32))
+        xb = x[None]
+        idx = jnp.asarray(rng.randint(0, n, e).astype(np.int32))
+        idx_sorted = jnp.sort(idx)
+        msgs = jnp.asarray(rng.randn(e, c).astype(np.float32))
+
+        timeit('gather take_along_axis [1,N,C]',
+               lambda xb, i: jnp.take_along_axis(xb, i[None, :, None],
+                                                 axis=1), xb, idx)
+        timeit('gather x[idx] flat', lambda x, i: x[i], x, idx)
+        timeit('segment_sum unsorted',
+               lambda m, i: jax.ops.segment_sum(m, i, num_segments=n),
+               msgs, idx)
+        timeit('segment_sum sorted (no hint)',
+               lambda m, i: jax.ops.segment_sum(m, i, num_segments=n),
+               msgs, idx_sorted)
+        timeit('segment_sum sorted + hint',
+               lambda m, i: jax.ops.segment_sum(m, i, num_segments=n,
+                                                indices_are_sorted=True),
+               msgs, idx_sorted)
+        timeit('segment_sum vmap B=1 unsorted',
+               lambda m, i: jax.vmap(lambda mm, ii: jax.ops.segment_sum(
+                   mm, ii, num_segments=n))(m[None], i[None]),
+               msgs, idx)
+
+
+if __name__ == '__main__':
+    main()

@@ -67,17 +67,26 @@ class DBP15K:
         train_y, test_y: ``[2, M]`` int64 alignment pairs in *local* indices.
     """
 
-    def __init__(self, root, pair):
+    def __init__(self, root, pair, download=False):
         if pair not in PAIRS:
             raise ValueError(f'pair must be one of {PAIRS}, got {pair!r}')
         self.root = os.path.expanduser(root)
         self.pair = pair
         d = os.path.join(self.root, pair)
         if not os.path.isdir(d):
-            raise FileNotFoundError(
-                f'DBP15K raw data not found at {d}. Download the DBP15K '
-                f'(JAPE) release and extract it so that {d}/triples_1 '
-                f'exists; this environment does not download datasets.')
+            if download:
+                from dgmc_tpu.datasets.download import download_and_extract
+                download_and_extract('dbp15k', self.root)
+                for sub in ('DBP15K', 'DBP15k'):  # flatten release nesting
+                    nested = os.path.join(self.root, sub, pair)
+                    if not os.path.isdir(d) and os.path.isdir(nested):
+                        d = nested
+            if not os.path.isdir(d):
+                raise FileNotFoundError(
+                    f'DBP15K raw data not found at {d}. Download the '
+                    f'DBP15K (JAPE) release and extract it so that '
+                    f'{d}/triples_1 exists, or pass download=True on a '
+                    f'networked machine.')
         self._load(d)
 
     def _load(self, d):

@@ -1,0 +1,77 @@
+"""Opt-in dataset acquisition (parity with PyG's auto-download).
+
+The reference gets download/extract/cache for free from PyG datasets
+(reference ``examples/dbp15k.py:5,27``); this module provides the same
+for networked machines while keeping the offline default: every loader
+raises with placement instructions unless ``download=True`` is passed.
+
+URLs mirror the sources the PyG dataset classes use. This build
+environment has no egress, so they are best-effort: verified structure,
+unverifiable liveness — a failed fetch reports the URL and leaves the
+offline instructions intact.
+"""
+
+import os
+import shutil
+import tarfile
+import urllib.request
+import zipfile
+
+URLS = {
+    'dbp15k': 'https://www.dropbox.com/s/rb9rwgqxilkqf8p/DBP15K.zip?dl=1',
+    'voc2011': ('http://host.robots.ox.ac.uk/pascal/VOC/voc2011/'
+                'VOCtrainval_25-May-2011.tar'),
+    'voc_keypoints': ('https://www2.eecs.berkeley.edu/Research/Projects/'
+                      'CS/vision/shape/poselets/'
+                      'voc2011_keypoints_Feb2012.tgz'),
+    'willow': ('http://www.di.ens.fr/willow/research/graphlearning/'
+               'WILLOW-ObjectClass_dataset.zip'),
+    'pascal_pf': ('http://www.di.ens.fr/willow/research/proposalflow/'
+                  'dataset/PF-dataset-PASCAL.zip'),
+}
+
+
+def fetch(url, dest_path, progress=True):
+    """Stream ``url`` to ``dest_path`` (atomic via .part rename)."""
+    os.makedirs(os.path.dirname(os.path.abspath(dest_path)), exist_ok=True)
+    part = dest_path + '.part'
+    try:
+        with urllib.request.urlopen(url, timeout=60) as r, \
+                open(part, 'wb') as f:
+            shutil.copyfileobj(r, f)
+    except Exception as e:
+        if os.path.exists(part):
+            os.remove(part)
+        raise RuntimeError(
+            f'download failed for {url}: {e}; fetch it manually and place '
+            f'it per the loader instructions') from e
+    os.replace(part, dest_path)
+    return dest_path
+
+
+def extract(archive, dest_dir):
+    """Extract a .zip/.tar/.tgz/.tar.gz archive into ``dest_dir``."""
+    os.makedirs(dest_dir, exist_ok=True)
+    if zipfile.is_zipfile(archive):
+        with zipfile.ZipFile(archive) as z:
+            z.extractall(dest_dir)
+    elif tarfile.is_tarfile(archive):
+        with tarfile.open(archive) as t:
+            t.extractall(dest_dir)
+    else:
+        raise ValueError(f'unrecognized archive format: {archive}')
+    return dest_dir
+
+
+def download_and_extract(key, root, keep_archive=False):
+    """Fetch the named dataset archive (see ``URLS``) into ``root`` and
+    extract it there. Returns ``root``."""
+    url = URLS[key]
+    name = os.path.basename(url.split('?')[0])
+    archive = os.path.join(root, name)
+    if not os.path.exists(archive):
+        fetch(url, archive)
+    extract(archive, root)
+    if not keep_archive:
+        os.remove(archive)
+    return root

@@ -35,17 +35,21 @@ class PascalPF:
     ``self.pairs`` is a list of (name_s, name_t) evaluation pairs.
     """
 
-    def __init__(self, root, category, transform=None):
+    def __init__(self, root, category, transform=None, download=False):
         if category not in CATEGORIES:
             raise ValueError(f'unknown category {category!r}')
         self.root = os.path.expanduser(root)
         self.category = category
         self.transform = transform
         base = os.path.join(self.root, 'PF-dataset-PASCAL')
+        if not os.path.isdir(base) and download:
+            from dgmc_tpu.datasets.download import download_and_extract
+            download_and_extract('pascal_pf', self.root)
         if not os.path.isdir(base):
             raise FileNotFoundError(
                 f'PascalPF raw data not found at {base}; place the '
-                f'PF-dataset-PASCAL release there (no downloads attempted).')
+                f'PF-dataset-PASCAL release there, or pass download=True '
+                f'on a networked machine.')
         self._load(base)
 
     def _load(self, base):

@@ -77,7 +77,7 @@ class PascalVOCKeypoints:
 
     def __init__(self, root, category, train=True, transform=None,
                  pre_filter=None, features=None, device_features=None,
-                 train_fraction=0.8):
+                 train_fraction=0.8, download=False):
         if category not in CATEGORIES:
             raise ValueError(f'unknown category {category!r}')
         self.root = os.path.expanduser(root)
@@ -89,10 +89,16 @@ class PascalVOCKeypoints:
         self.features = features
 
         ann_dir = os.path.join(self.root, 'annotations', category)
+        if not os.path.isdir(ann_dir) and download:
+            from dgmc_tpu.datasets.download import download_and_extract
+            download_and_extract('voc_keypoints', self.root)
+            download_and_extract('voc2011', self.root)
+            self._normalize_download_layout()
         if not os.path.isdir(ann_dir):
             raise FileNotFoundError(
-                f'Berkeley keypoint annotations not found at {ann_dir} '
-                f'(no downloads attempted).')
+                f'Berkeley keypoint annotations not found at {ann_dir}; '
+                f'place them there, or pass download=True on a networked '
+                f'machine.')
 
         # The keypoint-name vocabulary of this category, fixed by sorted
         # first appearance across the split — the class index ValidPairDataset
@@ -230,12 +236,41 @@ class PascalVOCKeypoints:
         os.makedirs(os.path.dirname(self._cache_path), exist_ok=True)
         np.savez(self._cache_path, **cache)
 
+    def _normalize_download_layout(self):
+        """Map freshly extracted archives onto the layout this loader
+        reads: the VOC tar unpacks as ``TrainVal/VOCdevkit/VOC2011/...``
+        and the Berkeley tgz may nest its ``annotations`` dir — locate
+        ``JPEGImages`` / ``ImageSets/Main`` / ``annotations`` wherever
+        they landed and symlink them to ``<root>/{images,ImageSets,
+        annotations}``."""
+        wanted = {'images': 'JPEGImages', 'ImageSets': 'ImageSets',
+                  'annotations': 'annotations'}
+        for link_name, dir_name in wanted.items():
+            link = os.path.join(self.root, link_name)
+            if os.path.exists(link):
+                continue
+            for cur, dirs, _ in os.walk(self.root):
+                if os.path.basename(cur) == dir_name and cur != link:
+                    os.symlink(os.path.abspath(cur), link)
+                    break
+
     def _image(self, image_name):
         from PIL import Image
         for ext in ('.jpg', '.png'):
             p = os.path.join(self.root, 'images', image_name + ext)
             if os.path.exists(p):
                 return np.asarray(Image.open(p).convert('RGB'))
+        # Warn once — but only when visual features are actually being
+        # extracted (weights='none' is deliberate structure-only mode).
+        if (not getattr(self, '_warned_missing_images', False)
+                and getattr(self.features, 'tag', None) != 'none'):
+            self._warned_missing_images = True
+            import warnings
+            warnings.warn(
+                f'no image found for {image_name!r} under '
+                f'{os.path.join(self.root, "images")}; visual features '
+                f'will be extracted from ZERO images (structure-only '
+                f'training). Place the VOC JPEGImages there to fix.')
         return np.zeros((256, 256, 3), np.uint8)
 
     def __len__(self):

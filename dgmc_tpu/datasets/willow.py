@@ -31,7 +31,7 @@ class WILLOWObjectClass:
     """One category of WILLOW-ObjectClass as a list-like of ``Graph`` s."""
 
     def __init__(self, root, category, transform=None, features=None,
-                 device_features=None):
+                 device_features=None, download=False):
         if category not in CATEGORIES:
             raise ValueError(f'unknown category {category!r}')
         self.root = os.path.expanduser(root)
@@ -43,6 +43,9 @@ class WILLOWObjectClass:
         self.features = features
         base = os.path.join(self.root, 'WILLOW-ObjectClass',
                             _DIRNAMES[category])
+        if not os.path.isdir(base) and download:
+            from dgmc_tpu.datasets.download import download_and_extract
+            download_and_extract('willow', self.root)
         if not os.path.isdir(base):
             base_alt = os.path.join(self.root, 'WILLOW-ObjectClass', category)
             if os.path.isdir(base_alt):
@@ -50,8 +53,8 @@ class WILLOWObjectClass:
             else:
                 raise FileNotFoundError(
                     f'WILLOW raw data not found at {base}; place the '
-                    f'WILLOW-ObjectClass release under {self.root} '
-                    f'(no downloads attempted).')
+                    f'WILLOW-ObjectClass release under {self.root}, or '
+                    f'pass download=True on a networked machine.')
         self._graphs = self._load(base)
 
     def _load(self, base):

@@ -48,11 +48,26 @@ def parse_args(argv=None):
                              'epoch into this directory')
     parser.add_argument('--metrics_log', type=str, default=None,
                         help='append per-epoch metrics to this JSONL file')
+    parser.add_argument('--coordinator', type=str, default=None,
+                        help='multi-host: coordinator address host:port '
+                             '(auto-detected on TPU pods / SLURM; pass '
+                             'explicitly elsewhere)')
+    parser.add_argument('--num_processes', type=int, default=None)
+    parser.add_argument('--process_id', type=int, default=None)
     return parser.parse_args(argv)
 
 
 def main(argv=None):
     args = parse_args(argv)
+    # Multi-host bring-up FIRST (no-op in a plain single-process launch):
+    # after this, jax.devices() spans every host and one data mesh drives
+    # cross-host gradient collectives (SURVEY.md §2.5's net-new backend).
+    from dgmc_tpu.parallel import (global_batch, initialize_distributed,
+                                   is_coordinator, local_batch_slice,
+                                   make_mesh, make_sharded_eval_step,
+                                   make_sharded_train_step)
+    nproc = initialize_distributed(args.coordinator, args.num_processes,
+                                   args.process_id)
     from dgmc_tpu.datasets import PascalVOCKeypoints, VGG16Features
     from dgmc_tpu.datasets.pascal_voc import CATEGORIES
 
@@ -92,8 +107,24 @@ def main(argv=None):
     batch0 = next(iter(train_loader))
     state = create_train_state(model, jax.random.key(args.seed), batch0,
                                learning_rate=args.lr)
-    step = make_train_step(model, loss_on_s0=True)
-    eval_step = make_eval_step(model)
+    if nproc > 1:
+        # Data-parallel over every device of every host. Each process runs
+        # the SAME deterministic loader (same seed ⇒ same batch order) and
+        # feeds only its contiguous slice of each batch; gradients combine
+        # through GSPMD's cross-host collectives automatically.
+        mesh = make_mesh(data=len(jax.devices()))
+        step = make_sharded_train_step(model, mesh, loss_on_s0=True)
+        eval_step = make_sharded_eval_step(model, mesh)
+        state = global_batch(state, mesh, replicate=True)
+
+        def feed(b):
+            return global_batch(local_batch_slice(b), mesh)
+    else:
+        step = make_train_step(model, loss_on_s0=True)
+        eval_step = make_eval_step(model)
+
+        def feed(b):
+            return b
 
     key = jax.random.key(args.seed + 2)
 
@@ -111,7 +142,7 @@ def main(argv=None):
             seen = n
             for batch in loader:
                 key, sub = jax.random.split(key)
-                out = eval_step(state, batch, sub)
+                out = eval_step(state, feed(batch), sub)
                 correct = correct + out['correct']
                 n += float(out['count'])
                 if n >= args.test_samples:
@@ -124,10 +155,13 @@ def main(argv=None):
     # stream depends on the shuffled batch count, so a resumed run's stream
     # differs from an uninterrupted one — acceptable here (the reference
     # protocol has no cross-epoch RNG contract for this workload).
+    # Orbax save/restore is a COLLECTIVE over global arrays: every process
+    # must participate (ckpt_dir must be a shared filesystem multi-host);
+    # only metric/stdout writes are coordinator-gated.
     ckpt, state, start_epoch = resume_or_init(args.ckpt_dir, state)
     profile_epoch = min(start_epoch + 1, args.epochs)
 
-    logger = MetricLogger(args.metrics_log)
+    logger = MetricLogger(args.metrics_log if is_coordinator() else None)
     if start_epoch > 1:
         logger.log(start_epoch - 1, event='resume')
     for epoch in range(start_epoch, args.epochs + 1):
@@ -136,18 +170,20 @@ def main(argv=None):
         with trace(args.profile if epoch == profile_epoch else None):
             for batch in train_loader:
                 key, sub = jax.random.split(key)
-                state, out = step(state, batch, sub)
+                state, out = step(state, feed(batch), sub)
                 total = total + out['loss']
             if args.profile and epoch == profile_epoch:
                 float(total)  # keep the trace open until execution ends
         loss = float(total) / len(train_loader)
-        print(f'Epoch: {epoch:02d}, Loss: {loss:.4f}, '
-              f'{time.time() - t0:.1f}s')
+        if is_coordinator():
+            print(f'Epoch: {epoch:02d}, Loss: {loss:.4f}, '
+                  f'{time.time() - t0:.1f}s')
 
         accs = [100 * test(ds) for ds in test_sets]
         accs.append(sum(accs) / len(accs))
-        print(' '.join(c[:5].ljust(5) for c in CATEGORIES) + ' mean')
-        print(' '.join(f'{a:.1f}'.ljust(5) for a in accs))
+        if is_coordinator():
+            print(' '.join(c[:5].ljust(5) for c in CATEGORIES) + ' mean')
+            print(' '.join(f'{a:.1f}'.ljust(5) for a in accs))
         logger.log(epoch, loss=loss, mean_acc=accs[-1])
         if ckpt:
             ckpt.save(epoch, state)

@@ -104,7 +104,7 @@ class DGMC(nn.Module):
     num_steps: int
     k: int = -1
     detach: bool = False
-    topk_block: int = 1024
+    topk_block: int = 256
     # Optional jax.sharding.NamedSharding for correspondence-shaped
     # intermediates [B, N_s, ...]: row-shards S_hat / S_idx over a mesh axis
     # so a single huge pair (DBP15K-scale) spreads its activation state
@@ -121,6 +121,20 @@ class DGMC(nn.Module):
     # unfused form wins (benchmarks/fused_consensus_tpu.json, bench.py).
     # Forced off when corr_sharding is set (GSPMD owns the layout there).
     fused_consensus: Optional[bool] = None
+    # Run each backbone ONCE per application point on the node-axis
+    # disjoint union of the (source, target) pair instead of twice (once
+    # per side). Requires blocked-adjacency graphs (ops/blocked.py) and a
+    # BatchNorm-free backbone (merged batch statistics would span both
+    # sides, unlike the reference's separate calls, reference
+    # ``dgmc/models/dgmc.py:149-150,173-176``). Default OFF: measured at
+    # DBP15K scale the union's halved op count is cancelled by its
+    # combined row gather crossing a ~2^19-row efficiency cliff (10 vs
+    # 31 GB/s), and with plain gather/scatter aggregation the union loses
+    # outright (58 vs 36 ms/consensus-iteration; batch-axis stacking
+    # loses harder still at 73 ms — TPU scatters with a batched leading
+    # dim are the slow path). Kept as an explicit option for platforms
+    # where dispatch overhead dominates.
+    batch_pair: Optional[bool] = None
 
     def _constrain(self, a):
         if self.corr_sharding is None:
@@ -169,8 +183,41 @@ class DGMC(nn.Module):
             with disable_fused_kernels():
                 return m(*args, **kw)
 
-        h_s = run_psi(self.psi_1, graph_s.x, graph_s, train=train)
-        h_t = run_psi(self.psi_1, graph_t.x, graph_t, train=train)
+        from dgmc_tpu.ops.blocked import UnionPair
+
+        can_stack = (
+            self.batch_pair is True
+            and (graph_s.edge_attr is None) == (graph_t.edge_attr is None)
+            and (graph_s.edge_attr is None
+                 or graph_s.edge_attr.shape[-1] == graph_t.edge_attr.shape[-1])
+            and graph_s.blocks_in is not None
+            and graph_t.blocks_in is not None
+            and graph_s.blocks_in.rows == graph_t.blocks_in.rows
+        )
+
+        def merges(m):
+            if not can_stack:
+                return False
+            if getattr(m, 'batch_norm', False):
+                raise ValueError(
+                    'batch_pair=True is invalid with a BatchNorm '
+                    'backbone: merged batch statistics would span '
+                    'both graphs')
+            return True
+
+        merge_1 = merges(self.psi_1) and (
+            graph_s.x.shape[-1] == graph_t.x.shape[-1])
+        merge_2 = merges(self.psi_2)
+        pair = UnionPair(graph_s, graph_t) if (merge_1 or merge_2) else None
+
+        def run_pair(m, x_s_in, x_t_in, merge):
+            if not merge:
+                return (run_psi(m, x_s_in, graph_s, train=train),
+                        run_psi(m, x_t_in, graph_t, train=train))
+            return pair.apply(
+                lambda x, g: run_psi(m, x, g, train=train), x_s_in, x_t_in)
+
+        h_s, h_t = run_pair(self.psi_1, graph_s.x, graph_t.x, merge_1)
         if detach:
             h_s = jax.lax.stop_gradient(h_s)
             h_t = jax.lax.stop_gradient(h_t)
@@ -223,8 +270,7 @@ class DGMC(nn.Module):
                 S = masked_softmax(S_hat, S_mask)
                 r_s = noise(step)
                 r_t = jnp.einsum('bst,bsr->btr', S, r_s)
-                o_s = run_psi(self.psi_2, r_s, graph_s, train=train)
-                o_t = run_psi(self.psi_2, r_t, graph_t, train=train)
+                o_s, o_t = run_pair(self.psi_2, r_s, r_t, merge_2)
                 if use_fused:
                     from dgmc_tpu.ops.pallas import consensus_update
                     delta = consensus_update(
@@ -286,8 +332,7 @@ class DGMC(nn.Module):
 
             r_t = jax.vmap(scat)(contrib.reshape(B, N_s * K, R_in),
                                  S_idx.reshape(B, N_s * K))
-            o_s = run_psi(self.psi_2, r_s, graph_s, train=train)
-            o_t = run_psi(self.psi_2, r_t, graph_t, train=train)
+            o_s, o_t = run_pair(self.psi_2, r_s, r_t, merge_2)
             o_t_cand = gather_t(o_t, S_idx)
             D = o_s[:, :, None, :] - o_t_cand
             S_hat = self._constrain(S_hat + consensus_mlp(D))

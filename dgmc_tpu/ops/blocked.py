@@ -1,0 +1,327 @@
+"""Scatter-free edge aggregation: node-range-blocked one-hot matmuls.
+
+The profile of the DBP15K-scale sparse step (465 ms on-chip) shows it is
+dominated by ~130 scatter-add ops of ~1.2 ms each — the forward
+``segment_sum`` reductions of message passing plus the scatter-add VJPs of
+the node gathers (see ``benchmarks/sparse_diag.py`` and the round-3 notes
+in ``benchmarks/README.md``). TPU has no fast scatter; it DOES have a fast
+MXU. Graph structure is static across an entire training run, so the
+edge→node reduction can be restructured host-side, once, into a form that
+is pure (batched) matmul on device:
+
+1. Host (``build_edge_blocks``): sort edges by destination node; partition
+   into blocks of ≤ ``block_edges`` edges such that every block's
+   destinations fall inside one aligned node range of ``rows`` rows (heavy
+   "hub" ranges simply get several blocks). Pad blocks with masked edges.
+2. Device (``adj_matmul``): gather the operand rows at the blocked source
+   endpoints, build each block's ``[block_edges, rows]`` one-hot routing
+   matrix (edge-structure-only ⇒ XLA CSEs one copy across all layers AND
+   all consensus iterations of a step), and contract on the MXU:
+   ``[NB, E_b, R] x [NB, E_b, C] -> [NB, R, C]``. Blocks sharing a node
+   range are combined by a second tiny one-hot matmul ``[NR, NB]`` —
+   no scatter anywhere.
+3. Backward: ``d/dh`` of ``out[n] = Σ_{e: dst=n} h[src_e]`` is the SAME
+   computation over the transposed adjacency, so a ``custom_vjp`` runs it
+   with the source-blocked structure — the gradient is also matmuls, never
+   a scatter-add.
+
+This replaces the capability the reference buys from ``torch_scatter``
+CUDA kernels (reference ``dgmc/models/rel.py:25-31`` via PyG
+``MessagePassing``) with an MXU-native formulation.
+"""
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+
+from dgmc_tpu.ops.graph import GraphBatch
+
+
+@struct.dataclass
+class EdgeBlocks:
+    """One direction of blocked adjacency: dst-sorted, range-aligned.
+
+    Shapes (per batch element): ``src [B, NB, E_b]`` int32 source-endpoint
+    node ids; ``dst_local [B, NB, E_b]`` int32 destination offset within
+    the block's node range; ``mask [B, NB, E_b]`` bool edge validity;
+    ``range_id [B, NB]`` int32 aligned node-range index of each block;
+    ``inv_degree [B, N, 1]`` float reciprocal destination in-degree
+    (1 where empty) — mean aggregation is a static elementwise scale.
+    ``rows`` / ``num_ranges`` are static ints.
+    """
+    src: jnp.ndarray
+    dst_local: jnp.ndarray
+    mask: jnp.ndarray
+    range_id: jnp.ndarray
+    inv_degree: jnp.ndarray
+    rows: int = struct.field(pytree_node=False)
+    num_ranges: int = struct.field(pytree_node=False)
+    # Optional dtype (e.g. jnp.bfloat16) for the gathered operand rows: the
+    # blocked gathers are random-access-bandwidth bound, so halving row
+    # bytes nearly halves their cost; accumulation stays f32.
+    gather_dtype: Optional[str] = struct.field(pytree_node=False,
+                                               default=None)
+
+
+def _build_one(src, dst, mask, num_nodes, rows, block_edges):
+    """Block one graph's edge list (numpy, host-side)."""
+    src = np.asarray(src)[mask]
+    dst = np.asarray(dst)[mask]
+    order = np.argsort(dst, kind='stable')
+    src, dst = src[order], dst[order]
+    num_ranges = -(-num_nodes // rows)
+
+    blocks = []  # (range_id, src_chunk, dst_local_chunk)
+    rid_of = dst // rows
+    start = 0
+    e = len(dst)
+    while start < e:
+        rid = rid_of[start]
+        # end of this range's edge run
+        run_end = start + np.searchsorted(rid_of[start:], rid + 1)
+        end = min(start + block_edges, run_end)
+        # Within a block, order edges by SOURCE row: summation order is
+        # irrelevant to the one-hot contraction, and a monotone index
+        # stream is the friendliest access pattern the row gather can get.
+        o = np.argsort(src[start:end], kind='stable')
+        blocks.append((rid, src[start:end][o],
+                       (dst[start:end] - rid * rows)[o]))
+        start = end
+    if not blocks:
+        blocks.append((0, np.zeros(0, np.int32), np.zeros(0, np.int32)))
+
+    nb = len(blocks)
+    b_src = np.zeros((nb, block_edges), np.int32)
+    b_loc = np.zeros((nb, block_edges), np.int32)
+    b_msk = np.zeros((nb, block_edges), bool)
+    b_rid = np.zeros((nb,), np.int32)
+    for i, (rid, s, l) in enumerate(blocks):
+        n = len(s)
+        b_src[i, :n] = s
+        b_loc[i, :n] = l
+        b_msk[i, :n] = True
+        b_rid[i] = rid
+
+    deg = np.bincount(dst, minlength=num_nodes).astype(np.float32)
+    inv_deg = (1.0 / np.maximum(deg, 1.0))[:, None]
+    return b_src, b_loc, b_msk, b_rid, inv_deg, num_ranges
+
+
+def build_edge_blocks(senders, receivers, edge_mask, num_nodes, rows=128,
+                      block_edges=512):
+    """Host-side blocking of a batched edge list, both directions.
+
+    Args mirror :class:`GraphBatch` fields (``[B, E]`` numpy arrays).
+    Returns ``(incoming, outgoing)`` :class:`EdgeBlocks` — ``incoming``
+    aggregates messages TO each edge's receiver (dst=receiver,
+    src=sender), ``outgoing`` the reverse. The two are mutual transposes:
+    each serves as the other's backward structure in :func:`adj_matmul`.
+
+    Batch elements are padded to one common block count.
+    """
+    senders = np.asarray(senders)
+    receivers = np.asarray(receivers)
+    edge_mask = np.asarray(edge_mask)
+    out = []
+    for dst, src in ((receivers, senders), (senders, receivers)):
+        per = [_build_one(src[b], dst[b], edge_mask[b], num_nodes, rows,
+                          block_edges) for b in range(dst.shape[0])]
+        nb = max(p[0].shape[0] for p in per)
+
+        def pad(a, n=nb):
+            return np.pad(a, ((0, n - a.shape[0]),) + ((0, 0),) *
+                          (a.ndim - 1))
+
+        out.append(EdgeBlocks(
+            src=jnp.asarray(np.stack([pad(p[0]) for p in per])),
+            dst_local=jnp.asarray(np.stack([pad(p[1]) for p in per])),
+            mask=jnp.asarray(np.stack([pad(p[2]) for p in per])),
+            range_id=jnp.asarray(np.stack([pad(p[3]) for p in per])),
+            inv_degree=jnp.asarray(np.stack([p[4] for p in per])),
+            rows=rows, num_ranges=per[0][5]))
+    return out[0], out[1]
+
+
+def _routed(h, src, loc, msk, rid, rows, num_ranges, out_rows, gather_dtype):
+    """Core blocked contraction: ``out[b, n] = Σ_{e: dst=n} h[b, src_e]``.
+
+    ``h [B, M, C]`` is the gathered-from table (``src`` indexes its rows),
+    ``out_rows`` the un-padded output row count.
+    """
+    C = h.shape[-1]
+    acc = jnp.promote_types(h.dtype, jnp.float32)
+    # Narrow-row guard: bf16 only pays when it still leaves >= 512-byte
+    # gather rows; measured at C=32 the 64-byte bf16 rows made the random
+    # gathers ~1.6x SLOWER (sub-line transfers), while at C=256 bf16 wins.
+    if gather_dtype is not None and C * 2 >= 512:
+        h = h.astype(gather_dtype)
+    else:
+        gather_dtype = None
+
+    def one(hb, src_b, loc_b, msk_b, rid_b):
+        g = jnp.take(hb, src_b.reshape(-1), axis=0)        # [NB*E_b, C]
+        g = g.reshape(src_b.shape + (C,))                  # [NB, E_b, C]
+        # Edge-structure-only routing tensor: CSE'd across every layer and
+        # consensus iteration that aggregates over this graph.
+        onehot = (loc_b[..., None] == jnp.arange(rows)) & msk_b[..., None]
+        # HIGHEST precision for f32 operands: these contractions are tiny
+        # (a few GFLOP) but route f32 values, and the default single-pass
+        # bf16 MXU mode would silently round every message. bf16 operands
+        # (gather_dtype) are exact in one pass.
+        prec = (None if gather_dtype is not None
+                else jax.lax.Precision.HIGHEST)
+        per_block = jnp.einsum('ber,bec->brc', onehot.astype(g.dtype), g,
+                               precision=prec,
+                               preferred_element_type=acc)  # [NB, R, C]
+        combine = (rid_b[None, :] == jnp.arange(num_ranges)[:, None])
+        # Combine is tiny; keep it HIGHEST so f32 partial sums are never
+        # re-rounded regardless of gather dtype.
+        out = jnp.einsum('nb,brc->nrc', combine.astype(acc), per_block,
+                         precision=jax.lax.Precision.HIGHEST,
+                         preferred_element_type=acc)
+        return out.reshape(num_ranges * rows, C)[:out_rows]
+
+    return jax.vmap(one)(h, src, loc, msk, rid).astype(acc)
+
+
+def _routed_sum(h, blocks):
+    return _routed(h, blocks.src, blocks.dst_local, blocks.mask,
+                   blocks.range_id, blocks.rows, blocks.num_ranges,
+                   h.shape[1], blocks.gather_dtype)
+
+
+@jax.custom_vjp
+def adj_matmul(h, fwd_blocks, bwd_blocks):
+    """``out[b, n, :] = Σ_{edges e with dst=n} h[b, src_e, :]`` — the
+    gather+segment-sum of message passing as pure MXU matmuls, with a
+    matmul (never scatter-add) backward via the transposed blocking.
+    """
+    return _routed_sum(h, fwd_blocks)
+
+
+def _fwd(h, fwd_blocks, bwd_blocks):
+    return _routed_sum(h, fwd_blocks), (bwd_blocks,)
+
+
+def _bwd(res, d_out):
+    (bwd_blocks,) = res
+    return _routed_sum(d_out, bwd_blocks), None, None
+
+
+adj_matmul.defvjp(_fwd, _bwd)
+
+
+# Design notes from on-chip measurement (benchmarks/sparse_diag.py):
+# - A "dual" variant running BOTH directions as one concatenated gather +
+#   contraction (with an order-preserving backward so the routing tensor
+#   CSEs across passes) measured no better than two adj_matmul calls —
+#   the >2^19-row combined gather runs ~3x less efficiently (10 vs
+#   31 GB/s), eating the op-count saving; chunking it back under the
+#   cliff recovered nothing.
+# - Sorting edges by source within a block (monotone gather stream) made
+#   no measurable difference; the row gather is latency- not
+#   pattern-bound at these sizes. The sort is kept anyway: it is free at
+#   build time and can only help.
+
+
+class UnionPair:
+    """A (source, target) graph pair disjoint-unioned along the NODE axis.
+
+    Per batch element the two graphs become one graph of ``N_s' + N_t``
+    nodes (``N_s'`` = source side padded up to a block-row boundary),
+    target-side edge endpoints offset by ``N_s'`` — the reference's
+    ``__inc__`` collation trick (reference ``dgmc/utils/data.py:9-16``)
+    applied on-device. One backbone application then covers both sides,
+    halving the op count of the per-consensus-step ψ₂ applications — and
+    on the tunneled TPU, where EVERY kernel pays a ~0.3-0.5 ms dispatch
+    floor, op count is the entire game at DBP15K scale.
+
+    Only profitable combined with blocked adjacency: with plain
+    gather/scatter aggregation, scatter cost grows with the union's node
+    count and a union measured 58 vs 36 ms per consensus iteration; the
+    blocked contraction's cost is bytes-bound and indifferent to table
+    size. Built at trace time from already-blocked sides (cheap index
+    concats, CSE'd by XLA).
+    """
+
+    def __init__(self, g_s, g_t):
+        bs, bt = g_s.blocks_in, g_t.blocks_in
+        assert bs is not None and bt is not None, (
+            'UnionPair requires blocked graphs (ops/blocked.py)')
+        assert bs.rows == bt.rows
+        self.n_s, self.n_t = g_s.num_nodes, g_t.num_nodes
+        # Align the source side to a whole number of block rows so target
+        # node ids / range ids offset cleanly.
+        self.pad = bs.num_ranges * bs.rows - self.n_s
+        off, nr_s = self.n_s + self.pad, bs.num_ranges
+
+        def merge(a, b):
+            ones = jnp.ones((a.inv_degree.shape[0], self.pad, 1),
+                            a.inv_degree.dtype)
+            return EdgeBlocks(
+                src=jnp.concatenate([a.src, b.src + off], axis=1),
+                dst_local=jnp.concatenate([a.dst_local, b.dst_local],
+                                          axis=1),
+                mask=jnp.concatenate([a.mask, b.mask], axis=1),
+                range_id=jnp.concatenate(
+                    [a.range_id, b.range_id + nr_s], axis=1),
+                inv_degree=jnp.concatenate(
+                    [a.inv_degree, ones, b.inv_degree], axis=1),
+                rows=a.rows, num_ranges=nr_s + b.num_ranges,
+                gather_dtype=a.gather_dtype)
+
+        ea_s, ea_t = g_s.edge_attr, g_t.edge_attr
+        self.graph = GraphBatch(
+            x=self._cat(g_s.x, g_t.x),
+            senders=jnp.concatenate([g_s.senders, g_t.senders + off],
+                                    axis=1),
+            receivers=jnp.concatenate([g_s.receivers, g_t.receivers + off],
+                                      axis=1),
+            node_mask=self._cat(g_s.node_mask, g_t.node_mask),
+            edge_mask=jnp.concatenate([g_s.edge_mask, g_t.edge_mask],
+                                      axis=1),
+            edge_attr=(None if ea_s is None else
+                       jnp.concatenate([ea_s, ea_t], axis=1)),
+            blocks_in=merge(g_s.blocks_in, g_t.blocks_in),
+            blocks_out=merge(g_s.blocks_out, g_t.blocks_out))
+
+    def _cat(self, a_s, a_t):
+        if self.pad:
+            widths = ((0, 0), (0, self.pad)) + ((0, 0),) * (a_s.ndim - 2)
+            a_s = jnp.pad(a_s, widths)
+        return jnp.concatenate([a_s, a_t], axis=1)
+
+    def apply(self, fn, x_s, x_t):
+        """Run ``fn(x, graph) -> [B, N, C]`` once over the union; split
+        the result back into per-side arrays."""
+        out = fn(self._cat(x_s, x_t), self.graph)
+        return out[:, :self.n_s], out[:, self.n_s + self.pad:]
+
+
+def attach_blocks(graph, rows=128, block_edges=512, min_nodes=1024,
+                  gather_dtype='bfloat16') -> 'object':
+    """Return ``graph`` with blocked-adjacency structure attached.
+
+    Host-side, one-off; a no-op for small graphs (``num_nodes <
+    min_nodes``), where plain gather/scatter is already cheap and the
+    padding overhead isn't worth it.
+
+    ``gather_dtype='bfloat16'`` (default) moves message rows AND routing
+    tensors as bf16 with f32 accumulation — both the blocked gathers and
+    the routing matmuls are bytes-bound, so this nearly halves their cost;
+    routing weights are exact 0/1 either way. Pass ``gather_dtype=None``
+    for full-f32 message traffic (bit-faithful to the gather/scatter
+    path up to summation order).
+    """
+    if graph.num_nodes < min_nodes or graph.blocks_in is not None:
+        return graph
+    inc, outg = build_edge_blocks(graph.senders, graph.receivers,
+                                  graph.edge_mask, graph.num_nodes,
+                                  rows=rows, block_edges=block_edges)
+    if gather_dtype is not None:
+        inc = inc.replace(gather_dtype=gather_dtype)
+        outg = outg.replace(gather_dtype=gather_dtype)
+    return graph.replace(blocks_in=inc, blocks_out=outg)

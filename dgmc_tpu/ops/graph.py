@@ -32,6 +32,10 @@ class GraphBatch:
             node 0 and must be masked out of every aggregation.
         edge_attr: optional ``[B, E, D]`` edge features (pseudo-coordinates
             for SplineCNN).
+        blocks_in / blocks_out: optional blocked-adjacency structure
+            (``dgmc_tpu/ops/blocked.py``) for scatter-free MXU
+            aggregation at large graph sizes; attach host-side via
+            ``dgmc_tpu.ops.blocked.attach_blocks``.
     """
     x: jnp.ndarray
     senders: jnp.ndarray
@@ -39,6 +43,8 @@ class GraphBatch:
     node_mask: jnp.ndarray
     edge_mask: jnp.ndarray
     edge_attr: Optional[jnp.ndarray] = None
+    blocks_in: Optional[object] = None
+    blocks_out: Optional[object] = None
 
     @property
     def num_graphs(self):

@@ -4,12 +4,16 @@ from dgmc_tpu.parallel.sharding import (replicate, shard_batch,
                                         make_sharded_train_step,
                                         make_sharded_eval_step)
 from dgmc_tpu.parallel.topk import sharded_topk_rows, sharded_topk_cols
-from dgmc_tpu.parallel.distributed import (initialize_distributed,
-                                           is_coordinator)
+from dgmc_tpu.parallel.distributed import (global_batch,
+                                           initialize_distributed,
+                                           is_coordinator,
+                                           local_batch_slice)
 
 __all__ = [
     'initialize_distributed',
     'is_coordinator',
+    'global_batch',
+    'local_batch_slice',
     'DATA_AXIS',
     'MODEL_AXIS',
     'make_mesh',

@@ -91,3 +91,45 @@ def initialize_distributed(coordinator_address: Optional[str] = None,
 def is_coordinator() -> bool:
     """True on the process that should write checkpoints / logs."""
     return jax.process_index() == 0
+
+
+def local_batch_slice(batch):
+    """This process's rows of a host-side batch whose leading axis will be
+    sharded over the data axis: with a contiguous ``P('data')`` layout,
+    process ``p`` owns rows ``[p*B/nproc, (p+1)*B/nproc)``. The batch's
+    leading dimension must divide evenly across processes."""
+    import numpy as np
+    nproc, pid = jax.process_count(), jax.process_index()
+
+    def cut(x):
+        x = np.asarray(x)
+        assert x.shape[0] % nproc == 0, (
+            f'batch axis {x.shape[0]} not divisible by {nproc} processes')
+        per = x.shape[0] // nproc
+        return x[pid * per:(pid + 1) * per]
+
+    return jax.tree.map(cut, batch)
+
+
+def global_batch(batch, mesh, axis=None, replicate=False):
+    """Assemble a globally-addressable array pytree from per-process data.
+
+    ``replicate=True``: every process passes identical full arrays (e.g.
+    the DBP15K whole-graph pair) and gets a mesh-replicated global array.
+    Otherwise each process passes ITS slice (see :func:`local_batch_slice`)
+    and the leading axis is sharded over ``axis``. This is the
+    multi-process feeding path ``jax.jit`` needs: plain ``device_put``
+    cannot build arrays spanning non-addressable devices.
+    """
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    if axis is None:
+        from dgmc_tpu.parallel.mesh import DATA_AXIS
+        axis = DATA_AXIS
+    sharding = NamedSharding(mesh, P() if replicate else P(axis))
+
+    def put(x):
+        return jax.make_array_from_process_local_data(sharding,
+                                                      np.asarray(x))
+
+    return jax.tree.map(put, batch)

@@ -26,7 +26,25 @@ from dgmc_tpu.parallel.mesh import DATA_AXIS
 from dgmc_tpu.train import steps as _steps
 
 
-def _gspmd_safe(step, mesh):
+def _reject_explicit_fused(model, mesh):
+    """Explicitly requested Pallas kernels cannot be silenced by the
+    trace-time context — reject them loudly, matching DGMC's own
+    ``corr_sharding`` check, instead of tracing a ``pallas_call`` into the
+    partitioned program."""
+    requested = [role for role, flag in (
+        ('psi_1', getattr(model.psi_1, 'fused', None)),
+        ('psi_2', getattr(model.psi_2, 'fused', None)),
+        ('fused_consensus', getattr(model, 'fused_consensus', None)),
+    ) if flag is True]
+    if requested:
+        raise ValueError(
+            f'{requested} request Pallas kernels explicitly, but a '
+            f'{mesh.size}-device mesh partitions the program and '
+            f'pallas_call has no GSPMD partitioning rule; leave the '
+            f'kernel flags at None/False for sharded execution')
+
+
+def _gspmd_safe(step, mesh, model=None):
     """Trace ``step`` with auto-dispatched Pallas kernels silenced whenever
     the mesh actually partitions (``pallas_call`` has no GSPMD partitioning
     rule — inside a partitioned program it crashes or silently replicates).
@@ -36,6 +54,8 @@ def _gspmd_safe(step, mesh):
     so the kernels stay on there."""
     if mesh.size <= 1:
         return step
+    if model is not None:
+        _reject_explicit_fused(model, mesh)
 
     def traced(*args):
         with disable_fused_kernels():
@@ -70,7 +90,7 @@ def make_sharded_train_step(model, mesh, loss_on_s0=False, num_steps=None,
                                   hits_ks=hits_ks, jit=False)
     repl = NamedSharding(mesh, P())
     batched = NamedSharding(mesh, P(batch_axis))
-    return jax.jit(_gspmd_safe(step, mesh),
+    return jax.jit(_gspmd_safe(step, mesh, model),
                    in_shardings=(repl, batched, repl),
                    out_shardings=(repl, repl),
                    donate_argnums=(0,))
@@ -82,6 +102,6 @@ def make_sharded_eval_step(model, mesh, hits_ks=(1,), num_steps=None,
                                  detach=detach, jit=False)
     repl = NamedSharding(mesh, P())
     batched = NamedSharding(mesh, P(batch_axis))
-    return jax.jit(_gspmd_safe(step, mesh),
+    return jax.jit(_gspmd_safe(step, mesh, model),
                    in_shardings=(repl, batched, repl),
                    out_shardings=repl)

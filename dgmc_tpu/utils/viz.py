@@ -1,0 +1,83 @@
+"""Correspondence visualization (matplotlib, host-side).
+
+The reference showcases rendered keypoint matches in its README
+(reference ``README.md:51-56``, ``figures/best_car.png``); this module is
+the equivalent utility for the TPU framework: draw a (source, target)
+keypoint-graph pair side by side and the predicted correspondence as
+lines, colored by correctness when ground truth is given.
+
+Matplotlib is imported lazily — install the ``viz`` extra
+(``pip install dgmc_tpu[viz]``).
+"""
+
+import numpy as np
+
+__all__ = ['predicted_targets', 'plot_matches']
+
+
+def predicted_targets(corr):
+    """Per-source-row argmax target of a
+    :class:`~dgmc_tpu.models.dgmc.Correspondence` (dense or sparse),
+    returned as ``[B, N_s]`` numpy int array."""
+    val = np.asarray(corr.val)
+    if corr.idx is None:
+        return val.argmax(axis=-1)
+    idx = np.asarray(corr.idx)
+    best = val.argmax(axis=-1)
+    return np.take_along_axis(idx, best[..., None], axis=-1)[..., 0]
+
+
+def plot_matches(pos_s, pos_t, pred, y=None, edges_s=None, edges_t=None,
+                 ax=None, offset=None, point_color='#1f77b4',
+                 edge_color='#cccccc'):
+    """Render one pair's predicted matches.
+
+    Args:
+        pos_s / pos_t: ``[N_s, 2]`` / ``[N_t, 2]`` keypoint coordinates.
+        pred: ``[N_s]`` predicted target index per source keypoint (see
+            :func:`predicted_targets`), ``-1`` to skip a row.
+        y: optional ``[N_s]`` ground-truth targets (``-1`` = no GT);
+            correct matches draw green, wrong ones red, un-labeled gray.
+        edges_s / edges_t: optional ``[E, 2]`` (sender, receiver) arrays
+            drawn as light graph structure.
+        offset: translation applied to the target cloud so the two graphs
+            sit side by side; default shifts right by 1.5x the source
+            width.
+        ax: existing matplotlib axes (one is created otherwise).
+
+    Returns the matplotlib axes.
+    """
+    import matplotlib.pyplot as plt
+
+    pos_s = np.asarray(pos_s, float)
+    pos_t = np.asarray(pos_t, float)
+    pred = np.asarray(pred)
+    if offset is None:
+        width = max(pos_s[:, 0].max() - pos_s[:, 0].min(), 1e-6)
+        offset = np.array([1.5 * width, 0.0])
+    pos_t = pos_t + np.asarray(offset, float)
+
+    if ax is None:
+        _, ax = plt.subplots(figsize=(8, 4))
+
+    for pos, edges in ((pos_s, edges_s), (pos_t, edges_t)):
+        if edges is not None:
+            for a, b in np.asarray(edges):
+                ax.plot([pos[a, 0], pos[b, 0]], [pos[a, 1], pos[b, 1]],
+                        color=edge_color, linewidth=0.8, zorder=1)
+    ax.scatter(pos_s[:, 0], pos_s[:, 1], s=28, c=point_color, zorder=3)
+    ax.scatter(pos_t[:, 0], pos_t[:, 1], s=28, c=point_color, zorder=3)
+
+    for i, j in enumerate(pred):
+        if j < 0 or j >= len(pos_t):
+            continue
+        if y is None or y[i] < 0:
+            color = '#999999'
+        else:
+            color = '#2ca02c' if int(y[i]) == int(j) else '#d62728'
+        ax.plot([pos_s[i, 0], pos_t[j, 0]], [pos_s[i, 1], pos_t[j, 1]],
+                color=color, linewidth=1.2, alpha=0.85, zorder=2)
+
+    ax.set_aspect('equal')
+    ax.axis('off')
+    return ax

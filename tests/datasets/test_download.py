@@ -1,0 +1,57 @@
+"""Dataset acquisition plumbing (fetch/extract) — tested with file:// URLs
+since this environment has no network egress. Loader default behavior
+(raise-with-instructions, no download attempted) is also pinned."""
+
+import os
+import tarfile
+import zipfile
+
+import pytest
+
+from dgmc_tpu.datasets import download as dl
+
+
+def test_fetch_file_url(tmp_path):
+    src = tmp_path / 'payload.bin'
+    src.write_bytes(b'hello dataset')
+    dest = tmp_path / 'out' / 'payload.bin'
+    dl.fetch(src.as_uri(), str(dest))
+    assert dest.read_bytes() == b'hello dataset'
+
+
+def test_fetch_failure_cleans_up_and_instructs(tmp_path):
+    dest = tmp_path / 'missing.bin'
+    with pytest.raises(RuntimeError, match='manually'):
+        dl.fetch((tmp_path / 'nope.bin').as_uri(), str(dest))
+    assert not dest.exists()
+    assert not (tmp_path / 'missing.bin.part').exists()
+
+
+@pytest.mark.parametrize('kind', ['zip', 'tar'])
+def test_download_and_extract_roundtrip(tmp_path, monkeypatch, kind):
+    inner = tmp_path / 'build' / 'DATA' / 'f.txt'
+    inner.parent.mkdir(parents=True)
+    inner.write_text('contents')
+    if kind == 'zip':
+        archive = tmp_path / 'data.zip'
+        with zipfile.ZipFile(archive, 'w') as z:
+            z.write(inner, 'DATA/f.txt')
+    else:
+        archive = tmp_path / 'data.tar.gz'
+        with tarfile.open(archive, 'w:gz') as t:
+            t.add(inner, 'DATA/f.txt')
+    monkeypatch.setitem(dl.URLS, 'fake', archive.as_uri())
+
+    root = tmp_path / 'root'
+    dl.download_and_extract('fake', str(root))
+    assert (root / 'DATA' / 'f.txt').read_text() == 'contents'
+    # archive removed by default
+    assert not (root / archive.name).exists()
+
+
+def test_loaders_stay_offline_by_default(tmp_path):
+    from dgmc_tpu.datasets import DBP15K, PascalPF
+    with pytest.raises(FileNotFoundError, match='download=True'):
+        DBP15K(str(tmp_path), 'zh_en')
+    with pytest.raises(FileNotFoundError, match='download=True'):
+        PascalPF(str(tmp_path), 'aeroplane')

@@ -1,0 +1,99 @@
+"""Sparse two-phase matching-quality gate (VERDICT round-2 item 3).
+
+The DBP15K protocol's core claim (the paper's, reproduced by reference
+``examples/dbp15k.py:63-69``) is that (a) sparse top-k feature matching
+with GT injection learns alignments from a 30% seed set, and (b) the
+detached consensus-refinement phase IMPROVES on feature-only matching.
+Nothing in the plumbing tests checks matching *quality*; this does, on a
+synthetic knowledge-graph alignment built like DBP15K in miniature:
+a random directed graph, a permuted copy with noisy features and 15%
+rewired edges, 30% training seeds, sparse k=8 with random negatives + GT
+injection, trained through the real two-phase compiled-step schedule
+(phase 1 ``num_steps=0``; phase 2 ``num_steps=5, detach=True``).
+
+Runs on the blocked-adjacency path (ops/blocked.py), so it also gates
+that the scatter-free MXU aggregation actually *trains*, not merely
+matches forward values.
+
+Calibration at the time of writing (CPU, seeds 0-3): phase 1 lands at
+0.55-0.59 test Hits@1, phase 2 at 0.70-0.73, chance is 1/300. Floors of
+0.65 and +0.05 improvement are comfortably inside that band but far
+above any broken-wiring outcome.
+"""
+
+import jax
+import numpy as np
+
+from dgmc_tpu.models import DGMC, RelCNN
+from dgmc_tpu.ops import GraphBatch
+from dgmc_tpu.ops.blocked import attach_blocks
+from dgmc_tpu.train import (create_train_state, make_eval_step,
+                            make_train_step)
+from dgmc_tpu.utils.data import PairBatch
+
+N, E, C = 300, 1500, 24
+
+
+def build_alignment_problem(seed=0):
+    rng = np.random.RandomState(seed)
+    x_s = rng.randn(N, C).astype(np.float32)
+    snd = rng.randint(0, N, E).astype(np.int32)
+    rcv = rng.randint(0, N, E).astype(np.int32)
+
+    # Target KG: permuted entities, noisy embeddings, 85% shared edges.
+    perm = rng.permutation(N).astype(np.int32)
+    x_t = np.zeros_like(x_s)
+    x_t[perm] = x_s + 0.9 * rng.randn(N, C).astype(np.float32)
+    keep = rng.rand(E) < 0.85
+    snd_t = np.where(keep, perm[snd], rng.randint(0, N, E)).astype(np.int32)
+    rcv_t = np.where(keep, perm[rcv], rng.randint(0, N, E)).astype(np.int32)
+
+    def side(x, s, r):
+        g = GraphBatch(x=x[None], senders=s[None], receivers=r[None],
+                       node_mask=np.ones((1, N), bool),
+                       edge_mask=np.ones((1, E), bool), edge_attr=None)
+        return attach_blocks(g, rows=64, block_edges=128, min_nodes=1,
+                             gather_dtype=None)
+
+    g_s, g_t = side(x_s, snd, rcv), side(x_t, snd_t, rcv_t)
+    train_mask = np.zeros(N, bool)
+    train_mask[:int(0.3 * N)] = True      # the reference's 30% seed split
+    y_train = np.where(train_mask, perm, -1).astype(np.int32)[None]
+    y_test = np.where(~train_mask, perm, -1).astype(np.int32)[None]
+    return (PairBatch(s=g_s, t=g_t, y=y_train, y_mask=y_train >= 0),
+            PairBatch(s=g_s, t=g_t, y=y_test, y_mask=y_test >= 0))
+
+
+def test_two_phase_schedule_matching_quality():
+    batch, test_batch = build_alignment_problem(seed=0)
+    model = DGMC(RelCNN(C, 64, num_layers=2, dropout=0.3),
+                 RelCNN(16, 16, num_layers=2), num_steps=0, k=8)
+    state = create_train_state(model, jax.random.key(0), batch,
+                               learning_rate=1e-2)
+
+    p1_train = make_train_step(model, num_steps=0)
+    p2_train = make_train_step(model, num_steps=5, detach=True)
+    p1_eval = make_eval_step(model, num_steps=0)
+    p2_eval = make_eval_step(model, num_steps=5)
+
+    def test_hits1(state, eval_step, key):
+        out = eval_step(state, test_batch, key)
+        return float(out['correct']) / float(out['count'])
+
+    key = jax.random.key(1)
+    for _ in range(80):
+        key, sub = jax.random.split(key)
+        state, _ = p1_train(state, batch, sub)
+    key, sub = jax.random.split(key)
+    h1 = test_hits1(state, p1_eval, sub)
+
+    for _ in range(40):
+        key, sub = jax.random.split(key)
+        state, _ = p2_train(state, batch, sub)
+    key, sub = jax.random.split(key)
+    h2 = test_hits1(state, p2_eval, sub)
+
+    assert h2 >= 0.65, f'two-phase matching quality regressed: {h2:.3f}'
+    assert h2 >= h1 + 0.05, (
+        f'consensus refinement no longer improves on feature matching: '
+        f'phase1={h1:.3f} phase2={h2:.3f}')

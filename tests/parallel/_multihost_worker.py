@@ -1,0 +1,70 @@
+"""Worker for the 2-process CPU multi-host test (spawned by
+``test_multihost.py``). Each process owns 4 virtual CPU devices; together
+they form one 8-device data mesh and run sharded train steps on
+per-process batch slices, printing the final loss for cross-process
+comparison."""
+
+import os
+import sys
+
+os.environ['JAX_PLATFORMS'] = 'cpu'
+os.environ['XLA_FLAGS'] = (os.environ.get('XLA_FLAGS', '') +
+                           ' --xla_force_host_platform_device_count=4')
+
+import jax  # noqa: E402
+
+jax.config.update('jax_platforms', 'cpu')
+
+import numpy as np  # noqa: E402
+
+
+def main():
+    pid, port = int(sys.argv[1]), sys.argv[2]
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))))
+    from dgmc_tpu.models import DGMC, GIN
+    from dgmc_tpu.ops import GraphBatch
+    from dgmc_tpu.parallel import (global_batch, initialize_distributed,
+                                   is_coordinator, local_batch_slice,
+                                   make_mesh, make_sharded_train_step)
+    from dgmc_tpu.train import create_train_state
+    from dgmc_tpu.utils.data import PairBatch
+
+    nproc = initialize_distributed(f'localhost:{port}', 2, pid)
+    assert nproc == 2, nproc
+    assert len(jax.devices()) == 8, jax.devices()
+    assert is_coordinator() == (pid == 0)
+
+    B, N, E, C = 8, 12, 30, 16
+    rng = np.random.RandomState(0)  # same data on both processes
+
+    def side():
+        return GraphBatch(
+            x=rng.randn(B, N, C).astype(np.float32),
+            senders=rng.randint(0, N, (B, E)).astype(np.int32),
+            receivers=rng.randint(0, N, (B, E)).astype(np.int32),
+            node_mask=np.ones((B, N), bool),
+            edge_mask=np.ones((B, E), bool))
+
+    y = np.tile(np.arange(N, dtype=np.int32), (B, 1))
+    batch = PairBatch(s=side(), t=side(), y=y, y_mask=y >= 0)
+
+    model = DGMC(GIN(C, 16, 2), GIN(8, 8, 2), num_steps=2, k=-1)
+    state = create_train_state(model, jax.random.key(0), batch,
+                               learning_rate=1e-3)
+
+    mesh = make_mesh(data=len(jax.devices()))
+    step = make_sharded_train_step(model, mesh, loss_on_s0=True)
+    state = global_batch(state, mesh, replicate=True)
+    fed = global_batch(local_batch_slice(batch), mesh)
+
+    key = jax.random.key(1)
+    out = None
+    for _ in range(2):
+        key, sub = jax.random.split(key)
+        state, out = step(state, fed, sub)
+    print(f'LOSS {float(out["loss"]):.6f}', flush=True)
+
+
+if __name__ == '__main__':
+    main()

@@ -1,0 +1,43 @@
+"""True multi-process execution test (VERDICT round-2 item 4).
+
+Launches TWO separate Python processes, each owning 4 virtual CPU
+devices, that bring up the JAX distributed runtime against a localhost
+coordinator and jointly execute data-parallel sharded train steps over
+one 8-device global mesh — per-process batch slices assembled with
+``jax.make_array_from_process_local_data`` via
+:func:`dgmc_tpu.parallel.global_batch`. Both processes must finish and
+agree on the loss.
+"""
+
+import os
+import re
+import socket
+import subprocess
+import sys
+
+WORKER = os.path.join(os.path.dirname(__file__), '_multihost_worker.py')
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(('localhost', 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_sharded_training():
+    port = _free_port()
+    env = dict(os.environ)
+    env.pop('JAX_PLATFORMS', None)
+    procs = [subprocess.Popen(
+        [sys.executable, WORKER, str(pid), str(port)],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env) for pid in range(2)]
+    outs = []
+    for p in procs:
+        out, _ = p.communicate(timeout=600)
+        outs.append(out)
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, f'worker failed:\n{out[-3000:]}'
+    losses = [float(re.search(r'LOSS ([\d.eE+-]+)', o).group(1))
+              for o in outs]
+    assert losses[0] == losses[1], losses
