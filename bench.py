@@ -28,6 +28,7 @@ Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", extras...}.
 
 import json
 import os
+import sys
 import time
 
 import jax
@@ -76,11 +77,21 @@ PEAK_FLOPS = {
 }
 
 
-def _aot_compile(jitted, *args):
+def _aot_compile(jitted, *args, attempts=3):
     """Ahead-of-time compile a jitted step once; the returned executable is
     used for BOTH the timed loop and the cost/memory accounting, so the
-    expensive XLA compile happens exactly once per leg."""
-    return jitted.lower(*args).compile()
+    expensive XLA compile happens exactly once per leg. The tunneled
+    platform's remote-compile endpoint fails transiently — retry."""
+    for i in range(attempts):
+        try:
+            return jitted.lower(*args).compile()
+        except Exception as e:
+            if i == attempts - 1:
+                raise
+            print(f'# compile attempt {i + 1} failed '
+                  f'({type(e).__name__}: {str(e)[:120]}); retrying',
+                  file=sys.stderr)
+            time.sleep(5)
 
 
 def _perf_stats(compiled, step_seconds):

@@ -14,7 +14,7 @@ try:  # models land after ops in the build order; keep ops importable alone.
 except ImportError:  # pragma: no cover
     DGMC = None
 
-__version__ = '0.2.0'
+__version__ = '0.3.0'
 
 __all__ = [
     'DGMC',

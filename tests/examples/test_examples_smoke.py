@@ -10,27 +10,8 @@ import pytest
 
 @pytest.fixture
 def dbp_root(tmp_path):
-    rng = np.random.RandomState(0)
-    d = tmp_path / 'zh_en'
-    d.mkdir()
-    n1, n2 = 12, 14
-    (d / 'ent_ids_1').write_text(
-        ''.join(f'{i}\te{i}\n' for i in range(n1)))
-    (d / 'ent_ids_2').write_text(
-        ''.join(f'{100 + i}\tf{i}\n' for i in range(n2)))
-    (d / 'triples_1').write_text(''.join(
-        f'{rng.randint(n1)}\t0\t{rng.randint(n1)}\n' for _ in range(30)))
-    (d / 'triples_2').write_text(''.join(
-        f'{100 + rng.randint(n2)}\t0\t{100 + rng.randint(n2)}\n'
-        for _ in range(36)))
-    (d / 'sup_pairs').write_text(
-        ''.join(f'{i}\t{100 + i}\n' for i in range(6)))
-    (d / 'ref_pairs').write_text(
-        ''.join(f'{i}\t{100 + i}\n' for i in range(6, 12)))
-    vecs = rng.randn(120, 8).tolist()
-    (d / 'zh_vectorList.json').write_text(json.dumps(vecs))
-    (d / 'en_vectorList.json').write_text(json.dumps(vecs))
-    return tmp_path
+    from tests.helpers import make_tiny_dbp15k
+    return make_tiny_dbp15k(tmp_path)
 
 
 @pytest.fixture

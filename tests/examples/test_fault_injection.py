@@ -1,0 +1,103 @@
+"""OS-level fault injection: SIGKILL a training run, restart, verify
+recovery (SURVEY.md §5 failure-detection/elastic row).
+
+The in-process resume test (`test_examples_smoke.py`) checks restore
+*logic*; this one checks the actual crash path: a subprocess running the
+DBP15K two-phase schedule is killed with SIGKILL the moment its first
+checkpoint lands on disk (so partial writes, unflushed logs and an
+optimizer mid-step are all in play), then the identical command reruns
+and must auto-resume past the killed epoch and finish the schedule.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+ARGS = ['--category', 'zh_en', '--dim', '8', '--rnd_dim', '4',
+        '--num_layers', '1', '--num_steps', '1', '--k', '2',
+        '--epochs', '6', '--phase1_epochs', '3', '--ckpt_every', '1']
+
+WORKER = r'''
+import sys
+import jax
+jax.config.update('jax_platforms', 'cpu')
+sys.path.insert(0, {repo!r})
+from dgmc_tpu.experiments import dbp15k
+dbp15k.main({args!r})
+print('RUN COMPLETE', flush=True)
+'''
+
+
+def _spawn(repo, args, out_path):
+    # stdout goes to a FILE, not a pipe: an undrained pipe can block a
+    # chatty worker before its first checkpoint and deadlock the test.
+    fh = open(out_path, 'w')
+    proc = subprocess.Popen(
+        [sys.executable, '-c', WORKER.format(repo=repo, args=args)],
+        stdout=fh, stderr=subprocess.STDOUT, text=True, cwd=repo)
+    proc._out_fh = fh
+    return proc
+
+
+def _finish(proc):
+    proc._out_fh.close()
+
+
+@pytest.mark.slow
+def test_sigkill_and_resume(tmp_path):
+    from tests.helpers import make_tiny_dbp15k
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    data = make_tiny_dbp15k(tmp_path / 'data')
+    ckpt = str(tmp_path / 'ckpt')
+    log = str(tmp_path / 'metrics.jsonl')
+    args = ARGS + ['--data_root', data, '--ckpt_dir', ckpt,
+                   '--metrics_log', log]
+    v_out, s_out = str(tmp_path / 'victim.log'), str(tmp_path / 'surv.log')
+
+    victim = _spawn(repo, args, v_out)
+    survivor = None
+    try:
+        # Kill as soon as any checkpoint step directory exists.
+        deadline = time.time() + 300
+        killed_after = None
+        while time.time() < deadline:
+            if victim.poll() is not None:  # finished before we could kill
+                break
+            steps = [int(p) for p in os.listdir(ckpt)
+                     if os.path.isdir(os.path.join(ckpt, p)) and p.isdigit()
+                     ] if os.path.isdir(ckpt) else []
+            if steps:
+                killed_after = max(steps)
+                victim.send_signal(signal.SIGKILL)
+                victim.wait(timeout=60)
+                break
+            time.sleep(0.2)
+        assert killed_after is not None, (
+            'no checkpoint appeared in time; victim output:\n'
+            + open(v_out).read()[-2000:])
+
+        survivor = _spawn(repo, args, s_out)
+        survivor.wait(timeout=600)
+    finally:
+        for p in (victim, survivor):
+            if p is not None and p.poll() is None:
+                p.kill()
+                p.wait()
+            if p is not None:
+                _finish(p)
+
+    out = open(s_out).read()
+    assert survivor.returncode == 0, out[-3000:]
+    assert 'Resumed from' in out, out[-3000:]
+    assert 'RUN COMPLETE' in out
+    # The resumed run crossed into phase 2 and reached the final epoch.
+    with open(log) as f:
+        events = [json.loads(line) for line in f]
+    assert any(e.get('event') == 'resume' for e in events)
+    assert any(e.get('phase') == 2 and e.get('step') == 6 for e in events)

@@ -1,9 +1,38 @@
 """Shared builders for small padded graph batches used across model tests."""
 
+import json
+import os
+
 import jax.numpy as jnp
 import numpy as np
 
 from dgmc_tpu.ops import GraphBatch
+
+
+def make_tiny_dbp15k(root, n1=12, n2=14, seed=0):
+    """Write a miniature DBP15K zh_en raw layout under ``root`` (shared by
+    the smoke fixtures and the subprocess fault-injection test)."""
+    rng = np.random.RandomState(seed)
+    d = os.path.join(str(root), 'zh_en')
+    os.makedirs(d, exist_ok=True)
+
+    def write(name, text):
+        with open(os.path.join(d, name), 'w') as f:
+            f.write(text)
+
+    write('ent_ids_1', ''.join(f'{i}\te{i}\n' for i in range(n1)))
+    write('ent_ids_2', ''.join(f'{100 + i}\tf{i}\n' for i in range(n2)))
+    write('triples_1', ''.join(
+        f'{rng.randint(n1)}\t0\t{rng.randint(n1)}\n' for _ in range(30)))
+    write('triples_2', ''.join(
+        f'{100 + rng.randint(n2)}\t0\t{100 + rng.randint(n2)}\n'
+        for _ in range(36)))
+    write('sup_pairs', ''.join(f'{i}\t{100 + i}\n' for i in range(6)))
+    write('ref_pairs', ''.join(f'{i}\t{100 + i}\n' for i in range(6, 12)))
+    vecs = rng.randn(120, 8).tolist()
+    write('zh_vectorList.json', json.dumps(vecs))
+    write('en_vectorList.json', json.dumps(vecs))
+    return str(root)
 
 
 def graph_from_edges(x, edges, num_nodes_pad=None, num_edges_pad=None,
