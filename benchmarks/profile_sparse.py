@@ -1,0 +1,166 @@
+"""Profile the DBP15K-scale sparse step: per-kernel device-time attribution.
+
+Wall-clock A/B runs on the shared tunneled chip vary +-15%; device-time
+totals from a ``jax.profiler.trace`` don't (benchmarks/README.md). This
+captures N steps, aggregates trace events on the device track, and maps
+``fusion.NNN`` kernel names back to HLO ``op_name`` metadata from the
+compiled executable so the totals are attributable to model stages.
+
+Usage: python profile_sparse.py [--route] [--bf16] [--steps N]
+"""
+
+import argparse
+import collections
+import glob
+import gzip
+import json
+import os
+import re
+import sys
+import tempfile
+
+import jax
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
+    __file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from timing import fence  # noqa: E402
+
+
+def build_step(route=False, bf16=False):
+    import bench
+    from dgmc_tpu.models import DGMC, RelCNN
+    from dgmc_tpu.train import create_train_state, make_train_step
+    from dgmc_tpu.utils.data import PairBatch
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(0)
+    s = bench._kg_side(bench.SP_N_S, bench.SP_E_S, bench.SP_DIM, rng)
+    t = bench._kg_side(bench.SP_N_T, bench.SP_E_T, bench.SP_DIM, rng)
+    y = np.full((1, bench.SP_N_S), -1, np.int32)
+    train_n = int(0.3 * bench.SP_N_S)
+    y[0, :train_n] = rng.permutation(bench.SP_N_T)[:train_n]
+    batch = jax.device_put(PairBatch(s=s, t=t, y=y, y_mask=y >= 0))
+    jax.block_until_ready(batch)
+
+    dt = jnp.bfloat16 if bf16 else None
+    psi_1 = RelCNN(bench.SP_DIM, 256, num_layers=3, dropout=0.5, dtype=dt)
+    psi_2 = RelCNN(32, 32, num_layers=3, dtype=dt)
+    model = DGMC(psi_1, psi_2, num_steps=10, k=bench.SP_K,
+                 topk_block=bench.SP_TOPK_BLOCK, route_sparse=route,
+                 dtype=dt)
+    tiny = PairBatch(s=bench._kg_side(32, 64, bench.SP_DIM, rng),
+                     t=bench._kg_side(32, 64, bench.SP_DIM, rng),
+                     y=np.zeros((1, 32), np.int32),
+                     y_mask=np.ones((1, 32), bool))
+    state = create_train_state(model, jax.random.key(0), tiny,
+                               learning_rate=1e-3)
+    step = make_train_step(model, loss_on_s0=False)
+    compiled = bench._aot_compile(step, state, batch, jax.random.key(1))
+    return compiled, state, batch
+
+
+def hlo_opname_map(compiled):
+    """Instruction name -> ``op_name`` metadata string, from the compiled
+    HLO text. Kernels whose metadata only exists on a called computation's
+    body (not the fusion root line) stay unmapped and fall into the
+    ``other`` rollup bucket — acceptable for this diagnostic."""
+    mapping = {}
+    for line in compiled.as_text().splitlines():
+        name = re.match(r'\s*%?([\w\.\-]+)\s*=', line)
+        op = re.search(r'op_name="([^"]+)"', line)
+        if name and op:
+            mapping.setdefault(name.group(1), op.group(1))
+    return mapping
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument('--route', action='store_true')
+    ap.add_argument('--bf16', action='store_true')
+    ap.add_argument('--steps', type=int, default=10)
+    args = ap.parse_args()
+
+    compiled, state, batch = build_step(route=args.route, bf16=args.bf16)
+    key = jax.random.key(1)
+    for _ in range(2):
+        key, sub = jax.random.split(key)
+        state, out = compiled(state, batch, sub)
+    fence(out['loss'])
+
+    tmp = tempfile.mkdtemp(prefix='sparse_trace_')
+    with jax.profiler.trace(tmp):
+        for _ in range(args.steps):
+            key, sub = jax.random.split(key)
+            state, out = compiled(state, batch, sub)
+        fence(out['loss'])
+
+    files = glob.glob(os.path.join(tmp, '**', '*.trace.json.gz'),
+                      recursive=True)
+    assert files, f'no trace file under {tmp}'
+    with gzip.open(sorted(files)[-1], 'rt') as f:
+        trace = json.load(f)
+
+    events = trace['traceEvents']
+    # Device tracks: pick pids whose process name mentions TPU / device.
+    pid_names = {e['pid']: e['args'].get('name', '')
+                 for e in events
+                 if e.get('ph') == 'M' and e.get('name') == 'process_name'
+                 and 'args' in e}
+    dev_pids = {p for p, n in pid_names.items()
+                if 'TPU' in n or 'Device' in n or '/device' in n.lower()}
+    if not dev_pids:  # fall back: every pid that has X events with dur
+        dev_pids = {e['pid'] for e in events if e.get('ph') == 'X'}
+
+    totals = collections.Counter()
+    counts = collections.Counter()
+    ops = {}
+    for e in events:
+        if e.get('ph') != 'X' or e.get('pid') not in dev_pids:
+            continue
+        name = e.get('name', '?')
+        # Skip module-level spans (the whole jitted program and bare
+        # step-number aggregates) — they double-count their kernels.
+        if re.match(r'^\d+$', name) or name.startswith('jit_'):
+            continue
+        totals[name] += e.get('dur', 0)
+        counts[name] += 1
+        if isinstance(e.get('args'), dict):
+            long = e['args'].get('long_name') or e['args'].get('tf_op', '')
+            if long:
+                ops.setdefault(name, long)
+
+    opmap = hlo_opname_map(compiled)
+    total_us = sum(totals.values())
+    print(f'# device total: {total_us / 1e3 / args.steps:.1f} ms/step '
+          f'across {len(totals)} kernel names '
+          f'({sum(counts.values()) / args.steps:.0f} kernel launches/step)')
+    print(f'{"ms/step":>8}  {"calls":>6}  kernel  [op_name]')
+    for name, us in totals.most_common(40):
+        op = opmap.get(name.split('.(')[0], '')
+        print(f'{us / 1e3 / args.steps:8.2f}  '
+              f'{counts[name] / args.steps:6.1f}  {name[:60]}  '
+              f'[{op[:80]}]')
+
+    # Stage-level rollup from op_name paths when available.
+    stage = collections.Counter()
+    for name, us in totals.items():
+        op = ops.get(name, '') + ' ' + opmap.get(name.split('.(')[0], '')
+        low = (op + ' ' + name).lower()
+        direction = 'bwd' if 'transpose(jvp' in low else 'fwd'
+        for pat in ('psi_1', 'psi_2', 'topk', 'scatter-add', 'adam',
+                    'take_along_axis', 'corr_route', 'softmax'):
+            if pat in low:
+                stage[f'{direction}:{pat}'] += us
+                break
+        else:
+            stage[f'{direction}:other'] += us
+    print('\n# rollup (ms/step):')
+    for k, us in stage.most_common():
+        print(f'  {k:20s} {us / 1e3 / args.steps:8.2f}')
+
+
+if __name__ == '__main__':
+    main()

@@ -49,15 +49,39 @@ def fetch(url, dest_path, progress=True):
     return dest_path
 
 
+def _check_member_path(name, dest_dir):
+    """Reject absolute paths and ``..`` traversal in archive members —
+    several dataset archives arrive over plain HTTP, so a tampered archive
+    must not be able to write outside ``dest_dir``."""
+    target = os.path.realpath(os.path.join(dest_dir, name))
+    base = os.path.realpath(dest_dir)
+    if not (target == base or target.startswith(base + os.sep)):
+        raise ValueError(f'archive member escapes extraction dir: {name!r}')
+
+
 def extract(archive, dest_dir):
-    """Extract a .zip/.tar/.tgz/.tar.gz archive into ``dest_dir``."""
+    """Extract a .zip/.tar/.tgz/.tar.gz archive into ``dest_dir``,
+    refusing path-traversal members."""
     os.makedirs(dest_dir, exist_ok=True)
     if zipfile.is_zipfile(archive):
         with zipfile.ZipFile(archive) as z:
+            for name in z.namelist():
+                _check_member_path(name, dest_dir)
             z.extractall(dest_dir)
     elif tarfile.is_tarfile(archive):
         with tarfile.open(archive) as t:
-            t.extractall(dest_dir)
+            if hasattr(tarfile, 'data_filter'):
+                # The stdlib filter also strips setuid bits / device nodes
+                # and rejects traversal (default from Python 3.14; opt-in
+                # since 3.12 security backports).
+                t.extractall(dest_dir, filter='data')
+            else:
+                for m in t.getmembers():
+                    _check_member_path(m.name, dest_dir)
+                    if not (m.isreg() or m.isdir()):
+                        raise ValueError(
+                            f'refusing non-regular tar member: {m.name!r}')
+                t.extractall(dest_dir)
     else:
         raise ValueError(f'unrecognized archive format: {archive}')
     return dest_dir

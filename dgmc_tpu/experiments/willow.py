@@ -208,10 +208,17 @@ def main(argv=None):
             o1, o2 = rng.permutation(len(ds)), rng.permutation(len(ds))
             pairs = [GraphPair(s=ds[int(i)], t=ds[int(j)], y_col=gt)
                      for i, j in zip(o1, o2)]
-            # Fixed batch size so every batch reuses one compiled step;
-            # the ragged tail is dropped (orders reshuffle every sweep).
-            for c in range(0, len(pairs) - eb + 1, eb):
-                b = pad_pair_batch(pairs[c:c + eb], num_nodes, num_edges)
+            # Fixed batch size so every batch reuses one compiled step; the
+            # ragged tail is padded with masked pairs (y_col=-1 => zero
+            # count) so every zipped pair of the sweep is evaluated,
+            # matching the reference's per-pair protocol
+            # (reference willow.py:125-130).
+            mask_pair = GraphPair(s=pairs[0].s, t=pairs[0].t,
+                                  y_col=np.full(NUM_KP, -1, np.int64))
+            for c in range(0, len(pairs), eb):
+                chunk = pairs[c:c + eb]
+                chunk += [mask_pair] * (eb - len(chunk))
+                b = pad_pair_batch(chunk, num_nodes, num_edges)
                 key, sub = jax.random.split(key)
                 out = eval_step(run_state, b, sub)
                 correct = correct + out['correct']

@@ -32,7 +32,7 @@ TPU-first design decisions:
   (reference ``examples/dbp15k.py:63-69``) with explicit phase config.
 """
 
-from typing import Optional
+from typing import Any, Optional
 
 import jax
 import jax.numpy as jnp
@@ -110,6 +110,14 @@ class DGMC(nn.Module):
     # so a single huge pair (DBP15K-scale) spreads its activation state
     # across chips. GSPMD propagates the layout through the consensus loop.
     corr_sharding: Optional[object] = None
+    # Mixed-precision compute dtype for the matching stage itself (the
+    # similarity GEMMs, candidate search operands and consensus MLP):
+    # psi outputs are cast to it, matmuls run on the bf16 MXU, and the
+    # correspondence logits S_hat accumulate in float32
+    # (preferred_element_type) so softmax/loss numerics stay f32.
+    # Parameters always stay float32. None = float32 throughout. Set the
+    # same dtype on the backbones for end-to-end mixed precision.
+    dtype: Optional[Any] = None
     # Pallas kernel for the dense consensus update: bounds the
     # [B, N_s, N_t, R] difference tensor to one VMEM tile and rematerializes
     # it tile-by-tile in the backward. ``None`` (default) auto-enables it on
@@ -121,6 +129,20 @@ class DGMC(nn.Module):
     # unfused form wins (benchmarks/fused_consensus_tpu.json, bench.py).
     # Forced off when corr_sharding is set (GSPMD owns the layout there).
     fused_consensus: Optional[bool] = None
+    # Sparse path: route every per-iteration scatter (the r_t projection's
+    # segment-sum and the candidate gathers' scatter-add VJPs) through a
+    # once-per-step blocked sort of S_idx (ops/corr_route.py) — matmuls
+    # only, reused by every consensus iteration and the backward.
+    # Default OFF: measured at DBP15K scale (15000x20000, k=10+10+GT) the
+    # routed step is ~16% SLOWER than the segment-sum form (433.5 vs
+    # 373.8 ms full step; 35.9 vs 30.9 ms/iteration + ~10 ms of route
+    # build in the base) — the per-candidate row gather of ~395k padded
+    # 128-byte rows runs at the chip's ~10-31 GB/s random-gather rate,
+    # costing more than the ~1.2 ms scatter it replaces. Kept as an
+    # explicit option: it is matmul/gather-only (no scatter anywhere), so
+    # it remains valid under corr_sharding / shard_map where scatter
+    # performance or partitioning rules differ.
+    route_sparse: Optional[bool] = None
     # Run each backbone ONCE per application point on the node-axis
     # disjoint union of the (source, target) pair instead of twice (once
     # per side). Requires blocked-adjacency graphs (ops/blocked.py) and a
@@ -194,6 +216,14 @@ class DGMC(nn.Module):
             and graph_t.blocks_in is not None
             and graph_s.blocks_in.rows == graph_t.blocks_in.rows
         )
+        if self.batch_pair is True and not can_stack:
+            # Mirror the loud BatchNorm rejection below: a user who
+            # explicitly requested union mode must not silently benchmark
+            # the two-call path.
+            raise ValueError(
+                'batch_pair=True requires blocked-adjacency graphs on both '
+                'sides (ops/blocked.attach_blocks) with matching block '
+                'rows and edge_attr widths; this pair cannot be stacked')
 
         def merges(m):
             if not can_stack:
@@ -218,6 +248,8 @@ class DGMC(nn.Module):
                 lambda x, g: run_psi(m, x, g, train=train), x_s_in, x_t_in)
 
         h_s, h_t = run_pair(self.psi_1, graph_s.x, graph_t.x, merge_1)
+        if self.dtype is not None:
+            h_s, h_t = h_s.astype(self.dtype), h_t.astype(self.dtype)
         if detach:
             h_s = jax.lax.stop_gradient(h_s)
             h_t = jax.lax.stop_gradient(h_t)
@@ -237,8 +269,12 @@ class DGMC(nn.Module):
         mlp_b2 = self.param('mlp_out_bias', nn.initializers.zeros, (1,))
 
         def consensus_mlp(d):
-            h = nn.relu(d @ mlp_w1 + mlp_b1)
-            return (h @ mlp_w2)[..., 0] + mlp_b2[0]
+            w1, w2 = mlp_w1.astype(d.dtype), mlp_w2.astype(d.dtype)
+            h = nn.relu(d @ w1 + mlp_b1.astype(d.dtype))
+            out = jax.lax.dot_general(
+                h, w2, (((h.ndim - 1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            return out[..., 0] + mlp_b2[0]
 
         def consensus_factored(u_s, u_t_rows):
             """``relu(D @ W1 + b1) @ W2 + b2`` with the first matmul
@@ -252,15 +288,61 @@ class DGMC(nn.Module):
             extra saved activations outweigh the removed matmul), so the
             sparse loop keeps the direct ``consensus_mlp(D)`` form."""
             h = nn.relu(u_s[:, :, None, :] - u_t_rows)
-            return (h @ mlp_w2)[..., 0] + mlp_b2[0]
+            out = jax.lax.dot_general(
+                h, mlp_w2.astype(h.dtype), (((3,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            return out[..., 0] + mlp_b2[0]
 
         def noise(step):
             key = self.make_rng('noise')
             return jax.random.normal(key, (B, N_s, R_in), h_s.dtype)
 
+        def prefetch_source(num_steps):
+            """Batch the source side of ψ₂ across ALL consensus iterations.
+
+            Per iteration the loop runs ψ₂ twice (reference
+            ``dgmc/models/dgmc.py:173-176``) — but the source-side input
+            ``r_s`` is pre-drawable indicator noise, independent of the
+            evolving correspondence; only the target side (``r_t = S·r_s``)
+            is sequential. So all ``num_steps`` source applications run as
+            ONE ψ₂ call on a step-tiled batch: identical values (same
+            per-step PRNG draws, shared parameters), ~num_steps× fewer
+            kernel launches and num_steps×-larger gathers/GEMMs on the
+            source graph — the profiled sparse step spends >50% of its
+            time in ψ₂ dispatch+gather (benchmarks/profile_sparse.py).
+
+            Valid only when ψ₂ supports channel-packed evaluation
+            (``streams``, currently RelCNN) and is batch-agnostic: no
+            batch statistics and no active dropout (a packed evaluation
+            would draw one mask across steps), and the pair isn't
+            union-merged. A step-tiled *batch* fallback was measured and
+            rejected: identical device time on the sparse workload (the
+            gathers are row-bound, not launch-bound) and a 2.5× peak-HBM
+            regression on the dense flagship.
+            """
+            if num_steps <= 1 or merge_2:
+                return None
+            if getattr(self.psi_2, 'batch_norm', False):
+                return None
+            if train and getattr(self.psi_2, 'dropout', 0.0):
+                return None
+            if not getattr(self.psi_2, 'supports_streams', False):
+                return None
+            r_all = jnp.stack([noise(i) for i in range(num_steps)])
+            T = num_steps
+            # Channel-packed form: the node tables the edge gathers read
+            # become T× wider (1.28 KB rows instead of 128 B at the
+            # DBP15K config), so the latency-bound random gathers run
+            # once for all T iterations.
+            x = r_all.transpose(1, 2, 0, 3).reshape(B, N_s, T * R_in)
+            o = run_psi(self.psi_2, x, graph_s, train=train, streams=T)
+            return r_all, o.reshape(B, N_s, T, -1).transpose(2, 0, 1, 3)
+
         if self.k < 1:
             # ---- Dense variant ----
-            S_hat = self._constrain(jnp.einsum('bsc,btc->bst', h_s, h_t))
+            S_hat = self._constrain(
+                jnp.einsum('bsc,btc->bst', h_s, h_t,
+                           preferred_element_type=jnp.float32))
             S_mask = s_mask[:, :, None] & t_mask[:, None, :]
             S_0 = masked_softmax(S_hat, S_mask)
 
@@ -274,26 +356,33 @@ class DGMC(nn.Module):
                 # R = 256 would blow the 16 MB scoped-VMEM limit.
                 use_fused = (jax.default_backend() == 'tpu'
                              and fused_kernels_allowed()
-                             and not jax.typeof(h_s).vma
                              and N_s >= TILE_S and N_t >= TILE_T
                              and R_out <= 128)
             else:
                 use_fused = self.fused_consensus
             use_fused = use_fused and self.corr_sharding is None
+            pre = prefetch_source(num_steps)
             for step in range(num_steps):
                 S = masked_softmax(S_hat, S_mask)
-                r_s = noise(step)
+                r_s = pre[0][step] if pre is not None else noise(step)
                 r_t = jnp.einsum('bst,bsr->btr', S, r_s)
-                o_s, o_t = run_pair(self.psi_2, r_s, r_t, merge_2)
+                if pre is not None:
+                    o_s = pre[1][step]
+                    o_t = run_psi(self.psi_2, r_t, graph_t, train=train)
+                else:
+                    o_s, o_t = run_pair(self.psi_2, r_s, r_t, merge_2)
                 if use_fused:
                     from dgmc_tpu.ops.pallas import consensus_update
+                    cast = lambda a: a.astype(o_s.dtype)  # noqa: E731
                     delta = consensus_update(
-                        o_s, o_t, mlp_w1, mlp_b1, mlp_w2, mlp_b2,
+                        o_s, o_t, cast(mlp_w1), cast(mlp_b1),
+                        cast(mlp_w2), cast(mlp_b2),
                         jax.default_backend() != 'tpu')  # interpret off-TPU
                 else:
+                    w1 = mlp_w1.astype(o_s.dtype)
                     delta = consensus_factored(
-                        o_s @ mlp_w1 + mlp_b1,
-                        (o_t @ mlp_w1)[:, None, :, :])
+                        o_s @ w1 + mlp_b1.astype(o_s.dtype),
+                        (o_t @ w1)[:, None, :, :])
                 S_hat = self._constrain(
                     S_hat + jnp.where(S_mask, delta, 0.0))
 
@@ -332,23 +421,46 @@ class DGMC(nn.Module):
         entry_mask = jnp.take_along_axis(
             t_mask, S_idx.reshape(B, -1), axis=1).reshape(S_idx.shape)
 
-        h_t_cand = gather_t(h_t, S_idx)
-        S_hat = jnp.einsum('bsc,bskc->bsk', h_s, h_t_cand)
+        # Scatter-free candidate routing (see route_sparse field): one
+        # device-side blocked sort of the final S_idx serves every
+        # consensus iteration and the whole backward pass.
+        use_route = bool(self.route_sparse)
+        if use_route:
+            from dgmc_tpu.ops.corr_route import (build_corr_route,
+                                                 sparse_gather,
+                                                 sparse_project)
+            route = build_corr_route(S_idx, N_t)
+            cand_rows = lambda feat: sparse_gather(feat, S_idx, route)  # noqa: E731,E501
+            project = lambda S, r_s: sparse_project(S, r_s, S_idx, route)  # noqa: E731,E501
+        else:
+            cand_rows = lambda feat: gather_t(feat, S_idx)  # noqa: E731
+
+            def project(S, r_s):
+                contrib = S[..., None] * r_s[:, :, None, :]
+                K_ = S_idx.shape[-1]
+
+                def scat(c, idx):
+                    return jax.ops.segment_sum(c, idx, num_segments=N_t)
+
+                return jax.vmap(scat)(contrib.reshape(B, N_s * K_, R_in),
+                                      S_idx.reshape(B, N_s * K_))
+
+        h_t_cand = cand_rows(h_t)
+        S_hat = jnp.einsum('bsc,bskc->bsk', h_s, h_t_cand,
+                           preferred_element_type=jnp.float32)
         S_0 = masked_softmax(S_hat, entry_mask) * s_mask[..., None]
 
-        K = S_idx.shape[-1]
+        pre = prefetch_source(num_steps)
         for step in range(num_steps):
             S = masked_softmax(S_hat, entry_mask) * s_mask[..., None]
-            r_s = noise(step)
-            contrib = S[..., None] * r_s[:, :, None, :]   # [B, N_s, K, R_in]
-
-            def scat(c, idx):
-                return jax.ops.segment_sum(c, idx, num_segments=N_t)
-
-            r_t = jax.vmap(scat)(contrib.reshape(B, N_s * K, R_in),
-                                 S_idx.reshape(B, N_s * K))
-            o_s, o_t = run_pair(self.psi_2, r_s, r_t, merge_2)
-            o_t_cand = gather_t(o_t, S_idx)
+            r_s = pre[0][step] if pre is not None else noise(step)
+            r_t = project(S, r_s)
+            if pre is not None:
+                o_s = pre[1][step]
+                o_t = run_psi(self.psi_2, r_t, graph_t, train=train)
+            else:
+                o_s, o_t = run_pair(self.psi_2, r_s, r_t, merge_2)
+            o_t_cand = cand_rows(o_t)
             D = o_s[:, :, None, :] - o_t_cand
             S_hat = self._constrain(S_hat + consensus_mlp(D))
 

@@ -15,6 +15,8 @@ Constructor note: the second positional argument is named ``channels``
 the reference's reassigned ``out_channels`` attribute).
 """
 
+from typing import Any, Optional
+
 import jax.numpy as jnp
 from flax import linen as nn
 
@@ -43,6 +45,9 @@ class GIN(nn.Module):
     batch_norm: bool = False
     cat: bool = True
     lin: bool = True
+    # Mixed-precision compute dtype for the per-layer MLPs and final Dense;
+    # parameters stay float32. None = float32.
+    dtype: Optional[Any] = None
 
     @property
     def out_channels(self):
@@ -58,13 +63,14 @@ class GIN(nn.Module):
         in_ch = self.in_channels
         for i in range(self.num_layers):
             mlp = MLP(in_ch, self.channels, 2, self.batch_norm, dropout=0.0,
-                      name=f'mlp_{i}')
+                      dtype=self.dtype, name=f'mlp_{i}')
             xs.append(GINConv(mlp, name=f'conv_{i}')(xs[-1], graph,
                                                      train=train))
             in_ch = self.channels
         out = jnp.concatenate(xs, axis=-1) if self.cat else xs[-1]
         if self.lin:
-            out = nn.Dense(self.channels, name='final')(out)
+            out = nn.Dense(self.channels, name='final',
+                           dtype=self.dtype)(out)
         return out
 
     def __repr__(self):

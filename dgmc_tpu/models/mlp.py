@@ -6,6 +6,8 @@ layers; dropout applied *before the final* Dense only. Works on padded
 ``[B, N, C]`` node tensors with an optional node mask (for BN statistics).
 """
 
+from typing import Any, Optional
+
 from flax import linen as nn
 
 from dgmc_tpu.models.norm import MaskedBatchNorm
@@ -17,6 +19,10 @@ class MLP(nn.Module):
     num_layers: int
     batch_norm: bool = False
     dropout: float = 0.0
+    # Mixed-precision compute dtype (e.g. jnp.bfloat16): matmuls run on the
+    # bf16 MXU while parameters stay float32 (flax promotes per-op). BN
+    # statistics are always float32 (see MaskedBatchNorm). None = float32.
+    dtype: Optional[Any] = None
 
     @nn.compact
     def __call__(self, x, node_mask=None, train=False):
@@ -24,7 +30,8 @@ class MLP(nn.Module):
             last = i == self.num_layers - 1
             if last:
                 x = nn.Dropout(self.dropout, deterministic=not train)(x)
-            x = nn.Dense(self.out_channels, name=f'dense_{i}')(x)
+            x = nn.Dense(self.out_channels, name=f'dense_{i}',
+                         dtype=self.dtype)(x)
             if not last:
                 x = nn.relu(x)
                 if self.batch_norm:

@@ -13,6 +13,8 @@ Constructor note: second positional arg is ``channels``; the effective
 output width is the ``out_channels`` property (see ``gin.py`` note).
 """
 
+from typing import Any, Optional
+
 import jax.numpy as jnp
 from flax import linen as nn
 
@@ -22,11 +24,33 @@ from dgmc_tpu.ops.graph import gather_nodes, scatter_to_nodes
 
 class RelConv(nn.Module):
     out_features: int
+    dtype: Optional[Any] = None
 
     @nn.compact
-    def __call__(self, x, graph, train=False):
-        h1 = nn.Dense(self.out_features, use_bias=False, name='lin1')(x)
-        h2 = nn.Dense(self.out_features, use_bias=False, name='lin2')(x)
+    def __call__(self, x, graph, train=False, streams=1):
+        """``streams > 1`` evaluates the SAME convolution on ``streams``
+        independent channel groups laid out channel-wise
+        (``x: [B, N, streams * C]``). The per-group math is identical to
+        ``streams`` separate calls (flax ``Dense`` maps the trailing axis;
+        aggregation is channel-independent), but the node tables the edge
+        gathers read become ``streams``× wider — at DBP15K scale the
+        128-byte per-row gathers run at only ~10 GB/s (latency-bound), so
+        packing the consensus iterations into channels is ~streams× fewer
+        random rows for the same bytes. Used by DGMC's source-side
+        iteration batching (``models/dgmc.py prefetch_source``).
+        """
+        B, N = x.shape[0], x.shape[1]
+
+        def grouped(dense, v):
+            if streams == 1:
+                return dense(v)
+            g = dense(v.reshape(B, N, streams, -1))
+            return g.reshape(B, N, -1)
+
+        h1 = grouped(nn.Dense(self.out_features, use_bias=False,
+                              name='lin1', dtype=self.dtype), x)
+        h2 = grouped(nn.Dense(self.out_features, use_bias=False,
+                              name='lin2', dtype=self.dtype), x)
         if graph.blocks_in is not None:
             # Scatter-free MXU path: blocked one-hot contractions with a
             # matmul (never scatter-add) backward via the transposed
@@ -47,10 +71,16 @@ class RelConv(nn.Module):
             m_out = gather_nodes(h2, graph.receivers)
             a_out = scatter_to_nodes(m_out, graph.senders, graph.edge_mask,
                                      x.shape[1], aggr='mean')
-        return nn.Dense(self.out_features, name='root')(x) + a_in + a_out
+        root = grouped(nn.Dense(self.out_features, name='root',
+                                dtype=self.dtype), x)
+        return root + (a_in + a_out).astype(root.dtype)
 
 
 class RelCNN(nn.Module):
+    # Capability flag consumed by DGMC.prefetch_source: this backbone can
+    # evaluate `streams` channel-packed inputs in one pass (see __call__).
+    supports_streams = True
+
     in_channels: int
     channels: int
     num_layers: int
@@ -58,6 +88,9 @@ class RelCNN(nn.Module):
     cat: bool = True
     lin: bool = True
     dropout: float = 0.0
+    # Mixed-precision compute dtype for every Dense / aggregation matmul;
+    # parameters and BN statistics stay float32. None = float32.
+    dtype: Optional[Any] = None
 
     @property
     def out_channels(self):
@@ -68,21 +101,42 @@ class RelCNN(nn.Module):
         return self.channels
 
     @nn.compact
-    def __call__(self, x, graph, train=False):
+    def __call__(self, x, graph, train=False, streams=1):
+        """``streams > 1``: evaluate ``streams`` channel-packed inputs in
+        one pass with shared parameters (see :class:`RelConv`). Requires
+        ``batch_norm=False`` and inactive dropout — both would couple the
+        groups."""
+        if streams > 1 and self.batch_norm:
+            raise ValueError('streams>1 is invalid with batch_norm=True: '
+                             'batch statistics would couple the streams')
+        B, N = x.shape[0], x.shape[1]
         xs = [x]
         for i in range(self.num_layers):
-            h = RelConv(self.channels, name=f'conv_{i}')(xs[-1], graph,
-                                                         train=train)
+            h = RelConv(self.channels, dtype=self.dtype,
+                        name=f'conv_{i}')(xs[-1], graph, train=train,
+                                          streams=streams)
             h = nn.relu(h)
             if self.batch_norm:
                 h = MaskedBatchNorm(name=f'bn_{i}')(
                     h, graph.node_mask, use_running_average=not train)
             h = nn.Dropout(self.dropout, deterministic=not train)(h)
             xs.append(h)
-        out = jnp.concatenate(xs, axis=-1) if self.cat else xs[-1]
+        if streams == 1:
+            out = jnp.concatenate(xs, axis=-1) if self.cat else xs[-1]
+            if self.lin:
+                out = nn.Dense(self.channels, name='final',
+                               dtype=self.dtype)(out)
+            return out
+        # Grouped jumping-knowledge concat + final Dense: per group.
+        if self.cat:
+            parts = [v.reshape(B, N, streams, -1) for v in xs]
+            out = jnp.concatenate(parts, axis=-1)
+        else:
+            out = xs[-1].reshape(B, N, streams, -1)
         if self.lin:
-            out = nn.Dense(self.channels, name='final')(out)
-        return out
+            out = nn.Dense(self.channels, name='final',
+                           dtype=self.dtype)(out)
+        return out.reshape(B, N, -1)
 
     def __repr__(self):
         return (f'{type(self).__name__}({self.in_channels}, '

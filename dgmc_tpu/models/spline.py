@@ -17,7 +17,7 @@ and blends them with the closed-form basis weights from
 MXU-tileable; XLA fuses the basis blend into the gather.
 """
 
-from typing import Optional
+from typing import Any, Optional
 
 import jax.numpy as jnp
 from flax import linen as nn
@@ -31,6 +31,9 @@ class SplineConv(nn.Module):
     dim: int
     kernel_size: int = 5
     degree: int = 1
+    # Mixed-precision compute dtype for the kernel GEMM / root Dense;
+    # parameters stay float32. None = float32.
+    dtype: Optional[Any] = None
     # None = auto: on TPU, when the per-graph working set fits VMEM, route
     # and aggregate via the fused Pallas kernel (MXU matmuls per graph,
     # zero HBM gathers) instead of XLA gather + scatter — bit-identical
@@ -53,7 +56,10 @@ class SplineConv(nn.Module):
             (KD, C_in, self.out_features))
 
         # [B, N, KD * C_out]: every node through every kernel matrix — one
-        # MXU GEMM.
+        # MXU GEMM (in the compute dtype when the bf16 policy is on).
+        if self.dtype is not None:
+            x = x.astype(self.dtype)
+            weight = weight.astype(self.dtype)
         t = x @ weight.transpose(1, 0, 2).reshape(C_in, KD * self.out_features)
         t = t.reshape(B, N * KD, self.out_features)
 
@@ -70,7 +76,6 @@ class SplineConv(nn.Module):
         if use_fused is None:
             use_fused = (jax.default_backend() == 'tpu'
                          and fused_kernels_allowed()
-                         and not jax.typeof(x).vma
                          and route_aggregate_fits(N, E, KD,
                                                   self.out_features))
         if use_fused:
@@ -83,9 +88,10 @@ class SplineConv(nn.Module):
             msgs = jnp.einsum('bea,beao->beo', basis.astype(x.dtype), picked)
             agg = scatter_to_nodes(msgs, graph.receivers, graph.edge_mask,
                                    N, aggr='mean')
-        root = nn.Dense(self.out_features, use_bias=False, name='root')(x)
+        root = nn.Dense(self.out_features, use_bias=False, name='root',
+                        dtype=self.dtype)(x)
         bias = self.param('bias', nn.initializers.zeros, (self.out_features,))
-        return agg + root + bias
+        return agg.astype(root.dtype) + root + bias.astype(root.dtype)
 
 
 class SplineCNN(nn.Module):
@@ -100,6 +106,8 @@ class SplineCNN(nn.Module):
     # TPU at fitting sizes); set False inside GSPMD-partitioned programs —
     # pallas_call has no partitioning rule (see DGMC.corr_sharding).
     fused: Optional[bool] = None
+    # Mixed-precision compute dtype; parameters stay float32.
+    dtype: Optional[Any] = None
 
     @property
     def out_channels(self):
@@ -114,12 +122,14 @@ class SplineCNN(nn.Module):
         xs = [x]
         for i in range(self.num_layers):
             h = SplineConv(self.channels, self.dim, fused=self.fused,
+                           dtype=self.dtype,
                            name=f'conv_{i}')(xs[-1], graph, train=train)
             xs.append(nn.relu(h))
         out = jnp.concatenate(xs, axis=-1) if self.cat else xs[-1]
         out = nn.Dropout(self.dropout, deterministic=not train)(out)
         if self.lin:
-            out = nn.Dense(self.channels, name='final')(out)
+            out = nn.Dense(self.channels, name='final',
+                           dtype=self.dtype)(out)
         return out
 
     def __repr__(self):

@@ -1,0 +1,177 @@
+"""Scatter-free routing for the sparse correspondence candidate set.
+
+The sparse consensus loop's device pattern (reference
+``dgmc/models/dgmc.py:204-223``) is, per iteration: project indicator
+functions onto the target graph through the candidate set
+(``r_t[t] += S[s, k] * r_s[s]`` for every candidate ``S_idx[s, k] == t`` —
+a ``segment_sum``, i.e. a scatter-add), and gather the consensus
+colourings back at the candidates (whose autodiff transpose is another
+scatter-add). TPU has no fast scatter (measured ~1.2-3 ms per scatter op
+regardless of payload, ``benchmarks/README.md``); it has a fast MXU.
+
+``S_idx`` is **iteration-invariant** within one forward/backward: the
+candidate search runs once per step, and the 10 consensus iterations plus
+the whole backward pass all route through the same index set. So the
+candidate set is sorted ONCE per step, on device, into node-range-aligned
+blocks — the device-side analog of the host-side edge blocking in
+``dgmc_tpu/ops/blocked.py`` — and every scatter the loop needs becomes a
+blocked one-hot MXU contraction over that structure:
+
+- :func:`sparse_project` (forward ``r_t`` projection): gather ``r_s`` rows
+  at the blocked source ids, scale by the per-candidate ``S`` value, and
+  contract with the ``[E_b, rows]`` one-hot routing tensor. The backward
+  needs NO routing at all: in original ``[N_s, K]`` order the cotangent is
+  ``d_r_t`` gathered at ``S_idx`` (a gather), reduced over ``K`` — every
+  candidate of source row ``s`` lives in row ``s``.
+- :func:`sparse_gather` (candidate gather with a matmul transpose): the
+  forward is a plain ``take_along_axis`` row gather; the backward routes
+  the cotangent rows through the blocked structure instead of emitting
+  XLA's scatter-add gather-VJP.
+
+The routing tensors depend only on ``S_idx``, so XLA CSEs one copy across
+all consensus iterations AND both passes of a training step.
+
+Static-shape blocking on device: after sorting candidates by target, the
+entries of target range ``r`` (``rows`` consecutive target nodes) occupy
+one contiguous run; each range takes ``ceil(count_r / E_b)`` blocks, and
+the total is bounded by ``num_ranges + E // E_b`` blocks — the static
+block count. Block start offsets derive from a ``searchsorted`` over the
+per-range cumulative block counts; ragged tails are masked.
+"""
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from dgmc_tpu.ops.blocked import _routed
+
+
+@struct.dataclass
+class CorrRoute:
+    """Blocked routing structure for one candidate set ``S_idx [B, N_s, K]``.
+
+    ``ent [B, NB, E_b]`` — flat candidate id (``s * K + k``) per blocked
+    entry; ``src [B, NB, E_b]`` — its source row ``s``; ``dst_local`` /
+    ``mask`` / ``range_id`` / ``rows`` / ``num_ranges`` — as in
+    :class:`~dgmc_tpu.ops.blocked.EdgeBlocks`. ``n_t`` is the target node
+    count (static).
+    """
+    ent: jnp.ndarray
+    src: jnp.ndarray
+    dst_local: jnp.ndarray
+    mask: jnp.ndarray
+    range_id: jnp.ndarray
+    rows: int = struct.field(pytree_node=False)
+    num_ranges: int = struct.field(pytree_node=False)
+    n_t: int = struct.field(pytree_node=False)
+
+
+def build_corr_route(S_idx, n_t, rows=128, block_entries=512):
+    """Sort + block the candidate set on device; see module docstring.
+
+    S_idx: ``[B, N_s, K]`` int32 target ids in ``[0, n_t)``. Entries from
+    padded source rows may hold arbitrary valid ids — their contributions
+    are zeroed by the ``S`` scale (forward) or a zero cotangent (backward),
+    exactly as the segment-sum formulation they replace.
+    """
+    B, N_s, K = S_idx.shape
+    E = N_s * K
+    num_ranges = -(-n_t // rows)
+    nb = num_ranges + E // block_entries
+    eb = jnp.arange(block_entries, dtype=jnp.int32)
+
+    def one(idx_flat):
+        order = jnp.argsort(idx_flat, stable=True).astype(jnp.int32)
+        sdst = idx_flat[order]
+        bounds = jnp.arange(num_ranges + 1, dtype=jnp.int32) * rows
+        starts = jnp.searchsorted(sdst, bounds, side='left').astype(
+            jnp.int32)
+        counts = starts[1:] - starts[:-1]                   # [NR]
+        bpr = -(-counts // block_entries)                   # blocks per range
+        cum = jnp.cumsum(bpr)
+        j = jnp.arange(nb, dtype=jnp.int32)
+        rid = jnp.searchsorted(cum, j, side='right').astype(jnp.int32)
+        live = rid < num_ranges
+        rid_c = jnp.minimum(rid, num_ranges - 1)
+        prev = jnp.where(rid_c > 0, cum[rid_c - 1], 0)
+        within = j - prev
+        bstart = starts[rid_c] + within * block_entries
+        nvalid = jnp.clip(counts[rid_c] - within * block_entries, 0,
+                          block_entries)
+        offs = jnp.clip(bstart[:, None] + eb[None, :], 0, E - 1)
+        mask = (eb[None, :] < nvalid[:, None]) & live[:, None]
+        ent = order[offs]
+        loc = sdst[offs] - rid_c[:, None] * rows
+        return ent, ent // K, jnp.clip(loc, 0, rows - 1), mask, rid_c
+
+    ent, src, loc, mask, rid = jax.vmap(one)(S_idx.reshape(B, E))
+    return CorrRoute(ent=ent, src=src, dst_local=loc, mask=mask,
+                     range_id=rid, rows=rows, num_ranges=num_ranges,
+                     n_t=n_t)
+
+
+def _route_sum(table, idx, route, scale=None):
+    """``out[b, t] = Σ_{entries e: dst_e = t} scale_e * table[b, idx_e]``
+    as blocked one-hot contractions (no scatter)."""
+    return _routed(table, idx, route.dst_local, route.mask, route.range_id,
+                   route.rows, route.num_ranges, route.n_t, None,
+                   scale=scale)
+
+
+@jax.custom_vjp
+def sparse_project(S, r_s, S_idx, route):
+    """``r_t[b, t, :] = Σ_{s,k: S_idx[b,s,k]=t} S[b,s,k] * r_s[b,s,:]`` —
+    the consensus indicator projection (reference
+    ``dgmc/models/dgmc.py:211-213``) without materializing the
+    ``[B, N_s, K, R]`` contribution tensor and without any scatter."""
+    scale = jax.vmap(jnp.take)(
+        S.reshape(S.shape[0], -1), route.ent)              # [B, NB, E_b]
+    scale = jnp.where(route.mask, scale, 0.0)
+    return _route_sum(r_s, route.src, route, scale=scale)
+
+
+def _project_fwd(S, r_s, S_idx, route):
+    return sparse_project(S, r_s, S_idx, route), (S, r_s, S_idx)
+
+
+def _project_bwd(res, d_r_t):
+    S, r_s, S_idx = res
+    B, N_s, K = S_idx.shape
+    # In original [N_s, K] order the transpose is gathers + a K-reduction:
+    # d_S[s,k] = <d_r_t[S_idx[s,k]], r_s[s]>; d_r_s[s] = Σ_k S[s,k] * g[s,k].
+    flat = S_idx.reshape(B, N_s * K)
+    g = jnp.take_along_axis(d_r_t, flat[..., None], axis=1)
+    g = g.reshape(B, N_s, K, -1)                           # [B, N_s, K, R]
+    d_S = jnp.einsum('bskr,bsr->bsk', g, r_s)
+    d_r_s = jnp.einsum('bsk,bskr->bsr', S, g)
+    return d_S, d_r_s, None, None
+
+
+sparse_project.defvjp(_project_fwd, _project_bwd)
+
+
+@jax.custom_vjp
+def sparse_gather(feat, S_idx, route):
+    """``feat[b, S_idx[b, s, k], :]`` — the candidate-row gather (reference
+    ``dgmc/models/dgmc.py:205,216``) whose backward is a blocked one-hot
+    contraction instead of XLA's scatter-add gather-VJP."""
+    B, N_s, K = S_idx.shape
+    flat = jnp.take_along_axis(feat, S_idx.reshape(B, N_s * K)[..., None],
+                               axis=1)
+    return flat.reshape(B, N_s, K, feat.shape[-1])
+
+
+def _gather_fwd(feat, S_idx, route):
+    return sparse_gather(feat, S_idx, route), (route,)
+
+
+def _gather_bwd(res, g):
+    (route,) = res
+    B = g.shape[0]
+    table = g.reshape(B, -1, g.shape[-1])                  # [B, E, R]
+    return _route_sum(table, route.ent, route), None, None
+
+
+sparse_gather.defvjp(_gather_fwd, _gather_bwd)

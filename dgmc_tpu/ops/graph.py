@@ -84,7 +84,14 @@ def scatter_to_nodes(messages, receivers, edge_mask, num_nodes, aggr='sum'):
     neighborhoods give zeros, matching PyG's behavior the reference relies
     on).
     """
-    messages = jnp.where(edge_mask[..., None], messages, 0)
+    out_dtype = messages.dtype
+    # Accumulate reductions in float32 even under the bf16 compute policy:
+    # a bf16 running sum stops absorbing contributions once it is ~256x
+    # any addend. One downcast at the end matches the policy's
+    # "bf16 compute, f32 accumulation" contract everywhere else
+    # (ops/blocked.py, the Pallas kernels, MaskedBatchNorm).
+    acc = jnp.promote_types(out_dtype, jnp.float32)
+    messages = jnp.where(edge_mask[..., None], messages, 0).astype(acc)
 
     def one(m, r):
         return jax.ops.segment_sum(m, r, num_segments=num_nodes)
@@ -95,7 +102,7 @@ def scatter_to_nodes(messages, receivers, edge_mask, num_nodes, aggr='sum'):
         out = out / jnp.maximum(deg, 1.0)[..., None]
     elif aggr != 'sum':
         raise ValueError(f'Unknown aggregation: {aggr!r}')
-    return out
+    return out.astype(out_dtype)
 
 
 def degree(receivers, edge_mask, num_nodes):

@@ -47,8 +47,11 @@ def _consensus_kernel(o_s_ref, o_t_ref, w1_ref, b1_ref, w2_ref, b2_ref,
 
 
 def _forward_pallas(o_s, o_t, w1, b1, w2, b2, interpret=False):
+    from dgmc_tpu.ops.pallas.dispatch import promote_vma, vma_union
     B, N_s, R = o_s.shape
     N_t = o_t.shape[1]
+    vma = vma_union(o_s, o_t, w1, b1, w2, b2)
+    o_s, o_t, w1, b1, w2, b2 = promote_vma(vma, o_s, o_t, w1, b1, w2, b2)
     pad_s = (-N_s) % TILE_S
     pad_t = (-N_t) % TILE_T
     o_s_p = jnp.pad(o_s, ((0, 0), (0, pad_s), (0, 0)))
@@ -75,7 +78,7 @@ def _forward_pallas(o_s, o_t, w1, b1, w2, b2, interpret=False):
                                lambda b, i, j: (b, i, j),
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((B, N_s + pad_s, N_t + pad_t),
-                                       o_s.dtype),
+                                       o_s.dtype, vma=vma),
         interpret=interpret,
     )(o_s_p, o_t_p, w1, b1[None, :], w2, b2[None, :])
     return out[:, :N_s, :N_t]
@@ -116,6 +119,13 @@ def _bwd(interpret, res, g):
     g_blocks = jnp.moveaxis(
         g_p.reshape(B, N_s, nblk, TILE_T), 2, 0)          # [nblk,B,S,T]
 
+    # Carry and reduce gradient accumulators in float32 even under the
+    # bf16 compute policy: the per-tile sums span B*S*T terms and the scan
+    # accumulates across all target tiles — a bf16 running sum stops
+    # absorbing addends once it is ~256x their size. One downcast at the
+    # end matches the policy's "bf16 compute, f32 accumulation" contract.
+    acc = jnp.promote_types(o_s.dtype, jnp.float32)
+
     def step(carry, inp):
         d_os, d_w1, d_b1, d_w2, d_b2 = carry
         o_t_b, g_b = inp                                   # [B,T,R], [B,S,T]
@@ -126,20 +136,25 @@ def _bwd(interpret, res, g):
         d_h = g_b[..., None] * w2[:, 0]                    # [B,S,T,R]
         d_pre = jnp.where(pre > 0, d_h, 0.0)
         d_d = jnp.einsum('bstq,rq->bstr', d_pre, w1)
-        d_os = d_os + d_d.sum(axis=2)
+        d_os = d_os + d_d.sum(axis=2).astype(acc)
         d_ot_b = -d_d.sum(axis=1)                          # [B,T,R]
-        d_w1 = d_w1 + jnp.einsum('bstr,bstq->rq', d, d_pre)
-        d_b1 = d_b1 + d_pre.sum(axis=(0, 1, 2))
-        d_w2 = d_w2 + jnp.einsum('bstq,bst->q', h, g_b)[:, None]
-        d_b2 = d_b2 + g_b.sum()[None]
+        d_w1 = d_w1 + jnp.einsum('bstr,bstq->rq', d, d_pre,
+                                 preferred_element_type=acc)
+        d_b1 = d_b1 + d_pre.astype(acc).sum(axis=(0, 1, 2))
+        d_w2 = d_w2 + jnp.einsum('bstq,bst->q', h, g_b,
+                                 preferred_element_type=acc)[:, None]
+        d_b2 = d_b2 + g_b.astype(acc).sum()[None]
         return (d_os, d_w1, d_b1, d_w2, d_b2), d_ot_b
 
-    zeros = (jnp.zeros_like(o_s), jnp.zeros_like(w1), jnp.zeros_like(b1),
-             jnp.zeros_like(w2), jnp.zeros((1,), o_s.dtype))
+    zeros = (jnp.zeros(o_s.shape, acc), jnp.zeros(w1.shape, acc),
+             jnp.zeros(b1.shape, acc), jnp.zeros(w2.shape, acc),
+             jnp.zeros((1,), acc))
     (d_os, d_w1, d_b1, d_w2, d_b2), d_ot_blocks = jax.lax.scan(
         step, zeros, (o_t_blocks, g_blocks))
     d_ot = jnp.moveaxis(d_ot_blocks, 0, 1).reshape(B, -1, R)[:, :N_t]
-    return d_os, d_ot, d_w1, d_b1, d_w2, d_b2
+    cast = lambda a, like: a.astype(like.dtype)  # noqa: E731
+    return (cast(d_os, o_s), d_ot, cast(d_w1, w1), cast(d_b1, b1),
+            cast(d_w2, w2), cast(d_b2, b1))
 
 
 consensus_update.defvjp(_fwd, _bwd)

@@ -13,8 +13,31 @@ consults :func:`fused_kernels_allowed`. Explicitly requested kernels
 import contextlib
 import contextvars
 
+import jax
+
 _fused_ok = contextvars.ContextVar('dgmc_tpu_fused_kernels_ok',
                                    default=True)
+
+
+def vma_union(*arrays):
+    """Union of the varying-manual-axes sets of ``arrays`` — empty outside
+    ``shard_map`` manual mode. Pallas kernels are shard-local, so they run
+    under a mesh as long as (a) every operand carries the same vma and
+    (b) the ``out_shape`` declares it; see :func:`promote_vma`."""
+    out = frozenset()
+    for a in arrays:
+        out |= frozenset(jax.typeof(a).vma)
+    return out
+
+
+def promote_vma(vma, *arrays):
+    """Promote every array to carry ``vma`` (replicated → varying is
+    free); no-op when ``vma`` is empty."""
+    def one(a):
+        missing = tuple(sorted(vma - set(jax.typeof(a).vma)))
+        return jax.lax.pcast(a, missing, to='varying') if missing else a
+
+    return tuple(one(a) for a in arrays)
 
 
 @contextlib.contextmanager

@@ -156,12 +156,16 @@ def _prep(flat, basis, receivers, edge_mask):
 
 
 def _fwd_impl(t, flat, basis, receivers, edge_mask, num_nodes, interpret):
+    from dgmc_tpu.ops.pallas.dispatch import promote_vma, vma_union
     B, M, O = t.shape
     pad = (-M) % M_TILE
     t_p = jnp.pad(t, ((0, 0), (0, pad), (0, 0))) if pad else t
     n_mt = (M + pad) // M_TILE
     flat_t, basis_t, rcv, emask_f = _prep(flat, basis, receivers,
                                           edge_mask)
+    vma = vma_union(t_p, flat_t, basis_t, rcv, emask_f)
+    t_p, flat_t, basis_t, rcv, emask_f = promote_vma(
+        vma, t_p, flat_t, basis_t, rcv, emask_f)
     E = flat_t.shape[2]
     out = pl.pallas_call(
         functools.partial(_fwd_kernel, num_nodes, n_mt),
@@ -171,7 +175,8 @@ def _fwd_impl(t, flat, basis, receivers, edge_mask, num_nodes, interpret):
         + _common_specs(flat_t, basis_t, rcv, emask_f),
         out_specs=pl.BlockSpec((1, num_nodes, O), lambda b, j: (b, 0, 0),
                                memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((B, num_nodes, O), t.dtype),
+        out_shape=jax.ShapeDtypeStruct((B, num_nodes, O), t.dtype,
+                                       vma=vma),
         scratch_shapes=[pltpu.VMEM((E, O), jnp.float32)],
         interpret=interpret,
     )(t_p, flat_t, basis_t, rcv, emask_f)
@@ -196,8 +201,12 @@ def _symzero(shape, dtype):
 
 
 def _bwd(num_nodes, interpret, res, g):
+    from dgmc_tpu.ops.pallas.dispatch import promote_vma, vma_union
     (M, flat_t, basis_t, rcv, emask_f), extra = res
     B, _, O = g.shape
+    vma = vma_union(g, flat_t, basis_t, rcv, emask_f)
+    g, flat_t, basis_t, rcv, emask_f = promote_vma(
+        vma, g, flat_t, basis_t, rcv, emask_f)
     E = flat_t.shape[2]
     pad = (-M) % M_TILE
     n_mt = (M + pad) // M_TILE
@@ -209,7 +218,8 @@ def _bwd(num_nodes, interpret, res, g):
         + _common_specs(flat_t, basis_t, rcv, emask_f),
         out_specs=pl.BlockSpec((1, M_TILE, O), lambda b, j: (b, j, 0),
                                memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((B, M + pad, O), g.dtype),
+        out_shape=jax.ShapeDtypeStruct((B, M + pad, O), g.dtype,
+                                       vma=vma),
         scratch_shapes=[pltpu.VMEM((E, O), jnp.float32)],
         interpret=interpret,
     )(g, flat_t, basis_t, rcv, emask_f)[:, :M]

@@ -113,6 +113,14 @@ def pallas_topk(h_s, h_t, k, t_mask=None, return_values=False,
     if t_mask is None:
         t_mask = jnp.ones((B, N_t), dtype=bool)
 
+    # shard_map manual mode: the kernel is shard-local, so it runs under a
+    # mesh as long as the varying-manual-axes type is declared — promote
+    # every input to the union vma and stamp it on the outputs. Outside
+    # shard_map all vma sets are empty and this is a no-op.
+    from dgmc_tpu.ops.pallas.dispatch import promote_vma, vma_union
+    vma = vma_union(h_s, h_t, t_mask)
+    h_s, h_t, t_mask = promote_vma(vma, h_s, h_t, t_mask)
+
     pad_s = (-N_s) % TILE_S
     pad_t = (-N_t) % BLOCK_T
     h_s_p = jnp.pad(h_s, ((0, 0), (0, pad_s), (0, 0)))
@@ -142,8 +150,8 @@ def pallas_topk(h_s, h_t, k, t_mask=None, return_values=False,
         ],
         out_shape=[
             # Values ride in the carry's float32; cast back on return.
-            jax.ShapeDtypeStruct((B, n_s_pad, k), jnp.float32),
-            jax.ShapeDtypeStruct((B, n_s_pad, k), jnp.int32),
+            jax.ShapeDtypeStruct((B, n_s_pad, k), jnp.float32, vma=vma),
+            jax.ShapeDtypeStruct((B, n_s_pad, k), jnp.int32, vma=vma),
         ],
         scratch_shapes=[
             pltpu.VMEM((TILE_S, k), jnp.float32),

@@ -64,9 +64,12 @@ def chunked_topk(h_s, h_t, k, t_mask=None, block=256, return_values=False,
 
     ``pallas=None`` auto-dispatches to the VMEM-resident Pallas kernel
     (:mod:`dgmc_tpu.ops.pallas.topk`) on TPU — 21 ms vs 82 ms for this
-    scan at 15000x20000 — outside ``shard_map``'s manual mode; results are
-    bit-identical either way. Pass ``pallas=False`` inside
-    GSPMD-partitioned programs (pallas_call has no partitioning rule;
+    scan at 15000x20000 — results are bit-identical either way. The
+    kernel is shard-local, so the auto path stays ON inside
+    ``shard_map`` manual mode (the kernel declares its varying-manual-axes
+    type; ``parallel/topk.py`` row/col sharding runs it per shard). Pass
+    ``pallas=False`` inside GSPMD auto-partitioned programs only
+    (``pallas_call`` has no GSPMD partitioning rule;
     :class:`~dgmc_tpu.models.DGMC` does this when ``corr_sharding`` is
     set).
 
@@ -79,8 +82,7 @@ def chunked_topk(h_s, h_t, k, t_mask=None, block=256, return_values=False,
     if pallas is None:
         from dgmc_tpu.ops.pallas import dispatch
         pallas = (dispatch.fused_kernels_allowed()
-                  and jax.default_backend() == 'tpu'
-                  and not jax.typeof(h_s).vma)
+                  and jax.default_backend() == 'tpu')
     return _chunked_topk(h_s, h_t, k, t_mask, block, return_values,
                          bool(pallas))
 

