@@ -282,12 +282,14 @@ def _bench_sparse_leg(bf16):
     step_ms = _best_of(window) / SP_ITERS * 1e3
     assert np.isfinite(loss)
     perf = _perf_stats(step, step_ms / 1e3)
-    # Live allocator peak, sampled HERE so it is attributable to this leg
-    # (peak_bytes_in_use is process-lifetime; the f32 leg runs first).
-    mem = jax.local_devices()[0].memory_stats() or {}
-    peak = mem.get('peak_bytes_in_use')
-    if peak:
-        perf['peak_hbm_gib'] = round(peak / 2**30, 3)
+    # Live allocator peak is PROCESS-LIFETIME: only the first (f32) leg
+    # can attribute it; later legs would just echo the earlier maximum,
+    # so they keep the per-executable static bound from memory_analysis.
+    if not bf16:
+        mem = jax.local_devices()[0].memory_stats() or {}
+        peak = mem.get('peak_bytes_in_use')
+        if peak:
+            perf['peak_hbm_gib'] = round(peak / 2**30, 3)
     return step_ms, perf
 
 

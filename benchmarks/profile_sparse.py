@@ -37,8 +37,11 @@ def build_step(route=False, bf16=False):
     import jax.numpy as jnp
 
     rng = np.random.RandomState(0)
-    s = bench._kg_side(bench.SP_N_S, bench.SP_E_S, bench.SP_DIM, rng)
-    t = bench._kg_side(bench.SP_N_T, bench.SP_E_T, bench.SP_DIM, rng)
+    gd = 'bfloat16' if bf16 else None   # match bench.py's legs exactly
+    s = bench._kg_side(bench.SP_N_S, bench.SP_E_S, bench.SP_DIM, rng,
+                       gather_dtype=gd)
+    t = bench._kg_side(bench.SP_N_T, bench.SP_E_T, bench.SP_DIM, rng,
+                       gather_dtype=gd)
     y = np.full((1, bench.SP_N_S), -1, np.int32)
     train_n = int(0.3 * bench.SP_N_S)
     y[0, :train_n] = rng.permutation(bench.SP_N_T)[:train_n]

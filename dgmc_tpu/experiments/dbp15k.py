@@ -43,8 +43,17 @@ def parse_args(argv=None):
     parser.add_argument('--syn_edges_s', type=int, default=100000)
     parser.add_argument('--syn_edges_t', type=int, default=120000)
     parser.add_argument('--syn_dim', type=int, default=300)
-    parser.add_argument('--syn_noise', type=float, default=1.0,
-                        help='feature noise sigma on aligned entities')
+    parser.add_argument('--syn_noise', type=float, default=2.5,
+                        help='max feature-noise sigma on aligned entities')
+    parser.add_argument('--syn_noise_min', type=float, default=0.5,
+                        help='min feature-noise sigma; each aligned entity '
+                             'draws its own sigma uniformly in '
+                             '[min, max] — homogeneous noise has a sharp '
+                             'all-or-nothing learnability transition at '
+                             'C=300 (measured: sigma 1.5 saturates, 1.8 '
+                             'never lifts off), while per-entity '
+                             'heterogeneity yields the mid-range phase-1 '
+                             'accuracy of the real embeddings')
     parser.add_argument('--syn_rewire', type=float, default=0.15,
                         help='fraction of source edges rewired on the '
                              'target side')
@@ -113,7 +122,15 @@ def synthetic_batches(args):
 
     perm = rng.permutation(n_t)[:n_s].astype(np.int32)
     x_t = rng.randn(n_t, c).astype(np.float32)
-    x_t[perm] = x_s + args.syn_noise * rng.randn(n_s, c).astype(np.float32)
+    sigma = rng.uniform(args.syn_noise_min, args.syn_noise,
+                        (n_s, 1)).astype(np.float32)
+    # Variance-preserving blend: corr(x_s, x_t[perm]) = 1/sqrt(1+sigma^2)
+    # per entity while every target row keeps unit feature variance —
+    # un-normalized additive noise gives aligned entities systematically
+    # larger norms, and those rows then dominate every similarity row's
+    # softmax (measured: training never lifts off at full scale).
+    x_t[perm] = ((x_s + sigma * rng.randn(n_s, c).astype(np.float32))
+                 / np.sqrt(1.0 + sigma ** 2))
     keep = rng.rand(e_s) >= args.syn_rewire
     snd_t = np.where(keep, perm[snd], rng.randint(0, n_t, e_s))
     rcv_t = np.where(keep, perm[rcv], rng.randint(0, n_t, e_s))

@@ -450,6 +450,19 @@ class DGMC(nn.Module):
                            preferred_element_type=jnp.float32)
         S_0 = masked_softmax(S_hat, entry_mask) * s_mask[..., None]
 
+        # Fused consensus-delta kernel (ops/pallas/sparse_consensus.py):
+        # forms the [TILE, K, R] difference block and MLP activations in
+        # VMEM only, with a tile-recompute backward — instead of XLA
+        # round-tripping the [B, N_s, K, R] difference tensor (+ saved
+        # activations) through HBM ten times per step. GSPMD programs
+        # keep the jnp form (no partitioning rule); shard_map is fine
+        # (the kernel declares its vma).
+        from dgmc_tpu.ops.pallas.dispatch import fused_kernels_allowed
+        use_sc = (jax.default_backend() == 'tpu'
+                  and fused_kernels_allowed()
+                  and self.corr_sharding is None
+                  and N_s >= 1024 and R_out <= 128)
+
         pre = prefetch_source(num_steps)
         for step in range(num_steps):
             S = masked_softmax(S_hat, entry_mask) * s_mask[..., None]
@@ -461,8 +474,16 @@ class DGMC(nn.Module):
             else:
                 o_s, o_t = run_pair(self.psi_2, r_s, r_t, merge_2)
             o_t_cand = cand_rows(o_t)
-            D = o_s[:, :, None, :] - o_t_cand
-            S_hat = self._constrain(S_hat + consensus_mlp(D))
+            if use_sc:
+                from dgmc_tpu.ops.pallas.sparse_consensus import (
+                    sparse_consensus_delta)
+                cast = lambda a: a.astype(o_s.dtype)  # noqa: E731
+                delta = sparse_consensus_delta(
+                    o_s, o_t_cand, cast(mlp_w1), cast(mlp_b1),
+                    cast(mlp_w2), cast(mlp_b2))
+            else:
+                delta = consensus_mlp(o_s[:, :, None, :] - o_t_cand)
+            S_hat = self._constrain(S_hat + delta)
 
         S_L = masked_softmax(S_hat, entry_mask) * s_mask[..., None]
         return (Correspondence(S_0, S_idx, s_mask, t_mask),

@@ -17,3 +17,16 @@ os.environ['XLA_FLAGS'] = (os.environ.get('XLA_FLAGS', '') +
 import jax  # noqa: E402
 
 jax.config.update('jax_platforms', 'cpu')
+
+# Persistent XLA compilation cache: the suite's wall-clock on a 1-core box
+# is dominated by recompiling the same tiny programs every run (~17 min of
+# CPU). With a warm cache reruns skip that; the cache key includes the JAX
+# version and backend, so upgrades invalidate it safely. The directory is
+# gitignored — the first run on a fresh checkout is the only cold one.
+_cache_dir = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), '.jax_compile_cache')
+jax.config.update('jax_compilation_cache_dir', _cache_dir)
+jax.config.update('jax_persistent_cache_min_compile_time_secs', 0.5)
+# Subprocess-based tests (fault injection, multihost, dryrun children)
+# don't import this conftest; the env var covers them.
+os.environ['JAX_COMPILATION_CACHE_DIR'] = _cache_dir

@@ -121,7 +121,7 @@ def test_dgmc_route_forced_on_matches_off():
     from dgmc_tpu.ops.graph import GraphBatch
 
     rng = np.random.RandomState(0)
-    N, E, C = 40, 120, 8
+    N, E, C = 24, 60, 8
 
     def side(seed):
         r = np.random.RandomState(seed)
@@ -137,8 +137,8 @@ def test_dgmc_route_forced_on_matches_off():
 
     outs = []
     for forced in (True, False):
-        model = DGMC(RelCNN(C, 16, num_layers=2),
-                     RelCNN(8, 8, num_layers=2), num_steps=3, k=4,
+        model = DGMC(RelCNN(C, 12, num_layers=1),
+                     RelCNN(8, 8, num_layers=1), num_steps=2, k=4,
                      route_sparse=forced)
         state = create_train_state(model, jax.random.key(0), batch,
                                    learning_rate=1e-2)

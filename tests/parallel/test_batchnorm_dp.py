@@ -24,7 +24,7 @@ from dgmc_tpu.train import create_train_state, make_train_step
 from dgmc_tpu.utils.data import PairBatch
 
 
-def _batch(B=8, n=12, e=32, c=6, seed=0):
+def _batch(B=8, n=8, e=20, c=4, seed=0):
     r = np.random.RandomState(seed)
 
     def side(s):
@@ -45,7 +45,7 @@ def test_bn_stats_match_single_device(ndev):
     if len(jax.devices()) < ndev:
         pytest.skip(f'needs {ndev} devices')
     batch = _batch()
-    model = DGMC(RelCNN(6, 8, num_layers=2, batch_norm=True),
+    model = DGMC(RelCNN(4, 6, num_layers=1, batch_norm=True),
                  RelCNN(4, 4, num_layers=1), num_steps=1, k=-1)
     state = create_train_state(model, jax.random.key(0), batch,
                                learning_rate=1e-3)
