@@ -143,6 +143,16 @@ class DGMC(nn.Module):
     # it remains valid under corr_sharding / shard_map where scatter
     # performance or partitioning rules differ.
     route_sparse: Optional[bool] = None
+    # Fused Pallas kernel for the sparse consensus delta
+    # (ops/pallas/sparse_consensus.py). Default OFF: measured at DBP15K
+    # scale it is ~4 ms/iteration SLOWER than XLA's own fusion of the
+    # unfused form (device-time profile: fwd+bwd "other" 82 -> 122
+    # ms/step with the kernel; benchmarks/README.md) — the per-tile
+    # one-hot expansion matmuls and 128-row tiles lose to XLA fusing the
+    # broadcast-subtract into two full-size GEMMs. Kept as an explicit
+    # option (shard_map-compatible via vma) for platforms where the HBM
+    # round-trips it avoids dominate.
+    fused_sparse_consensus: Optional[bool] = None
     # Run each backbone ONCE per application point on the node-axis
     # disjoint union of the (source, target) pair instead of twice (once
     # per side). Requires blocked-adjacency graphs (ops/blocked.py) and a
@@ -195,6 +205,12 @@ class DGMC(nn.Module):
                         f'corr_sharding is incompatible with {role} '
                         f'fused=True: Pallas routing kernels cannot run '
                         f'inside GSPMD-partitioned programs')
+            for flag in ('fused_consensus', 'fused_sparse_consensus'):
+                if getattr(self, flag) is True:
+                    raise ValueError(
+                        f'corr_sharding is incompatible with {flag}=True: '
+                        f'pallas_call has no GSPMD partitioning rule '
+                        f'(leave it at None/False for sharded execution)')
 
         def run_psi(m, *args, **kw):
             """Invoke a backbone; under corr_sharding, silence its
@@ -391,13 +407,25 @@ class DGMC(nn.Module):
                     Correspondence(S_L, None, s_mask, t_mask))
 
         # ---- Sparse (top-k) variant ----
-        # Inside a GSPMD-partitioned program (corr_sharding) the scan path
-        # must be used: pallas_call has no partitioning rule.
-        S_idx = self._constrain(
-            chunked_topk(h_s, h_t, self.k, t_mask=t_mask,
-                         block=self.topk_block,
-                         pallas=False if self.corr_sharding is not None
-                         else None))
+        # Under corr_sharding the candidate search runs as shard_map
+        # manual code EMBEDDED in the GSPMD program: each (batch, row)
+        # shard runs the streaming Pallas kernel locally (rows are
+        # independent, no collectives) instead of the whole program
+        # falling back to the ~4x slower scan — pallas_call has no GSPMD
+        # partitioning rule, but it does run under shard_map
+        # (parallel/topk.corr_sharded_topk). Ragged meshes fall back.
+        S_idx = None
+        if self.corr_sharding is not None:
+            from dgmc_tpu.parallel.topk import corr_sharded_topk
+            S_idx = corr_sharded_topk(self.corr_sharding, h_s, h_t, self.k,
+                                      t_mask, block=self.topk_block)
+        if S_idx is None:
+            S_idx = chunked_topk(h_s, h_t, self.k, t_mask=t_mask,
+                                 block=self.topk_block,
+                                 pallas=False
+                                 if self.corr_sharding is not None
+                                 else None)
+        S_idx = self._constrain(S_idx)
 
         if train and y is not None:
             if y_mask is None:
@@ -457,11 +485,12 @@ class DGMC(nn.Module):
         # activations) through HBM ten times per step. GSPMD programs
         # keep the jnp form (no partitioning rule); shard_map is fine
         # (the kernel declares its vma).
-        from dgmc_tpu.ops.pallas.dispatch import fused_kernels_allowed
-        use_sc = (jax.default_backend() == 'tpu'
-                  and fused_kernels_allowed()
-                  and self.corr_sharding is None
-                  and N_s >= 1024 and R_out <= 128)
+        # Explicit True is honored (interpret mode off-TPU, like the
+        # dense fused_consensus kernel); only an auto decision would
+        # consult the trace-time contextvar — and the auto decision is
+        # "off" (the recorded negative result above). corr_sharding was
+        # rejected loudly earlier.
+        use_sc = self.fused_sparse_consensus is True and R_out <= 128
 
         pre = prefetch_source(num_steps)
         for step in range(num_steps):
@@ -480,7 +509,8 @@ class DGMC(nn.Module):
                 cast = lambda a: a.astype(o_s.dtype)  # noqa: E731
                 delta = sparse_consensus_delta(
                     o_s, o_t_cand, cast(mlp_w1), cast(mlp_b1),
-                    cast(mlp_w2), cast(mlp_b2))
+                    cast(mlp_w2), cast(mlp_b2),
+                    jax.default_backend() != 'tpu')
             else:
                 delta = consensus_mlp(o_s[:, :, None, :] - o_t_cand)
             S_hat = self._constrain(S_hat + delta)

@@ -165,12 +165,12 @@ def sparse_consensus_delta_reference(o_s, cand, w1, b1, w2, b2):
 
 def _fwd(o_s, cand, w1, b1, w2, b2, interpret=False):
     out = _forward(o_s, cand, w1, b1, w2, b2, interpret)
-    return out, (o_s, cand, w1, b1, w2)
+    return out, (o_s, cand, w1, b1, w2, b2)
 
 
 def _bwd(interpret, res, g):
     from dgmc_tpu.ops.pallas.dispatch import promote_vma, vma_union
-    o_s, cand, w1, b1, w2 = res
+    o_s, cand, w1, b1, w2, b2 = res
     B, N_s, R = o_s.shape
     K = cand.shape[2]
     vma = vma_union(o_s, cand, w1, b1, w2, g)
@@ -224,7 +224,7 @@ def _bwd(interpret, res, g):
     )(o_s_p, cand_p, w1, b1[None, :], w2.reshape(1, R), g_p)
     return (d_os[:, :N_s], d_cand.reshape(B, n_pad, K, R)[:, :N_s],
             d_w1.astype(w1.dtype), d_b1[0].astype(b1.dtype),
-            d_w2.astype(w2.dtype), d_b2[0].astype(b1.dtype))
+            d_w2.astype(w2.dtype), d_b2[0].astype(b2.dtype))
 
 
 sparse_consensus_delta.defvjp(_fwd, _bwd)

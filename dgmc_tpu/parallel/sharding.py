@@ -39,6 +39,8 @@ def _reject_explicit_fused(model, mesh):
         ('psi_1', getattr(model.psi_1, 'fused', None)),
         ('psi_2', getattr(model.psi_2, 'fused', None)),
         ('fused_consensus', getattr(model, 'fused_consensus', None)),
+        ('fused_sparse_consensus',
+         getattr(model, 'fused_sparse_consensus', None)),
     ) if flag is True]
     if requested:
         raise ValueError(

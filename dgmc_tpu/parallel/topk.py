@@ -45,6 +45,56 @@ def sharded_topk_rows(mesh, h_s, h_t, k, t_mask=None, block=1024,
     return inner(h_s, h_t, t_mask)
 
 
+def corr_sharded_topk(sharding, h_s, h_t, k, t_mask, block=256):
+    """Top-k under a correspondence sharding, INSIDE a GSPMD program.
+
+    ``sharding`` is the ``corr_sharding`` NamedSharding for
+    ``[B, N_s, ...]`` arrays (batch over one mesh axis, source rows over
+    another; ``parallel/mesh.corr_spec``). ``pallas_call`` has no GSPMD
+    partitioning rule, but ``shard_map`` embeds manual per-shard code in
+    an auto-partitioned program — so each (batch, row) shard runs the
+    streaming Pallas kernel locally (rows are independent; no
+    collectives), instead of the whole program falling back to the ~4×
+    slower scan. Returns ``None`` when the shapes don't tile the mesh
+    evenly (caller falls back).
+    """
+    mesh, spec = sharding.mesh, sharding.spec
+    b_ax = spec[0] if len(spec) > 0 else None
+    s_ax = spec[1] if len(spec) > 1 else None
+
+    def ax_size(ax):
+        if ax is None:
+            return 1
+        axes = ax if isinstance(ax, tuple) else (ax,)
+        out = 1
+        for a in axes:
+            out *= mesh.shape[a]
+        return out
+
+    B, N_s = h_s.shape[0], h_s.shape[1]
+    if B % ax_size(b_ax) or N_s % ax_size(s_ax):
+        return None
+    if t_mask is None:
+        t_mask = jnp.ones((h_t.shape[0], h_t.shape[1]), bool)
+
+    # The embedding is usually traced inside disable_fused_kernels()
+    # (make_sharded_train_step silences auto-Pallas for the GSPMD parts),
+    # but THIS region is manual shard-local code — exactly what the
+    # kernel supports — so the decision is made explicitly here, not via
+    # the contextvar.
+    use_kernel = jax.default_backend() == 'tpu'
+
+    @functools.partial(
+        jax.shard_map, mesh=mesh,
+        in_specs=(P(b_ax, s_ax, None), P(b_ax, None, None), P(b_ax, None)),
+        out_specs=P(b_ax, s_ax, None))
+    def local(hs, ht, tm):
+        return chunked_topk(hs, ht, k, t_mask=tm, block=block,
+                            pallas=use_kernel)
+
+    return local(h_s, h_t, t_mask)
+
+
 def sharded_topk_cols(mesh, h_s, h_t, k, t_mask=None, block=1024,
                       axis=MODEL_AXIS):
     """Top-k with target columns sharded over ``axis``; one all_gather of

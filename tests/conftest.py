@@ -28,5 +28,8 @@ _cache_dir = os.path.join(os.path.dirname(os.path.dirname(
 jax.config.update('jax_compilation_cache_dir', _cache_dir)
 jax.config.update('jax_persistent_cache_min_compile_time_secs', 0.5)
 # Subprocess-based tests (fault injection, multihost, dryrun children)
-# don't import this conftest; the env var covers them.
+# don't import this conftest; the env vars cover them (both the cache
+# dir AND the lowered min-compile-time floor, or sub-second child
+# programs would never be cached).
 os.environ['JAX_COMPILATION_CACHE_DIR'] = _cache_dir
+os.environ['JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS'] = '0.5'
