@@ -489,8 +489,12 @@ class DGMC(nn.Module):
         # dense fused_consensus kernel); only an auto decision would
         # consult the trace-time contextvar — and the auto decision is
         # "off" (the recorded negative result above). corr_sharding was
-        # rejected loudly earlier.
-        use_sc = self.fused_sparse_consensus is True and R_out <= 128
+        # rejected loudly earlier; an unsatisfiable width is too.
+        use_sc = self.fused_sparse_consensus is True
+        if use_sc and R_out > 128:
+            raise ValueError(
+                f'fused_sparse_consensus=True requires psi_2 out_channels '
+                f'<= 128 (VMEM tile bound); got {R_out}')
 
         pre = prefetch_source(num_steps)
         for step in range(num_steps):

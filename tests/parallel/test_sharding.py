@@ -96,3 +96,47 @@ def test_gspmd_safe_disables_auto_kernels_at_trace_time():
     mesh1 = make_mesh(data=1, model=1, devices=jax.devices()[:1])
     jax.jit(_gspmd_safe(probe, mesh1))(jnp.ones(8))
     assert seen == [True]
+
+
+def test_corr_sharding_embedded_kernel_topk_path():
+    """When (B, N_s) tile the corr mesh evenly, the sparse candidate
+    search runs as shard_map manual code EMBEDDED in the GSPMD program
+    (parallel/topk.corr_sharded_topk) — results must match the
+    unsharded step exactly (the embedding is bit-identical by design)."""
+    from dgmc_tpu.models import SplineCNN
+    from dgmc_tpu.data import (Cartesian, Compose, Constant, KNNGraph,
+                               RandomGraphPairs)
+    from dgmc_tpu.utils import PairLoader
+    from dgmc_tpu.parallel.topk import corr_sharded_topk
+
+    mesh = make_mesh(data=2, model=4)
+    transform = Compose([Constant(), KNNGraph(k=4), Cartesian()])
+    ds = RandomGraphPairs(min_inliers=8, max_inliers=12, min_outliers=0,
+                          max_outliers=2, transform=transform, length=4,
+                          seed=3)
+    # B=2 tiles data=2; N_s=16 tiles model=4 -> the embedding is LIVE
+    # (corr_sharded_topk returns non-None), unlike the ragged test above.
+    loader = PairLoader(ds, 2, shuffle=False, num_nodes=16, num_edges=64)
+    batch = next(iter(loader))
+    sh = corr_sharding(mesh)
+    assert corr_sharded_topk(
+        sh, jax.numpy.zeros((2, 16, 8)), jax.numpy.zeros((2, 16, 8)),
+        4, None) is not None
+
+    psi_1 = SplineCNN(1, 16, dim=2, num_layers=2, cat=False, lin=True)
+    psi_2 = SplineCNN(8, 8, dim=2, num_layers=2, cat=True, lin=True)
+    base = DGMC(psi_1, psi_2, num_steps=2, k=4)
+    sharded = DGMC(psi_1, psi_2, num_steps=2, k=4, corr_sharding=sh)
+
+    state = create_train_state(base, jax.random.key(0), batch)
+    key = jax.random.key(2)
+    ref_step = make_train_step(base, jit=False)
+    sh_step = make_sharded_train_step(sharded, mesh)
+
+    _, ref_out = ref_step(state, batch, key)
+    state_sh = replicate(jax.tree.map(np.asarray, state), mesh)
+    _, sh_out = sh_step(state_sh, shard_batch(batch, mesh), key)
+    assert float(sh_out['loss']) == pytest.approx(float(ref_out['loss']),
+                                                  rel=1e-4)
+    assert float(sh_out['acc']) == pytest.approx(float(ref_out['acc']),
+                                                 abs=1e-6)
