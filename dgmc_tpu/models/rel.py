@@ -109,6 +109,12 @@ class RelCNN(nn.Module):
         if streams > 1 and self.batch_norm:
             raise ValueError('streams>1 is invalid with batch_norm=True: '
                              'batch statistics would couple the streams')
+        if streams > 1 and train and self.dropout > 0:
+            raise ValueError(
+                'streams>1 is invalid with active dropout: a packed '
+                'evaluation draws ONE mask across the channel groups, '
+                'coupling what should be independent iterations '
+                '(DGMC.prefetch_source skips packing in this case)')
         B, N = x.shape[0], x.shape[1]
         xs = [x]
         for i in range(self.num_layers):

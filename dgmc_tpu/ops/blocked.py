@@ -327,7 +327,11 @@ def attach_blocks(graph, rows=128, block_edges=512, min_nodes=1024,
     ``gather_dtype='bfloat16'`` moves message rows AND routing tensors as
     bf16 with f32 accumulation — both the blocked gathers and the routing
     matmuls are bytes-bound, so this nearly halves their cost; routing
-    weights are exact 0/1 either way. The default is ``None`` (full-f32
+    weights are exact 0/1 either way. Narrow-row exception: rows below
+    512 bytes in bf16 (``C < 256``) silently stay/upcast to float32 inside
+    ``_routed`` — sub-cache-line gather rows measured ~1.6× SLOWER, and the
+    upcast is numerically exact, so a ``gather_dtype='bfloat16'`` request
+    on narrow channels keeps f32 traffic by design. The default is ``None`` (full-f32
     message traffic, bit-faithful to the gather/scatter path up to
     summation order): reduced-precision messages belong to the explicit
     bf16 compute policy (``dtype=jnp.bfloat16`` on the backbones), which

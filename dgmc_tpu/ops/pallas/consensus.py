@@ -31,9 +31,17 @@ TILE_T = 128
 
 
 def _mlp_tile(d, w1, b1, w2, b2):
-    """2-layer MLP on a flattened difference tile. d: [S*T, R]."""
+    """2-layer MLP on a flattened difference tile. d: [S*T, R].
+
+    The final contraction accumulates in float32 regardless of the compute
+    dtype: the per-step delta is added to the f32 ``S_hat`` logits, and the
+    unfused path / sparse kernel both emit f32 deltas
+    (``preferred_element_type``) — the fused dense kernel must not be the
+    one place a bf16 rounding sneaks into the logit accumulation."""
     h = jnp.maximum(d @ w1 + b1, 0.0)
-    return h @ w2 + b2
+    out = jax.lax.dot_general(h, w2, (((1,), (0,)), ((), ())),
+                              preferred_element_type=jnp.float32)
+    return out + b2.astype(jnp.float32)
 
 
 def _consensus_kernel(o_s_ref, o_t_ref, w1_ref, b1_ref, w2_ref, b2_ref,
@@ -78,7 +86,7 @@ def _forward_pallas(o_s, o_t, w1, b1, w2, b2, interpret=False):
                                lambda b, i, j: (b, i, j),
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((B, N_s + pad_s, N_t + pad_t),
-                                       o_s.dtype, vma=vma),
+                                       jnp.float32, vma=vma),
         interpret=interpret,
     )(o_s_p, o_t_p, w1, b1[None, :], w2, b2[None, :])
     return out[:, :N_s, :N_t]
@@ -153,8 +161,8 @@ def _bwd(interpret, res, g):
         step, zeros, (o_t_blocks, g_blocks))
     d_ot = jnp.moveaxis(d_ot_blocks, 0, 1).reshape(B, -1, R)[:, :N_t]
     cast = lambda a, like: a.astype(like.dtype)  # noqa: E731
-    return (cast(d_os, o_s), d_ot, cast(d_w1, w1), cast(d_b1, b1),
-            cast(d_w2, w2), cast(d_b2, b1))
+    return (cast(d_os, o_s), cast(d_ot, o_t), cast(d_w1, w1),
+            cast(d_b1, b1), cast(d_w2, w2), cast(d_b2, b1))
 
 
 consensus_update.defvjp(_fwd, _bwd)

@@ -17,6 +17,14 @@ import jax
 
 _fused_ok = contextvars.ContextVar('dgmc_tpu_fused_kernels_ok',
                                    default=True)
+# Separate switch for kernels EMBEDDED via shard_map inside GSPMD programs
+# (parallel/topk.corr_sharded_topk): those are deliberately immune to
+# disable_fused_kernels() — the orchestrator sets that while tracing the
+# partitioned region, yet the embedded manual region is exactly where the
+# kernel is valid. This dedicated opt-out restores an escape hatch should
+# the shard_map Pallas path misbehave on some topology.
+_embedded_ok = contextvars.ContextVar('dgmc_tpu_embedded_kernels_ok',
+                                      default=True)
 
 
 def vma_union(*arrays):
@@ -53,3 +61,18 @@ def disable_fused_kernels():
 
 def fused_kernels_allowed():
     return _fused_ok.get()
+
+
+@contextlib.contextmanager
+def disable_embedded_kernels():
+    """Trace-time context: shard_map-embedded Pallas kernels (the GSPMD
+    top-k embedding) fall back to their scan paths inside this block."""
+    token = _embedded_ok.set(False)
+    try:
+        yield
+    finally:
+        _embedded_ok.reset(token)
+
+
+def embedded_kernels_allowed():
+    return _embedded_ok.get()

@@ -80,9 +80,12 @@ def corr_sharded_topk(sharding, h_s, h_t, k, t_mask, block=256):
     # The embedding is usually traced inside disable_fused_kernels()
     # (make_sharded_train_step silences auto-Pallas for the GSPMD parts),
     # but THIS region is manual shard-local code — exactly what the
-    # kernel supports — so the decision is made explicitly here, not via
-    # the contextvar.
-    use_kernel = jax.default_backend() == 'tpu'
+    # kernel supports — so that contextvar is deliberately ignored. The
+    # dedicated disable_embedded_kernels() switch remains as the escape
+    # hatch if the shard_map Pallas path misbehaves on some topology.
+    from dgmc_tpu.ops.pallas.dispatch import embedded_kernels_allowed
+    use_kernel = (jax.default_backend() == 'tpu'
+                  and embedded_kernels_allowed())
 
     @functools.partial(
         jax.shard_map, mesh=mesh,

@@ -106,3 +106,20 @@ def test_masked_nodes_do_not_leak():
     out1 = model.apply(variables, g1.x, g1)
     out2 = model.apply(variables, g2.x, g2)
     assert jnp.allclose(out1[0, :4], out2[0, :4], atol=1e-5)
+
+
+def test_relcnn_streams_rejects_active_dropout():
+    """A channel-packed (streams>1) evaluation draws ONE dropout mask
+    across the packed groups — silently coupling what should be
+    independent consensus iterations. The backbone must reject it loudly
+    (DGMC.prefetch_source already skips packing in this case)."""
+    g = path_graph(n=4, c=16)
+    model = RelCNN(16, 32, num_layers=1, dropout=0.5)
+    x2 = jnp.concatenate([g.x, g.x], axis=-1)
+    with pytest.raises(ValueError, match='dropout'):
+        model.init({'params': KEY, 'dropout': KEY}, x2, g,
+                   train=True, streams=2)
+    # Inactive dropout (eval) stays fine.
+    variables = model.init({'params': KEY}, x2, g, train=False, streams=2)
+    out = model.apply(variables, x2, g, train=False, streams=2)
+    assert out.shape == (1, 4, 2 * 32)

@@ -64,3 +64,23 @@ def test_dgmc_fused_matches_unfused():
     S0_b, SL_b = run(fused)
     np.testing.assert_allclose(np.asarray(SL_b.val), np.asarray(SL_a.val),
                                rtol=1e-5, atol=1e-6)
+
+
+def test_bf16_inputs_emit_f32_delta():
+    """Under the bf16 compute policy the fused kernel must still hand back
+    a float32 delta (the consensus logits S_hat accumulate in f32; the
+    unfused path and the sparse kernel both force this via
+    preferred_element_type) and stay within bf16 tolerance of the f32
+    unfused semantics."""
+    args = _case()
+    want = consensus_update_reference(*args)
+    bf_args = tuple(a.astype(jnp.bfloat16) for a in args)
+    got = consensus_update(*bf_args, True)
+    assert got.dtype == jnp.float32
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=5e-2, atol=5e-2)
+    # Gradients keep each primal's dtype: the f32 upstream cotangent must
+    # not leak f32 into the bf16 backbone backward (cast-back contract).
+    grads = jax.grad(lambda a: consensus_update(*a, True).sum())(bf_args)
+    assert all(g.dtype == jnp.bfloat16 for g in grads), (
+        [g.dtype for g in grads])

@@ -47,3 +47,20 @@ def test_cols_rejects_oversized_k(mesh):
     h_s, h_t, t_mask = _case()
     with pytest.raises(ValueError):
         sharded_topk_cols(mesh, h_s, h_t, 4, t_mask=t_mask)  # 24/8=3 < 4
+
+
+def test_disable_embedded_kernels_is_independent_escape_hatch():
+    """disable_fused_kernels() deliberately does NOT reach the
+    shard_map-embedded top-k (that region is manual code where the kernel
+    is valid); disable_embedded_kernels() is the dedicated opt-out."""
+    from dgmc_tpu.ops.pallas.dispatch import (disable_embedded_kernels,
+                                              disable_fused_kernels,
+                                              embedded_kernels_allowed,
+                                              fused_kernels_allowed)
+    assert embedded_kernels_allowed()
+    with disable_embedded_kernels():
+        assert not embedded_kernels_allowed()
+        assert fused_kernels_allowed()  # switches are independent
+    with disable_fused_kernels():
+        assert embedded_kernels_allowed()
+    assert embedded_kernels_allowed()
