@@ -84,6 +84,8 @@ def main():
     ap.add_argument('--route', action='store_true')
     ap.add_argument('--bf16', action='store_true')
     ap.add_argument('--steps', type=int, default=10)
+    ap.add_argument('--json', default=None,
+                    help='dump the full kernel table to this path')
     args = ap.parse_args()
 
     compiled, state, batch = build_step(route=args.route, bf16=args.bf16)
@@ -149,6 +151,7 @@ def main():
 
     # Stage-level rollup from op_name paths when available.
     stage = collections.Counter()
+    stage_n = collections.Counter()
     for name, us in totals.items():
         op = ops.get(name, '') + ' ' + opmap.get(name.split('.(')[0], '')
         low = (op + ' ' + name).lower()
@@ -157,12 +160,23 @@ def main():
                     'take_along_axis', 'corr_route', 'softmax'):
             if pat in low:
                 stage[f'{direction}:{pat}'] += us
+                stage_n[f'{direction}:{pat}'] += counts[name]
                 break
         else:
             stage[f'{direction}:other'] += us
-    print('\n# rollup (ms/step):')
+            stage_n[f'{direction}:other'] += counts[name]
+    print('\n# rollup (ms/step, launches/step):')
     for k, us in stage.most_common():
-        print(f'  {k:20s} {us / 1e3 / args.steps:8.2f}')
+        print(f'  {k:20s} {us / 1e3 / args.steps:8.2f} '
+              f'{stage_n[k] / args.steps:8.1f}')
+
+    if args.json:
+        with open(args.json, 'w') as f:
+            json.dump([{'name': n, 'op': ops.get(n, ''),
+                        'hlo': opmap.get(n.split('.(')[0], ''),
+                        'us': us, 'calls': counts[n]}
+                       for n, us in totals.most_common()], f)
+        print(f'# full table -> {args.json}')
 
 
 if __name__ == '__main__':

@@ -153,20 +153,23 @@ class DGMC(nn.Module):
     # option (shard_map-compatible via vma) for platforms where the HBM
     # round-trips it avoids dominate.
     fused_sparse_consensus: Optional[bool] = None
-    # Run each backbone ONCE per application point on the node-axis
+    # Run a backbone ONCE per application point on the node-axis
     # disjoint union of the (source, target) pair instead of twice (once
-    # per side). Requires blocked-adjacency graphs (ops/blocked.py) and a
+    # per side). ``True`` merges both backbones, ``'psi_1'`` / ``'psi_2'``
+    # merge one. Requires blocked-adjacency graphs (ops/blocked.py) and a
     # BatchNorm-free backbone (merged batch statistics would span both
     # sides, unlike the reference's separate calls, reference
-    # ``dgmc/models/dgmc.py:149-150,173-176``). Default OFF: measured at
-    # DBP15K scale the union's halved op count is cancelled by its
-    # combined row gather crossing a ~2^19-row efficiency cliff (10 vs
-    # 31 GB/s), and with plain gather/scatter aggregation the union loses
-    # outright (58 vs 36 ms/consensus-iteration; batch-axis stacking
-    # loses harder still at 73 ms — TPU scatters with a batched leading
-    # dim are the slow path). Kept as an explicit option for platforms
-    # where dispatch overhead dominates.
-    batch_pair: Optional[bool] = None
+    # ``dgmc/models/dgmc.py:149-150,173-176``). Default OFF for ψ₂:
+    # measured at DBP15K scale the per-iteration union's halved op count
+    # is cancelled by its combined row gather crossing a ~2^19-row
+    # efficiency cliff (10 vs 31 GB/s), and merging ψ₂ also forfeits the
+    # bigger stream-packed prefetch win; with plain gather/scatter
+    # aggregation the union loses outright (58 vs 36 ms per consensus
+    # iteration; batch-axis stacking loses harder still at 73 ms — TPU
+    # scatters with a batched leading dim are the slow path). ``'psi_1'``
+    # is different: ψ₁ runs once per STEP, its union stays under the
+    # gather cliff, and the experiment CLIs enable it at DBP15K scale.
+    batch_pair: Optional[Any] = None
 
     def _constrain(self, a):
         if self.corr_sharding is None:
@@ -223,8 +226,12 @@ class DGMC(nn.Module):
 
         from dgmc_tpu.ops.blocked import UnionPair
 
+        if self.batch_pair not in (None, False, True, 'psi_1', 'psi_2'):
+            raise ValueError(
+                f"batch_pair must be None/False/True/'psi_1'/'psi_2', "
+                f'got {self.batch_pair!r}')
         can_stack = (
-            self.batch_pair is True
+            self.batch_pair in (True, 'psi_1', 'psi_2')
             and (graph_s.edge_attr is None) == (graph_t.edge_attr is None)
             and (graph_s.edge_attr is None
                  or graph_s.edge_attr.shape[-1] == graph_t.edge_attr.shape[-1])
@@ -232,28 +239,32 @@ class DGMC(nn.Module):
             and graph_t.blocks_in is not None
             and graph_s.blocks_in.rows == graph_t.blocks_in.rows
         )
-        if self.batch_pair is True and not can_stack:
+        if self.batch_pair in (True, 'psi_1', 'psi_2') and not can_stack:
             # Mirror the loud BatchNorm rejection below: a user who
             # explicitly requested union mode must not silently benchmark
             # the two-call path.
             raise ValueError(
-                'batch_pair=True requires blocked-adjacency graphs on both '
+                'batch_pair requires blocked-adjacency graphs on both '
                 'sides (ops/blocked.attach_blocks) with matching block '
                 'rows and edge_attr widths; this pair cannot be stacked')
 
-        def merges(m):
-            if not can_stack:
+        def merges(m, role):
+            if not can_stack or self.batch_pair not in (True, role):
                 return False
             if getattr(m, 'batch_norm', False):
                 raise ValueError(
-                    'batch_pair=True is invalid with a BatchNorm '
+                    'batch_pair is invalid with a BatchNorm '
                     'backbone: merged batch statistics would span '
                     'both graphs')
             return True
 
-        merge_1 = merges(self.psi_1) and (
-            graph_s.x.shape[-1] == graph_t.x.shape[-1])
-        merge_2 = merges(self.psi_2)
+        merge_1 = merges(self.psi_1, 'psi_1')
+        if merge_1 and graph_s.x.shape[-1] != graph_t.x.shape[-1]:
+            raise ValueError(
+                f'batch_pair={self.batch_pair!r} cannot union psi_1: '
+                f'source/target feature widths differ '
+                f'({graph_s.x.shape[-1]} vs {graph_t.x.shape[-1]})')
+        merge_2 = merges(self.psi_2, 'psi_2')
         pair = UnionPair(graph_s, graph_t) if (merge_1 or merge_2) else None
 
         def run_pair(m, x_s_in, x_t_in, merge):
@@ -413,7 +424,8 @@ class DGMC(nn.Module):
         # independent, no collectives) instead of the whole program
         # falling back to the ~4x slower scan — pallas_call has no GSPMD
         # partitioning rule, but it does run under shard_map
-        # (parallel/topk.corr_sharded_topk). Ragged meshes fall back.
+        # (parallel/topk.corr_sharded_topk). Ragged row counts are padded
+        # inside the embedding; only a ragged batch axis falls back.
         S_idx = None
         if self.corr_sharding is not None:
             from dgmc_tpu.parallel.topk import corr_sharded_topk
@@ -440,14 +452,19 @@ class DGMC(nn.Module):
             S_idx = include_gt(S_idx, y, y_mask & s_mask)
 
         def gather_t(feat, idx):
-            # feat [B, N_t, C], idx [B, N_s, K] -> [B, N_s, K, C]
+            # feat [B, N_t, C], idx [B, N_s, K] -> [B, N_s, K, C].
+            # mode='clip': candidate indices come from top-k / uniform
+            # negatives / ground-truth injection, all < N_t by
+            # construction — the default 'fill' mode's select_n pass over
+            # the gathered rows is measurable waste at DBP15K scale.
             Bk, Ns_, K_ = idx.shape
             flat = jnp.take_along_axis(feat, idx.reshape(Bk, Ns_ * K_, 1),
-                                       axis=1)
+                                       axis=1, mode='clip')
             return flat.reshape(Bk, Ns_, K_, feat.shape[-1])
 
         entry_mask = jnp.take_along_axis(
-            t_mask, S_idx.reshape(B, -1), axis=1).reshape(S_idx.shape)
+            t_mask, S_idx.reshape(B, -1), axis=1,
+            mode='clip').reshape(S_idx.shape)
 
         # Scatter-free candidate routing (see route_sparse field): one
         # device-side blocked sort of the final S_idx serves every

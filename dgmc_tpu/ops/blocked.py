@@ -165,13 +165,26 @@ def _routed(h, src, loc, msk, rid, rows, num_ranges, out_rows, gather_dtype,
     # The same guard in reverse for narrow low-precision tables (bf16
     # compute policy): upcasting to float32 rows is exact and moves the
     # gather back to >= 128-byte lines, which measured ~1.6x faster than
-    # 64-byte sub-line rows.
+    # 64-byte sub-line rows. The optimization barrier is load-bearing:
+    # convert and gather commute, and without it XLA fuses the convert
+    # INTO the gather kernel (its cost model prefers the smaller table
+    # read), silently reinstating the 64-byte-row gather this guard
+    # exists to avoid — profiled at 0.78 vs 0.44 ms per ψ₂ target gather
+    # on the bf16 DBP15K leg. The barrier materializes the f32 table
+    # once (a [N, C] elementwise pass, trivial next to the gather).
     if h.dtype.itemsize * C < 128 and jnp.issubdtype(h.dtype,
                                                      jnp.floating):
-        h = h.astype(jnp.float32)
+        h = jax.lax.optimization_barrier(h.astype(jnp.float32))
 
     def one(hb, src_b, loc_b, msk_b, rid_b, scale_b):
-        g = jnp.take(hb, src_b.reshape(-1), axis=0)        # [NB*E_b, C]
+        # mode='clip': block indices are host-built and always in-bounds
+        # (padding points at row 0 under mask=False, zeroed by the one-hot
+        # contraction), so jnp.take's default out-of-bounds 'fill' would
+        # only add a full-width select_n pass over every gathered row —
+        # profiled at ~0.56 ms per gather at DBP15K scale, ~40 ms/step
+        # across ψ₁/ψ₂ before this was pinned.
+        g = jnp.take(hb, src_b.reshape(-1), axis=0,
+                     mode='clip')                          # [NB*E_b, C]
         g = g.reshape(src_b.shape + (C,))                  # [NB, E_b, C]
         if scale_b is not None:
             g = g * scale_b[..., None].astype(g.dtype)

@@ -126,7 +126,7 @@ def sparse_project(S, r_s, S_idx, route):
     the consensus indicator projection (reference
     ``dgmc/models/dgmc.py:211-213``) without materializing the
     ``[B, N_s, K, R]`` contribution tensor and without any scatter."""
-    scale = jax.vmap(jnp.take)(
+    scale = jax.vmap(lambda s, e: jnp.take(s, e, mode='clip'))(
         S.reshape(S.shape[0], -1), route.ent)              # [B, NB, E_b]
     scale = jnp.where(route.mask, scale, 0.0)
     return _route_sum(r_s, route.src, route, scale=scale)
@@ -142,7 +142,7 @@ def _project_bwd(res, d_r_t):
     # In original [N_s, K] order the transpose is gathers + a K-reduction:
     # d_S[s,k] = <d_r_t[S_idx[s,k]], r_s[s]>; d_r_s[s] = Σ_k S[s,k] * g[s,k].
     flat = S_idx.reshape(B, N_s * K)
-    g = jnp.take_along_axis(d_r_t, flat[..., None], axis=1)
+    g = jnp.take_along_axis(d_r_t, flat[..., None], axis=1, mode='clip')
     g = g.reshape(B, N_s, K, -1)                           # [B, N_s, K, R]
     d_S = jnp.einsum('bskr,bsr->bsk', g, r_s)
     d_r_s = jnp.einsum('bsk,bskr->bsr', S, g)
@@ -159,7 +159,7 @@ def sparse_gather(feat, S_idx, route):
     contraction instead of XLA's scatter-add gather-VJP."""
     B, N_s, K = S_idx.shape
     flat = jnp.take_along_axis(feat, S_idx.reshape(B, N_s * K)[..., None],
-                               axis=1)
+                               axis=1, mode='clip')
     return flat.reshape(B, N_s, K, feat.shape[-1])
 
 

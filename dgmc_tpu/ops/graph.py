@@ -72,8 +72,12 @@ def gather_nodes(x, idx):
     """Batched node gather: ``x[b, idx[b, e]]``.
 
     x: ``[B, N, C]``, idx: ``[B, E]`` → ``[B, E, C]``.
+
+    ``mode='clip'``: edge endpoints are host-built and in-bounds (padded
+    edges point at node 0 under ``edge_mask=False``); the default 'fill'
+    mode would append a select_n pass over every gathered row.
     """
-    return jnp.take_along_axis(x, idx[..., None], axis=1)
+    return jnp.take_along_axis(x, idx[..., None], axis=1, mode='clip')
 
 
 def scatter_to_nodes(messages, receivers, edge_mask, num_nodes, aggr='sum'):

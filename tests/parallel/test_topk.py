@@ -64,3 +64,34 @@ def test_disable_embedded_kernels_is_independent_escape_hatch():
     with disable_fused_kernels():
         assert embedded_kernels_allowed()
     assert embedded_kernels_allowed()
+
+
+@pytest.mark.parametrize('n_s', [13, 15, 17])
+def test_corr_sharded_topk_ragged_rows_stay_live(mesh, n_s):
+    """Row counts that do NOT divide the model axis must keep the embedded
+    shard_map path (padded rows are discarded work), not fall back to the
+    GSPMD scan — KeOps never falls back by shape either (reference
+    dgmc.py:85-94). Indices must be bit-identical to dense_topk."""
+    from dgmc_tpu.parallel import corr_sharding
+    from dgmc_tpu.parallel.topk import corr_sharded_topk
+
+    h_s, h_t, t_mask = _case(B=1, N_s=n_s, ties=True)
+    sh = corr_sharding(mesh)
+    got = corr_sharded_topk(sh, h_s, h_t, 5, t_mask)
+    assert got is not None, 'ragged rows must not fall back'
+    assert got.shape == (1, n_s, 5)
+    want = dense_topk(h_s, h_t, 5, t_mask=t_mask)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_corr_sharded_topk_ragged_batch_falls_back(mesh):
+    """A ragged BATCH axis still declines (padding it would replicate the
+    whole per-pair cost)."""
+    from dgmc_tpu.parallel import corr_sharding
+    from dgmc_tpu.parallel.topk import corr_sharded_topk
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mesh2 = make_mesh(data=2, model=4)
+    h_s, h_t, t_mask = _case(B=3)
+    sh = NamedSharding(mesh2, P('data', 'model', None))
+    assert corr_sharded_topk(sh, h_s, h_t, 5, t_mask) is None
