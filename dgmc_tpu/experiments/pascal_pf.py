@@ -149,8 +149,11 @@ def main(argv=None):
                 out = syn_eval_step(state, b, sub)
                 correct = correct + out['correct']
                 n += float(np.asarray(b.y_mask).sum())
-            eval_acc = 100 * float(correct) / max(n, 1)
-            print(f'Held-out synthetic: {eval_acc:.2f}')
+            eval_acc = float(correct) / max(n, 1)
+            print(f'Held-out synthetic: {100 * eval_acc:.2f}')
+            # Logged as a 0-1 fraction, the same unit as train_acc in
+            # this JSONL (the percentage is print-only, mirroring the
+            # reference's printed tables).
             logger.log(epoch, synthetic_eval_acc=eval_acc)
 
         if test_datasets:

@@ -74,18 +74,22 @@ class Correspondence:
         return out.at[b, s, self.idx].add(self.val)
 
 
-def include_gt(S_idx, y_col, y_mask):
+def include_gt(S_idx, y_col, y_mask, return_replaced=False):
     """Overwrite the *last* candidate slot with the ground-truth column for
     every valid row whose ground truth is not already present — the sparse
     training guarantee of the reference's ``__include_gt__`` (reference
     ``dgmc/models/dgmc.py:96-112``).
 
     S_idx: ``[B, N_s, K]``; y_col: ``[B, N_s]``; y_mask: ``[B, N_s]``.
+    With ``return_replaced`` also returns the ``[B, N_s]`` bool mask of
+    rows whose last slot was overwritten (used by the caller's
+    arithmetic entry-mask so the injection rule lives in ONE place).
     """
     present = (S_idx == y_col[..., None]).any(axis=-1)
     replace = y_mask & ~present
     new_last = jnp.where(replace, y_col, S_idx[..., -1])
-    return S_idx.at[..., -1].set(new_last)
+    out = S_idx.at[..., -1].set(new_last)
+    return (out, replace) if return_replaced else out
 
 
 class DGMC(nn.Module):
@@ -167,8 +171,11 @@ class DGMC(nn.Module):
     # aggregation the union loses outright (58 vs 36 ms per consensus
     # iteration; batch-axis stacking loses harder still at 73 ms — TPU
     # scatters with a batched leading dim are the slow path). ``'psi_1'``
-    # is different: ψ₁ runs once per STEP, its union stays under the
-    # gather cliff, and the experiment CLIs enable it at DBP15K scale.
+    # merges only the once-per-step feature encoder — measured at DBP15K
+    # scale it ALSO loses (~293 vs ~268 ms wall; the union's combined
+    # 1-1.2 KB-row gathers cost more than the halved launch count saves,
+    # benchmarks/README.md), so nothing in-tree enables it; it remains an
+    # explicit option for platforms where dispatch overhead dominates.
     batch_pair: Optional[Any] = None
 
     def _constrain(self, a):
@@ -439,6 +446,23 @@ class DGMC(nn.Module):
                                  else None)
         S_idx = self._constrain(S_idx)
 
+        # Candidate-slot validity WITHOUT gathering t_mask at S_idx (a
+        # ~300k-row bool gather, ~2.4 ms/step at DBP15K scale), by
+        # construction of each slot:
+        # - top-k slot j is valid exactly when j < n_valid: masked columns
+        #   score exactly finfo.min / -inf in every search path, strictly
+        #   below any real inner product, so the k winners are the valid
+        #   columns first;
+        # - random negatives are drawn as floor(u * n_valid), always a
+        #   valid column (invalid only in the degenerate n_valid == 0);
+        # - an injected ground-truth column is valid by the GT contract
+        #   (the reference overwrites blindly too, reference
+        #   dgmc.py:96-112).
+        n_valid_t = jnp.sum(t_mask, axis=-1).astype(jnp.int32)      # [B]
+        entry_mask = jnp.broadcast_to(
+            jnp.arange(self.k)[None, None, :] < n_valid_t[:, None, None],
+            (B, N_s, self.k))
+
         if train and y is not None:
             if y_mask is None:
                 y_mask = jnp.ones(y.shape, bool)
@@ -446,10 +470,17 @@ class DGMC(nn.Module):
             if num_rnd > 0:
                 u = jax.random.uniform(self.make_rng('negatives'),
                                        (B, N_s, num_rnd))
-                n_valid = t_mask.sum(axis=-1).astype(u.dtype)  # [B]
+                n_valid = n_valid_t.astype(u.dtype)                 # [B]
                 rnd = jnp.floor(u * n_valid[:, None, None]).astype(jnp.int32)
                 S_idx = jnp.concatenate([S_idx, rnd], axis=-1)
-            S_idx = include_gt(S_idx, y, y_mask & s_mask)
+                entry_mask = jnp.concatenate(
+                    [entry_mask,
+                     jnp.broadcast_to((n_valid_t > 0)[:, None, None],
+                                      (B, N_s, num_rnd))], axis=-1)
+            S_idx, replaced = include_gt(S_idx, y, y_mask & s_mask,
+                                         return_replaced=True)
+            entry_mask = entry_mask.at[..., -1].set(
+                entry_mask[..., -1] | replaced)
 
         def gather_t(feat, idx):
             # feat [B, N_t, C], idx [B, N_s, K] -> [B, N_s, K, C].
@@ -457,14 +488,14 @@ class DGMC(nn.Module):
             # negatives / ground-truth injection, all < N_t by
             # construction — the default 'fill' mode's select_n pass over
             # the gathered rows is measurable waste at DBP15K scale.
+            # (The narrow-row upcast guard that pays off in the blocked
+            # aggregation path was tried here too in r5 and measured
+            # neutral-to-negative — the extra downcast pass on the
+            # [B, N_s*K, C] result eats the gather saving.)
             Bk, Ns_, K_ = idx.shape
             flat = jnp.take_along_axis(feat, idx.reshape(Bk, Ns_ * K_, 1),
                                        axis=1, mode='clip')
             return flat.reshape(Bk, Ns_, K_, feat.shape[-1])
-
-        entry_mask = jnp.take_along_axis(
-            t_mask, S_idx.reshape(B, -1), axis=1,
-            mode='clip').reshape(S_idx.shape)
 
         # Scatter-free candidate routing (see route_sparse field): one
         # device-side blocked sort of the final S_idx serves every

@@ -194,7 +194,10 @@ def _routed(h, src, loc, msk, rid, rows, num_ranges, out_rows, gather_dtype,
         # HIGHEST precision for f32 operands: these contractions are tiny
         # (a few GFLOP) but route f32 values, and the default single-pass
         # bf16 MXU mode would silently round every message. bf16 operands
-        # (gather_dtype) are exact in one pass.
+        # (gather_dtype) are exact in one pass. (A single-pass bf16
+        # contraction of the exactly-bf16-representable upcast tables was
+        # tried in r5 and LOST ~30 ms/step — narrow bf16 operands pay
+        # (2,1)-packing relayouts that dwarf the saved MXU passes.)
         prec = (None if gather_dtype is not None
                 else jax.lax.Precision.HIGHEST)
         per_block = jnp.einsum('ber,bec->brc', onehot.astype(g.dtype), g,
