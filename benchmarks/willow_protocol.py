@@ -26,7 +26,15 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
 
 def build_fixture_data(root, seed=0):
     """VOC + WILLOW trees in the published layouts (Berkeley XML with
-    height/width visible_bounds; WILLOW .mat pts_coord [2, 10])."""
+    height/width visible_bounds; WILLOW .mat pts_coord [2, 10]).
+
+    Keypoints are PER-CATEGORY PROTOTYPE layouts plus small jitter — like
+    real object classes, keypoint i sits in a consistent geometric
+    neighborhood across instances, so identity matching is learnable from
+    graph structure alone (no images ship: features come from a VGG
+    forward over zeros, so the signal is the Delaunay geometry — the
+    protocol evidence is the harness TRAINING to above-chance accuracy,
+    not reproducing the paper's numbers, which need the real datasets)."""
     from scipy.io import savemat
     from dgmc_tpu.datasets.pascal_voc import CATEGORIES
     from dgmc_tpu.datasets.willow import _DIRNAMES
@@ -36,9 +44,11 @@ def build_fixture_data(root, seed=0):
     kp_names = ['a', 'b', 'c', 'd', 'e', 'f', 'g', 'h']
     for cat in CATEGORIES:
         ann = os.path.join(voc, 'annotations', cat)
-        os.makedirs(ann)
+        os.makedirs(ann, exist_ok=True)
+        proto = rng.rand(len(kp_names), 2) * 80 + 10
         for i in range(8):
-            pts = rng.rand(len(kp_names), 2) * 80 + 10
+            pts = np.clip(proto + rng.randn(len(kp_names), 2) * 2.5,
+                          1.0, 99.0)
             kps = '\n'.join(
                 f'<keypoint name="{n}" x="{pts[j, 0]:.2f}" '
                 f'y="{pts[j, 1]:.2f}" visible="1" z="0"/>'
@@ -53,10 +63,12 @@ def build_fixture_data(root, seed=0):
                         f'</annotation>')
     for dirname in _DIRNAMES.values():
         base = os.path.join(willow, 'WILLOW-ObjectClass', dirname)
-        os.makedirs(base)
+        os.makedirs(base, exist_ok=True)
+        proto = rng.rand(2, 10) * 100
         for i in range(30):
             savemat(os.path.join(base, f'im{i:03d}.mat'),
-                    {'pts_coord': rng.rand(2, 10) * 100})
+                    {'pts_coord': np.clip(proto + rng.randn(2, 10) * 2.5,
+                                          0.0, 100.0)})
     return voc, willow
 
 
@@ -70,10 +82,32 @@ def main():
     ap.add_argument('--out', default=os.path.join(
         os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
         'runs', 'willow_protocol_r05.jsonl'))
+    ap.add_argument('--root', default=None,
+                    help='persistent fixture root: reused if it already '
+                         'exists, so the cached VGG features survive '
+                         'retries on a flaky tunnel (default: fresh tmp)')
     args = ap.parse_args()
 
-    root = tempfile.mkdtemp(prefix='willow_protocol_')
-    voc, willow_root = build_fixture_data(root)
+    if args.root:
+        root = args.root
+        # Reuse only a COMPLETE fixture (sentinel written after a full
+        # build): a retry after a mid-build crash, or a root built by an
+        # older generator, must rebuild rather than silently hand
+        # willow.main a partial/stale tree.
+        sentinel = os.path.join(root, '.fixture_complete_v2')
+        if os.path.exists(sentinel):
+            voc = os.path.join(root, 'voc')
+            willow_root = os.path.join(root, 'willow')
+        else:
+            import shutil
+            for sub in ('voc', 'willow'):
+                shutil.rmtree(os.path.join(root, sub), ignore_errors=True)
+            os.makedirs(root, exist_ok=True)
+            voc, willow_root = build_fixture_data(root)
+            open(sentinel, 'w').close()
+    else:
+        root = tempfile.mkdtemp(prefix='willow_protocol_')
+        voc, willow_root = build_fixture_data(root)
 
     from dgmc_tpu.experiments import willow
     t0 = time.time()
