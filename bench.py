@@ -41,10 +41,22 @@ competes against that same number — a legitimate optimization, not a
 protocol change (the timed region is identical).
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", extras...}.
+
+Timeout diagnosability: every section reports into a host-side progress
+ledger, and a SIGTERM/SIGALRM (what ``timeout(1)`` sends) makes the
+process print a PARTIAL JSON line — sections completed, per-section
+elapsed, the section in flight — before exiting 124, instead of dying
+silently like ``BENCH_r05.json`` (``rc: 124, parsed: null``). With
+``--obs-dir`` the run additionally leaves the standard telemetry
+artifacts (``python -m dgmc_tpu.obs.report <dir>``), flushed after every
+section so they survive a kill too.
 """
 
+import argparse
+import contextlib
 import json
 import os
+import signal
 import sys
 import time
 
@@ -97,6 +109,70 @@ PEAK_FLOPS = {
 }
 
 
+# ---------------------------------------------------------------------------
+# Section progress + partial-result emission on timeout
+# ---------------------------------------------------------------------------
+
+_PROGRESS = {'sections': {}, 'current': None, 'current_t0': None,
+             'start': time.time()}
+_OBS = None  # RunObserver when --obs-dir is set
+
+
+@contextlib.contextmanager
+def _section(name):
+    """Track one benchmark section in the progress ledger (and in the
+    --obs-dir artifacts), so a timeout mid-run still reports which
+    sections finished and where time went."""
+    # t0 before name: a signal between the two assignments must never see
+    # current set with current_t0 still None (the handler reads both).
+    t0 = _PROGRESS['current_t0'] = time.perf_counter()
+    _PROGRESS['current'] = name
+    try:
+        yield
+        _PROGRESS['sections'][name] = {
+            'ok': True, 'elapsed_s': round(time.perf_counter() - t0, 3)}
+    except Exception as e:
+        _PROGRESS['sections'][name] = {
+            'ok': False, 'elapsed_s': round(time.perf_counter() - t0, 3),
+            'error': f'{type(e).__name__}: {e}'}
+        raise
+    finally:
+        _PROGRESS['current'] = _PROGRESS['current_t0'] = None
+        if _OBS is not None:
+            _OBS.log(name, **_PROGRESS['sections'].get(name, {}))
+            _OBS.snapshot_memory(name)
+
+
+def _emit_partial(signum, frame):
+    """Signal handler: print a partial JSON line and exit 124 (the
+    timeout(1) convention) instead of dying with no evidence."""
+    current, t0 = _PROGRESS['current'], _PROGRESS['current_t0']
+    rec = {
+        'metric': 'train_pairs_per_sec',
+        'value': None,
+        'partial': True,
+        'signal': signal.Signals(signum).name,
+        'elapsed_s': round(time.time() - _PROGRESS['start'], 3),
+        'sections': _PROGRESS['sections'],
+        'current': None if current is None or t0 is None else {
+            'name': current,
+            'elapsed_s': round(time.perf_counter() - t0, 3)},
+    }
+    # No _OBS.flush() here: flush() snapshots the registry under
+    # non-reentrant locks the interrupted main thread may already hold
+    # (REGISTRY._lock, the compile-listener lock) — a blocked acquire in a
+    # signal handler would hang the process, the very failure mode this
+    # handler exists to fix. The obs artifacts are already on disk: every
+    # completed section flushed them.
+    print(json.dumps(rec), flush=True)
+    os._exit(124)
+
+
+def _install_signal_handlers():
+    for sig in (signal.SIGTERM, signal.SIGALRM):
+        signal.signal(sig, _emit_partial)
+
+
 def _aot_compile(jitted, *args, attempts=3):
     """Ahead-of-time compile a jitted step once; the returned executable is
     used for BOTH the timed loop and the cost/memory accounting, so the
@@ -137,13 +213,10 @@ def _perf_stats(compiled, step_seconds):
                 out['mfu_peak_ref'] = f'{kind} bf16 {peak:.0f}'
     except Exception:
         pass
-    try:
-        ma = compiled.memory_analysis()
-        peak_bytes = (ma.argument_size_in_bytes + ma.output_size_in_bytes +
-                      ma.temp_size_in_bytes)
-        out['peak_hbm_gib'] = round(peak_bytes / 2**30, 3)
-    except Exception:
-        pass
+    from dgmc_tpu.obs.memory import compiled_memory
+    cm = compiled_memory(compiled)
+    if cm:
+        out['peak_hbm_gib'] = round(cm['total_bytes'] / 2**30, 3)
     return out
 
 
@@ -308,8 +381,10 @@ def bench_sparse():
     of it would measure the same kernel repeatedly; r03's did)."""
     from dgmc_tpu.ops.topk import chunked_topk
 
-    f32_ms, f32_perf = _bench_sparse_leg(bf16=False)
-    step_ms, perf = _bench_sparse_leg(bf16=True)
+    with _section('sparse_f32'):
+        f32_ms, f32_perf = _bench_sparse_leg(bf16=False)
+    with _section('sparse_bf16'):
+        step_ms, perf = _bench_sparse_leg(bf16=True)
 
     rng = np.random.RandomState(0)
     h_s = jnp.asarray(rng.randn(1, SP_N_S, 256).astype(np.float32))
@@ -333,14 +408,15 @@ def bench_sparse():
     )
     topk_ms = {}
     for name, f in runners:
-        _fence(f(h_s, h_t)[0, 0, 0])
+        with _section(f'topk_{name}'):
+            _fence(f(h_s, h_t)[0, 0, 0])
 
-        def window(f=f):
-            for _ in range(TOPK_ITERS):
-                out = f(h_s, h_t)
-            _fence(out[0, 0, 0])
+            def window(f=f):
+                for _ in range(TOPK_ITERS):
+                    out = f(h_s, h_t)
+                _fence(out[0, 0, 0])
 
-        topk_ms[name] = round(_best_of(window) / TOPK_ITERS * 1e3, 2)
+            topk_ms[name] = round(_best_of(window) / TOPK_ITERS * 1e3, 2)
 
     return {
         'shape': f'{SP_N_S}x{SP_N_T} k={SP_K} steps={NUM_STEPS}',
@@ -354,7 +430,16 @@ def bench_sparse():
     }
 
 
-def main():
+def main(argv=None):
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    from dgmc_tpu.obs import RunObserver, add_obs_flag
+    add_obs_flag(parser)
+    args = parser.parse_args(argv)
+    global _OBS
+    if args.obs_dir:
+        _OBS = RunObserver(args.obs_dir)
+    _install_signal_handlers()
+
     # Sparse first: the allocator's peak_bytes_in_use is process-lifetime,
     # so the sparse leg must run before anything else allocates if its
     # reported peak is to be attributable to the DBP15K workload.
@@ -362,9 +447,11 @@ def main():
         sparse = bench_sparse()
     except Exception as e:  # never let the sparse leg kill the primary line
         sparse = {'error': f'{type(e).__name__}: {e}'}
-    pairs_per_sec, dense_stats = bench_dense()
+    with _section('dense_f32'):
+        pairs_per_sec, dense_stats = bench_dense()
     try:
-        bf16_pps, bf16_stats = bench_dense(bf16=True)
+        with _section('dense_bf16'):
+            bf16_pps, bf16_stats = bench_dense(bf16=True)
         dense_bf16 = {'pairs_per_sec': round(bf16_pps, 2), **bf16_stats}
     except Exception as e:
         dense_bf16 = {'error': f'{type(e).__name__}: {e}'}
@@ -417,7 +504,10 @@ def main():
         'dense_perf': dense_stats,
         'dense_bf16': dense_bf16,
         'sparse_dbp15k': sparse,
+        'sections': _PROGRESS['sections'],
     }))
+    if _OBS is not None:
+        _OBS.close()
 
 
 if __name__ == '__main__':

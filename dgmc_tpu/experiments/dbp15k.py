@@ -22,6 +22,7 @@ import jax
 import numpy as np
 
 from dgmc_tpu.models import DGMC, RelCNN
+from dgmc_tpu.obs import RunObserver, add_obs_flag
 from dgmc_tpu.train import (MetricLogger, create_train_state, make_eval_step,
                             make_train_step, resume_or_init, trace)
 from dgmc_tpu.utils.data import GraphPair, pad_pair_batch
@@ -93,6 +94,7 @@ def parse_args(argv=None):
                              '(auto-detected on TPU pods / SLURM)')
     parser.add_argument('--num_processes', type=int, default=None)
     parser.add_argument('--process_id', type=int, default=None)
+    add_obs_flag(parser)
     return parser.parse_args(argv)
 
 
@@ -260,6 +262,7 @@ def main(argv=None):
     profile_epoch = min(start_epoch + 1, args.epochs)
 
     logger = MetricLogger(args.metrics_log if is_coordinator() else None)
+    obs = RunObserver(args.obs_dir if is_coordinator() else None)
     if start_epoch > 1:
         logger.log(start_epoch - 1, event='resume')
     if is_coordinator():
@@ -278,8 +281,10 @@ def main(argv=None):
         if epoch == args.phase1_epochs + 1 and is_coordinator():
             print('Refine correspondence matrix...')
         step = phase2 if refine else phase1
-        with trace(args.profile if epoch == profile_epoch else None):
-            state, out = step(state, train_batch, sub)
+        with trace(args.profile if epoch == profile_epoch else None), \
+                obs.compile_label(f'phase{2 if refine else 1}'):
+            with obs.step():
+                state, out = step(state, train_batch, sub)
             # No host fetch here: on a tunneled/remote device every scalar
             # fetch costs a full round trip, so the loss rides device-side
             # until an epoch that actually prints — except when profiling,
@@ -308,11 +313,16 @@ def main(argv=None):
                       f'({per_epoch:.1f}s/epoch)')
             logger.log(epoch, loss=loss, hits1=hits1, hits10=hits10,
                        phase=2 if refine else 1)
+            obs.log(epoch, loss=loss, hits1=hits1, hits10=hits10,
+                    phase=2 if refine else 1,
+                    epoch_s=round(per_epoch, 3))
+            obs.snapshot_memory(f'epoch{epoch}')
         if ckpt and (epoch % args.ckpt_every == 0 or epoch == args.epochs):
             ckpt.save(epoch, state)
     if ckpt:
         ckpt.close()
     logger.close()
+    obs.close()
     return state
 
 

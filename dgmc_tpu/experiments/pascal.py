@@ -19,6 +19,7 @@ import jax.numpy as jnp
 
 from dgmc_tpu.data import Cartesian, Compose, Delaunay, Distance, FaceToEdge
 from dgmc_tpu.models import DGMC, SplineCNN
+from dgmc_tpu.obs import RunObserver, add_obs_flag
 from dgmc_tpu.train import (MetricLogger, create_train_state, make_eval_step,
                             make_train_step, resume_or_init, trace)
 from dgmc_tpu.utils import (ConcatDataset, PairLoader, ValidPairDataset,
@@ -54,6 +55,7 @@ def parse_args(argv=None):
                              'explicitly elsewhere)')
     parser.add_argument('--num_processes', type=int, default=None)
     parser.add_argument('--process_id', type=int, default=None)
+    add_obs_flag(parser)
     return parser.parse_args(argv)
 
 
@@ -162,15 +164,18 @@ def main(argv=None):
     profile_epoch = min(start_epoch + 1, args.epochs)
 
     logger = MetricLogger(args.metrics_log if is_coordinator() else None)
+    obs = RunObserver(args.obs_dir if is_coordinator() else None)
     if start_epoch > 1:
         logger.log(start_epoch - 1, event='resume')
     for epoch in range(start_epoch, args.epochs + 1):
         t0 = time.time()
         total = jnp.zeros(())  # device-side; one fetch per epoch
-        with trace(args.profile if epoch == profile_epoch else None):
+        with trace(args.profile if epoch == profile_epoch else None), \
+                obs.compile_label(f'epoch{epoch}'):
             for batch in train_loader:
                 key, sub = jax.random.split(key)
-                state, out = step(state, feed(batch), sub)
+                with obs.step():
+                    state, out = step(state, feed(batch), sub)
                 total = total + out['loss']
             if args.profile and epoch == profile_epoch:
                 float(total)  # keep the trace open until execution ends
@@ -185,11 +190,15 @@ def main(argv=None):
             print(' '.join(c[:5].ljust(5) for c in CATEGORIES) + ' mean')
             print(' '.join(f'{a:.1f}'.ljust(5) for a in accs))
         logger.log(epoch, loss=loss, mean_acc=accs[-1])
+        obs.log(epoch, loss=loss, mean_acc=accs[-1],
+                epoch_s=round(time.time() - t0, 3))
+        obs.snapshot_memory(f'epoch{epoch}')
         if ckpt:
             ckpt.save(epoch, state)
     if ckpt:
         ckpt.close()
     logger.close()
+    obs.close()
     return state
 
 

@@ -23,6 +23,7 @@ import numpy as np
 from dgmc_tpu.data import (Cartesian, Compose, Constant, KNNGraph,
                            RandomGraphPairs)
 from dgmc_tpu.models import DGMC, SplineCNN, metrics
+from dgmc_tpu.obs import RunObserver, add_obs_flag
 from dgmc_tpu.utils import PairLoader, pad_pair_batch
 from dgmc_tpu.utils.data import GraphPair
 from dgmc_tpu.train import (MetricLogger, create_train_state,
@@ -51,6 +52,7 @@ def parse_args(argv=None):
                              'epoch into this directory')
     parser.add_argument('--metrics_log', type=str, default=None,
                         help='append per-epoch metrics to this JSONL file')
+    add_obs_flag(parser)
     return parser.parse_args(argv)
 
 
@@ -106,6 +108,7 @@ def main(argv=None):
         syn_eval_step = make_eval_step(model)
 
     logger = MetricLogger(args.metrics_log)
+    obs = RunObserver(args.obs_dir)
     profile_epoch = min(2, args.epochs)
     key = jax.random.key(args.seed + 1)
     for epoch in range(1, args.epochs + 1):
@@ -116,10 +119,12 @@ def main(argv=None):
         tot_loss = jnp.zeros(())
         tot_correct = jnp.zeros(())
         tot_n = 0.0
-        with trace(args.profile if epoch == profile_epoch else None):
+        with trace(args.profile if epoch == profile_epoch else None), \
+                obs.compile_label(f'epoch{epoch}'):
             for batch in train_loader:
                 key, sub = jax.random.split(key)
-                state, out = step(state, batch, sub)
+                with obs.step():
+                    state, out = step(state, batch, sub)
                 tot_loss = tot_loss + out['loss']
                 n_b = float(batch.y_mask.sum())
                 tot_correct = tot_correct + out['acc'] * n_b
@@ -133,6 +138,9 @@ def main(argv=None):
               f' Acc: {acc:.2f},'
               f' {time.time() - t0:.1f}s')
         logger.log(epoch, loss=loss, train_acc=acc)
+        obs.log(epoch, loss=loss, train_acc=acc,
+                epoch_s=round(time.time() - t0, 3))
+        obs.snapshot_memory(f'epoch{epoch}')
 
         if syn_eval_loader is not None:
             # Dedicated RNG stream: drawing from the training key chain
@@ -155,6 +163,7 @@ def main(argv=None):
             # this JSONL (the percentage is print-only, mirroring the
             # reference's printed tables).
             logger.log(epoch, synthetic_eval_acc=eval_acc)
+            obs.log(epoch, synthetic_eval_acc=eval_acc)
 
         if test_datasets:
             accs = []
@@ -178,6 +187,7 @@ def main(argv=None):
             print(' '.join(f'{a:.1f}'.ljust(5) for a in accs))
             logger.log(epoch, mean_acc=accs[-1])
     logger.close()
+    obs.close()
     return state
 
 

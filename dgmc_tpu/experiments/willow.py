@@ -23,6 +23,7 @@ import numpy as np
 
 from dgmc_tpu.data import Cartesian, Compose, Delaunay, Distance, FaceToEdge
 from dgmc_tpu.models import DGMC, SplineCNN
+from dgmc_tpu.obs import RunObserver, add_obs_flag
 from dgmc_tpu.train import (Checkpointer, MetricLogger, create_train_state,
                             make_eval_step, make_train_step, restore_params,
                             snapshot_params, trace)
@@ -69,6 +70,7 @@ def parse_args(argv=None):
     parser.add_argument('--metrics_log', type=str, default=None,
                         help='append per-epoch/per-run metrics to this '
                              'JSONL file')
+    add_obs_flag(parser)
     return parser.parse_args(argv)
 
 
@@ -131,6 +133,7 @@ def main(argv=None):
     # it, so a killed 20-run protocol restarts at the next unfinished run
     # instead of re-pretraining.
     logger = MetricLogger(args.metrics_log)
+    obs = RunObserver(args.obs_dir)
     ckpt = Checkpointer(args.ckpt_dir) if args.ckpt_dir else None
     runs_path = (os.path.join(args.ckpt_dir, 'runs.json')
                  if args.ckpt_dir else None)
@@ -155,23 +158,28 @@ def main(argv=None):
             t0 = time.time()
             total = jnp.zeros(())  # device-side; one fetch per epoch
             first = True
-            for batch in pretrain_loader:
-                key, sub = jax.random.split(key)
-                # Trace the first step of the second epoch (the first
-                # epoch is compile-heavy).
-                arm = need_profile if epoch == 2 and first else None
-                with trace(arm):
-                    state, out = step(state, batch, sub)
+            with obs.compile_label('pretrain'):
+                for batch in pretrain_loader:
+                    key, sub = jax.random.split(key)
+                    # Trace the first step of the second epoch (the first
+                    # epoch is compile-heavy).
+                    arm = need_profile if epoch == 2 and first else None
+                    with trace(arm):
+                        with obs.step():
+                            state, out = step(state, batch, sub)
+                        if arm:
+                            float(out['loss'])
                     if arm:
-                        float(out['loss'])
-                if arm:
-                    need_profile = None
-                first = False
-                total = total + out['loss']
+                        need_profile = None
+                    first = False
+                    total = total + out['loss']
             loss = float(total) / len(pretrain_loader)
             print(f'Epoch: {epoch:02d}, Loss: {loss:.4f}, '
                   f'{time.time() - t0:.1f}s')
             logger.log(epoch, loss=loss, stage='pretrain')
+            obs.log(epoch, loss=loss, stage='pretrain',
+                    epoch_s=round(time.time() - t0, 3))
+            obs.snapshot_memory(f'pretrain_epoch{epoch}')
         if ckpt:
             ckpt.save(0, state, wait=True)
     snapshot = snapshot_params(state)
@@ -240,14 +248,16 @@ def main(argv=None):
                             shuffle=True, seed=args.seed + i,
                             num_nodes=num_nodes, num_edges=num_edges)
         nonlocal need_profile
-        for epoch in range(args.epochs):
-            for batch in loader:
-                key, sub = jax.random.split(key)
-                with trace(need_profile):
-                    run_state, out = step(run_state, batch, sub)
-                    if need_profile:
-                        float(out['loss'])
-                need_profile = None
+        with obs.compile_label(f'run{i}'):
+            for epoch in range(args.epochs):
+                for batch in loader:
+                    key, sub = jax.random.split(key)
+                    with trace(need_profile):
+                        with obs.step():
+                            run_state, out = step(run_state, batch, sub)
+                        if need_profile:
+                            float(out['loss'])
+                    need_profile = None
         accs = []
         for ds in willow:
             _, test_ds = ds.shuffled_split(20, seed=args.seed + i)
@@ -256,6 +266,8 @@ def main(argv=None):
         print(' '.join(c.ljust(13) for c in WILLOW_CATEGORIES))
         print(' '.join(f'{a:.2f}'.ljust(13) for a in accs))
         logger.log(i, stage='run', accs=accs)
+        obs.log(i, stage='run', mean_acc=sum(accs) / len(accs))
+        obs.snapshot_memory(f'run{i}')
         return accs
 
     for i in range(len(done_accs) + 1, args.runs + 1):
@@ -272,6 +284,7 @@ def main(argv=None):
     if ckpt:
         ckpt.close()
     logger.close()
+    obs.close()
     return all_accs
 
 

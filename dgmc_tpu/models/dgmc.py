@@ -281,7 +281,11 @@ class DGMC(nn.Module):
             return pair.apply(
                 lambda x, g: run_psi(m, x, g, train=train), x_s_in, x_t_in)
 
-        h_s, h_t = run_pair(self.psi_1, graph_s.x, graph_t.x, merge_1)
+        # Stage scopes (psi1 / initial_corr / topk / consensus_iter / psi2)
+        # name the matching pipeline's phases in profiler traces and
+        # lowered HLO metadata — numerics are untouched.
+        with jax.named_scope('psi1'):
+            h_s, h_t = run_pair(self.psi_1, graph_s.x, graph_t.x, merge_1)
         if self.dtype is not None:
             h_s, h_t = h_s.astype(self.dtype), h_t.astype(self.dtype)
         if detach:
@@ -369,56 +373,80 @@ class DGMC(nn.Module):
             # DBP15K config), so the latency-bound random gathers run
             # once for all T iterations.
             x = r_all.transpose(1, 2, 0, 3).reshape(B, N_s, T * R_in)
-            o = run_psi(self.psi_2, x, graph_s, train=train, streams=T)
+            with jax.named_scope('psi2'):
+                o = run_psi(self.psi_2, x, graph_s, train=train, streams=T)
             return r_all, o.reshape(B, N_s, T, -1).transpose(2, 0, 1, 3)
 
         if self.k < 1:
             # ---- Dense variant ----
-            S_hat = self._constrain(
-                jnp.einsum('bsc,btc->bst', h_s, h_t,
-                           preferred_element_type=jnp.float32))
-            S_mask = s_mask[:, :, None] & t_mask[:, None, :]
-            S_0 = masked_softmax(S_hat, S_mask)
-
-            if self.fused_consensus is None:
-                from dgmc_tpu.ops.pallas.consensus import TILE_S, TILE_T
-                from dgmc_tpu.ops.pallas.dispatch import (
-                    fused_kernels_allowed)
-                # R ceiling: the kernel holds two [TILE_S*TILE_T, R] f32
-                # tiles in VMEM (64 KiB x R each); measurements cover
-                # R <= 128 (benchmarks/fused_consensus_tpu.json) and
-                # R = 256 would blow the 16 MB scoped-VMEM limit.
-                use_fused = (jax.default_backend() == 'tpu'
-                             and fused_kernels_allowed()
-                             and N_s >= TILE_S and N_t >= TILE_T
-                             and R_out <= 128)
-            else:
-                use_fused = self.fused_consensus
-            use_fused = use_fused and self.corr_sharding is None
-            pre = prefetch_source(num_steps)
-            for step in range(num_steps):
-                S = masked_softmax(S_hat, S_mask)
-                r_s = pre[0][step] if pre is not None else noise(step)
-                r_t = jnp.einsum('bst,bsr->btr', S, r_s)
-                if pre is not None:
-                    o_s = pre[1][step]
-                    o_t = run_psi(self.psi_2, r_t, graph_t, train=train)
-                else:
-                    o_s, o_t = run_pair(self.psi_2, r_s, r_t, merge_2)
-                if use_fused:
-                    from dgmc_tpu.ops.pallas import consensus_update
-                    cast = lambda a: a.astype(o_s.dtype)  # noqa: E731
-                    delta = consensus_update(
-                        o_s, o_t, cast(mlp_w1), cast(mlp_b1),
-                        cast(mlp_w2), cast(mlp_b2),
-                        jax.default_backend() != 'tpu')  # interpret off-TPU
-                else:
-                    w1 = mlp_w1.astype(o_s.dtype)
-                    delta = consensus_factored(
-                        o_s @ w1 + mlp_b1.astype(o_s.dtype),
-                        (o_t @ w1)[:, None, :, :])
+            with jax.named_scope('initial_corr'):
                 S_hat = self._constrain(
-                    S_hat + jnp.where(S_mask, delta, 0.0))
+                    jnp.einsum('bsc,btc->bst', h_s, h_t,
+                               preferred_element_type=jnp.float32))
+                S_mask = s_mask[:, :, None] & t_mask[:, None, :]
+                S_0 = masked_softmax(S_hat, S_mask)
+
+            # Resolve (and record) the kernel decision only when the
+            # consensus loop actually runs — num_steps == 0 must not
+            # claim a dispatch outcome for code that never executes.
+            use_fused = False
+            if num_steps > 0 and self.fused_consensus is None:
+                if self.corr_sharding is not None:
+                    from dgmc_tpu.ops.pallas.dispatch import record_dispatch
+                    record_dispatch('dense_consensus', 'fallback',
+                                    'gspmd-silenced')
+                else:
+                    from dgmc_tpu.ops.pallas.consensus import TILE_S, TILE_T
+                    from dgmc_tpu.ops.pallas.dispatch import auto_fused
+                    # R ceiling: the kernel holds two [TILE_S*TILE_T, R]
+                    # f32 tiles in VMEM (64 KiB x R each); measurements
+                    # cover R <= 128
+                    # (benchmarks/fused_consensus_tpu.json) and R = 256
+                    # would blow the 16 MB scoped-VMEM limit.
+                    use_fused = auto_fused(
+                        'dense_consensus',
+                        size_ok=(N_s >= TILE_S and N_t >= TILE_T
+                                 and R_out <= 128))
+            elif num_steps > 0:
+                # Explicit True with corr_sharding was rejected loudly
+                # above, so no silent clamp can happen here.
+                from dgmc_tpu.ops.pallas.dispatch import record_dispatch
+                use_fused = self.fused_consensus
+                record_dispatch('dense_consensus',
+                                'pallas' if use_fused else 'fallback',
+                                'explicit')
+            pre = prefetch_source(num_steps)
+
+            def dense_iter(step, S_hat):
+                with jax.named_scope('consensus_iter'):
+                    S = masked_softmax(S_hat, S_mask)
+                    r_s = pre[0][step] if pre is not None else noise(step)
+                    r_t = jnp.einsum('bst,bsr->btr', S, r_s)
+                    with jax.named_scope('psi2'):
+                        if pre is not None:
+                            o_s = pre[1][step]
+                            o_t = run_psi(self.psi_2, r_t, graph_t,
+                                          train=train)
+                        else:
+                            o_s, o_t = run_pair(self.psi_2, r_s, r_t,
+                                                merge_2)
+                    if use_fused:
+                        from dgmc_tpu.ops.pallas import consensus_update
+                        cast = lambda a: a.astype(o_s.dtype)  # noqa: E731
+                        delta = consensus_update(
+                            o_s, o_t, cast(mlp_w1), cast(mlp_b1),
+                            cast(mlp_w2), cast(mlp_b2),
+                            jax.default_backend() != 'tpu')  # interpret
+                    else:
+                        w1 = mlp_w1.astype(o_s.dtype)
+                        delta = consensus_factored(
+                            o_s @ w1 + mlp_b1.astype(o_s.dtype),
+                            (o_t @ w1)[:, None, :, :])
+                    return self._constrain(
+                        S_hat + jnp.where(S_mask, delta, 0.0))
+
+            for step in range(num_steps):
+                S_hat = dense_iter(step, S_hat)
 
             S_L = masked_softmax(S_hat, S_mask)
             return (Correspondence(S_0, None, s_mask, t_mask),
@@ -433,18 +461,21 @@ class DGMC(nn.Module):
         # partitioning rule, but it does run under shard_map
         # (parallel/topk.corr_sharded_topk). Ragged row counts are padded
         # inside the embedding; only a ragged batch axis falls back.
-        S_idx = None
-        if self.corr_sharding is not None:
-            from dgmc_tpu.parallel.topk import corr_sharded_topk
-            S_idx = corr_sharded_topk(self.corr_sharding, h_s, h_t, self.k,
-                                      t_mask, block=self.topk_block)
-        if S_idx is None:
-            S_idx = chunked_topk(h_s, h_t, self.k, t_mask=t_mask,
-                                 block=self.topk_block,
-                                 pallas=False
-                                 if self.corr_sharding is not None
-                                 else None)
-        S_idx = self._constrain(S_idx)
+        with jax.named_scope('topk'):
+            S_idx = None
+            if self.corr_sharding is not None:
+                from dgmc_tpu.parallel.topk import corr_sharded_topk
+                S_idx = corr_sharded_topk(self.corr_sharding, h_s, h_t,
+                                          self.k, t_mask,
+                                          block=self.topk_block)
+            if S_idx is None:
+                S_idx = chunked_topk(h_s, h_t, self.k, t_mask=t_mask,
+                                     block=self.topk_block,
+                                     pallas=False
+                                     if self.corr_sharding is not None
+                                     else None,
+                                     dispatch_reason='gspmd-silenced')
+            S_idx = self._constrain(S_idx)
 
         # Candidate-slot validity WITHOUT gathering t_mask at S_idx (a
         # ~300k-row bool gather, ~2.4 ms/step at DBP15K scale), by
@@ -521,10 +552,11 @@ class DGMC(nn.Module):
                 return jax.vmap(scat)(contrib.reshape(B, N_s * K_, R_in),
                                       S_idx.reshape(B, N_s * K_))
 
-        h_t_cand = cand_rows(h_t)
-        S_hat = jnp.einsum('bsc,bskc->bsk', h_s, h_t_cand,
-                           preferred_element_type=jnp.float32)
-        S_0 = masked_softmax(S_hat, entry_mask) * s_mask[..., None]
+        with jax.named_scope('initial_corr'):
+            h_t_cand = cand_rows(h_t)
+            S_hat = jnp.einsum('bsc,bskc->bsk', h_s, h_t_cand,
+                               preferred_element_type=jnp.float32)
+            S_0 = masked_softmax(S_hat, entry_mask) * s_mask[..., None]
 
         # Fused consensus-delta kernel (ops/pallas/sparse_consensus.py):
         # forms the [TILE, K, R] difference block and MLP activations in
@@ -539,33 +571,45 @@ class DGMC(nn.Module):
         # "off" (the recorded negative result above). corr_sharding was
         # rejected loudly earlier; an unsatisfiable width is too.
         use_sc = self.fused_sparse_consensus is True
+        if num_steps > 0:
+            from dgmc_tpu.ops.pallas.dispatch import record_dispatch
+            record_dispatch(
+                'sparse_consensus', 'pallas' if use_sc else 'fallback',
+                'explicit' if self.fused_sparse_consensus is not None
+                else 'default-off')
         if use_sc and R_out > 128:
             raise ValueError(
                 f'fused_sparse_consensus=True requires psi_2 out_channels '
                 f'<= 128 (VMEM tile bound); got {R_out}')
 
         pre = prefetch_source(num_steps)
+
+        def sparse_iter(step, S_hat):
+            with jax.named_scope('consensus_iter'):
+                S = masked_softmax(S_hat, entry_mask) * s_mask[..., None]
+                r_s = pre[0][step] if pre is not None else noise(step)
+                r_t = project(S, r_s)
+                with jax.named_scope('psi2'):
+                    if pre is not None:
+                        o_s = pre[1][step]
+                        o_t = run_psi(self.psi_2, r_t, graph_t, train=train)
+                    else:
+                        o_s, o_t = run_pair(self.psi_2, r_s, r_t, merge_2)
+                o_t_cand = cand_rows(o_t)
+                if use_sc:
+                    from dgmc_tpu.ops.pallas.sparse_consensus import (
+                        sparse_consensus_delta)
+                    cast = lambda a: a.astype(o_s.dtype)  # noqa: E731
+                    delta = sparse_consensus_delta(
+                        o_s, o_t_cand, cast(mlp_w1), cast(mlp_b1),
+                        cast(mlp_w2), cast(mlp_b2),
+                        jax.default_backend() != 'tpu')
+                else:
+                    delta = consensus_mlp(o_s[:, :, None, :] - o_t_cand)
+                return self._constrain(S_hat + delta)
+
         for step in range(num_steps):
-            S = masked_softmax(S_hat, entry_mask) * s_mask[..., None]
-            r_s = pre[0][step] if pre is not None else noise(step)
-            r_t = project(S, r_s)
-            if pre is not None:
-                o_s = pre[1][step]
-                o_t = run_psi(self.psi_2, r_t, graph_t, train=train)
-            else:
-                o_s, o_t = run_pair(self.psi_2, r_s, r_t, merge_2)
-            o_t_cand = cand_rows(o_t)
-            if use_sc:
-                from dgmc_tpu.ops.pallas.sparse_consensus import (
-                    sparse_consensus_delta)
-                cast = lambda a: a.astype(o_s.dtype)  # noqa: E731
-                delta = sparse_consensus_delta(
-                    o_s, o_t_cand, cast(mlp_w1), cast(mlp_b1),
-                    cast(mlp_w2), cast(mlp_b2),
-                    jax.default_backend() != 'tpu')
-            else:
-                delta = consensus_mlp(o_s[:, :, None, :] - o_t_cand)
-            S_hat = self._constrain(S_hat + delta)
+            S_hat = sparse_iter(step, S_hat)
 
         S_L = masked_softmax(S_hat, entry_mask) * s_mask[..., None]
         return (Correspondence(S_0, S_idx, s_mask, t_mask),

@@ -59,13 +59,17 @@ class GIN(nn.Module):
 
     @nn.compact
     def __call__(self, x, graph, train=False):
+        import jax
+
         xs = [x]
         in_ch = self.in_channels
         for i in range(self.num_layers):
             mlp = MLP(in_ch, self.channels, 2, self.batch_norm, dropout=0.0,
                       dtype=self.dtype, name=f'mlp_{i}')
-            xs.append(GINConv(mlp, name=f'conv_{i}')(xs[-1], graph,
-                                                     train=train))
+            # Named layer scopes for profiler-trace attribution.
+            with jax.named_scope(f'gin_conv_{i}'):
+                xs.append(GINConv(mlp, name=f'conv_{i}')(xs[-1], graph,
+                                                         train=train))
             in_ch = self.channels
         out = jnp.concatenate(xs, axis=-1) if self.cat else xs[-1]
         if self.lin:

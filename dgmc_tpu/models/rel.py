@@ -115,12 +115,16 @@ class RelCNN(nn.Module):
                 'evaluation draws ONE mask across the channel groups, '
                 'coupling what should be independent iterations '
                 '(DGMC.prefetch_source skips packing in this case)')
+        import jax
+
         B, N = x.shape[0], x.shape[1]
         xs = [x]
         for i in range(self.num_layers):
-            h = RelConv(self.channels, dtype=self.dtype,
-                        name=f'conv_{i}')(xs[-1], graph, train=train,
-                                          streams=streams)
+            # Named layer scopes for profiler-trace attribution.
+            with jax.named_scope(f'rel_conv_{i}'):
+                h = RelConv(self.channels, dtype=self.dtype,
+                            name=f'conv_{i}')(xs[-1], graph, train=train,
+                                              streams=streams)
             h = nn.relu(h)
             if self.batch_norm:
                 h = MaskedBatchNorm(name=f'bn_{i}')(

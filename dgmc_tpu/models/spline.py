@@ -69,15 +69,20 @@ class SplineConv(nn.Module):
         flat = graph.senders[..., None] * KD + combo        # [B, E, 2^D]
         E, A = flat.shape[1], flat.shape[2]
 
-        from dgmc_tpu.ops.pallas.dispatch import fused_kernels_allowed
+        from dgmc_tpu.ops.pallas.dispatch import (auto_fused,
+                                                  record_dispatch)
         from dgmc_tpu.ops.pallas.spline import (route_aggregate,
                                                 route_aggregate_fits)
         use_fused = self.fused
         if use_fused is None:
-            use_fused = (jax.default_backend() == 'tpu'
-                         and fused_kernels_allowed()
-                         and route_aggregate_fits(N, E, KD,
-                                                  self.out_features))
+            use_fused = auto_fused(
+                'spline_route',
+                size_ok=route_aggregate_fits(N, E, KD, self.out_features),
+                size_reason='vmem')
+        else:
+            record_dispatch('spline_route',
+                            'pallas' if use_fused else 'fallback',
+                            'explicit')
         if use_fused:
             agg = route_aggregate(t, flat, basis, graph.receivers,
                                   graph.edge_mask, N)
@@ -119,11 +124,16 @@ class SplineCNN(nn.Module):
 
     @nn.compact
     def __call__(self, x, graph, train=False):
+        import jax
+
         xs = [x]
         for i in range(self.num_layers):
-            h = SplineConv(self.channels, self.dim, fused=self.fused,
-                           dtype=self.dtype,
-                           name=f'conv_{i}')(xs[-1], graph, train=train)
+            # Named layer scopes so profiler traces attribute time to the
+            # conv stack instead of anonymous fused XLA ops.
+            with jax.named_scope(f'spline_conv_{i}'):
+                h = SplineConv(self.channels, self.dim, fused=self.fused,
+                               dtype=self.dtype,
+                               name=f'conv_{i}')(xs[-1], graph, train=train)
             xs.append(nn.relu(h))
         out = jnp.concatenate(xs, axis=-1) if self.cat else xs[-1]
         out = nn.Dropout(self.dropout, deterministic=not train)(out)

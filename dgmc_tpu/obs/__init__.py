@@ -1,0 +1,54 @@
+"""Observability subsystem: profiler spans, telemetry registry, run
+artifacts, and the report CLI.
+
+The reference logs training with bare ``print()`` lines and publishes no
+wall-clock numbers (SURVEY.md §5), so every perf claim this repo makes
+rests on its own measurements. This package is the single place those
+measurements come from:
+
+- :mod:`~dgmc_tpu.obs.observe` — staged profiler traces
+  (:func:`trace`), fenced per-step wall-clock timing (:class:`StepTimer`),
+  and the JSONL metric sink (:class:`MetricLogger`). Formerly
+  ``dgmc_tpu.train.observe``; the old import path remains as a deprecated
+  alias.
+- :mod:`~dgmc_tpu.obs.registry` — process-wide counter/gauge registry:
+  jit compile events (padding-bucket recompile churn), kernel-dispatch
+  outcomes (Pallas-taken vs XLA-fallback vs GSPMD-silenced, with reason).
+- :mod:`~dgmc_tpu.obs.memory` — per-device ``memory_stats()`` snapshots
+  with a host-RSS fallback for platforms (CPU, tunneled TPU) where the
+  allocator publishes nothing.
+- :mod:`~dgmc_tpu.obs.run` — the :class:`RunObserver` facade behind the
+  ``--obs-dir`` flag of every experiment CLI and ``bench.py``: one
+  directory holding ``metrics.jsonl``, ``timings.json``, ``memory.json``
+  and ``dispatch.json``.
+- :mod:`~dgmc_tpu.obs.report` — ``python -m dgmc_tpu.obs.report <dir>``:
+  renders throughput, step-time percentiles, recompile counts, HBM peaks
+  and the kernel-dispatch table from those artifacts.
+
+Model code carries :func:`jax.named_scope` annotations for the matching
+pipeline's stages (``psi1``, ``initial_corr``, ``topk``,
+``consensus_iter``, ``psi2``) so Perfetto/TensorBoard traces and lowered
+HLO show the algorithm's structure instead of anonymous XLA ops.
+"""
+
+from dgmc_tpu.obs.observe import MetricLogger, StepTimer, trace
+from dgmc_tpu.obs.registry import (REGISTRY, CompileWatcher, Registry,
+                                   compile_event_count, dispatch_table,
+                                   record_dispatch)
+from dgmc_tpu.obs.memory import memory_snapshot
+from dgmc_tpu.obs.run import RunObserver, add_obs_flag
+
+__all__ = [
+    'MetricLogger',
+    'StepTimer',
+    'trace',
+    'Registry',
+    'REGISTRY',
+    'CompileWatcher',
+    'compile_event_count',
+    'record_dispatch',
+    'dispatch_table',
+    'memory_snapshot',
+    'RunObserver',
+    'add_obs_flag',
+]

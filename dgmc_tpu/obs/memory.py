@@ -1,0 +1,85 @@
+"""Memory telemetry: per-device allocator snapshots with a host fallback.
+
+``device.memory_stats()`` is the live HBM allocator view on real TPUs, but
+it returns ``None``/``{}`` on the CPU backend and on some tunneled TPU
+platforms (bench.py's notes). Observability must not silently go dark
+there, so every snapshot also records host process memory from
+``/proc/self/status`` (VmRSS/VmHWM) — on CPU runs the "HBM" *is* host
+memory, and on a starved tunneled platform the host numbers still bound
+the process. Peak extraction in ``obs.report`` prefers device peaks and
+falls back to the host high-water mark.
+"""
+
+import time
+
+
+def _device_stats():
+    """Per-device allocator stats; entries are ``None`` where the platform
+    publishes nothing."""
+    import jax
+    out = []
+    for d in jax.local_devices():
+        try:
+            stats = d.memory_stats()
+        except Exception:
+            stats = None
+        rec = {'id': d.id, 'kind': d.device_kind, 'platform': d.platform}
+        if stats:
+            rec['bytes_in_use'] = int(stats.get('bytes_in_use', 0))
+            peak = stats.get('peak_bytes_in_use')
+            if peak is not None:
+                rec['peak_bytes_in_use'] = int(peak)
+            limit = stats.get('bytes_limit')
+            if limit is not None:
+                rec['bytes_limit'] = int(limit)
+        else:
+            rec['stats'] = None
+        out.append(rec)
+    return out
+
+
+def _host_stats():
+    """Host process RSS and high-water mark, in bytes."""
+    out = {}
+    try:
+        with open('/proc/self/status') as f:
+            for line in f:
+                if line.startswith('VmRSS:'):
+                    out['rss_bytes'] = int(line.split()[1]) * 1024
+                elif line.startswith('VmHWM:'):
+                    out['peak_rss_bytes'] = int(line.split()[1]) * 1024
+    except OSError:
+        pass
+    if 'peak_rss_bytes' not in out:
+        try:
+            import resource
+            ru = resource.getrusage(resource.RUSAGE_SELF)
+            out['peak_rss_bytes'] = ru.ru_maxrss * 1024  # KiB on Linux
+        except Exception:
+            pass
+    return out
+
+
+def memory_snapshot(tag=''):
+    """One labelled memory snapshot: device allocator stats + host RSS."""
+    return {'tag': tag, 'time': time.time(),
+            'devices': _device_stats(), 'host': _host_stats()}
+
+
+def compiled_memory(compiled):
+    """Static peak-HBM bound of one compiled executable
+    (``memory_analysis``): argument + output + temp bytes. Works even
+    where the live allocator publishes nothing. Returns ``{}`` if the
+    platform refuses."""
+    try:
+        ma = compiled.memory_analysis()
+        return {
+            'argument_bytes': int(ma.argument_size_in_bytes),
+            'output_bytes': int(ma.output_size_in_bytes),
+            'temp_bytes': int(ma.temp_size_in_bytes),
+            'total_bytes': int(ma.argument_size_in_bytes +
+                               ma.output_size_in_bytes +
+                               ma.temp_size_in_bytes),
+        }
+    except Exception:
+        return {}

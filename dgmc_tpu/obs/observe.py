@@ -1,0 +1,143 @@
+"""Observability primitives: profiler traces, per-step timing, metric
+logging.
+
+The reference has no tracing, timing, or metric sink of any kind — training
+progress is bare ``print()`` lines (SURVEY.md §5: reference
+``examples/dbp15k.py:75-76``, ``examples/pascal.py:109-110``). Here these
+are first-class:
+
+- :func:`trace` — a ``jax.profiler`` trace of a step window, viewable in
+  TensorBoard/Perfetto, for finding MXU idle time and HBM stalls. Model
+  code carries ``jax.named_scope`` stage annotations (``psi1``, ``topk``,
+  ``consensus_iter``, ``psi2``; see ``models/dgmc.py``), so the trace
+  shows the matching pipeline's stages rather than anonymous XLA ops.
+- :class:`StepTimer` — wall-clock per-step timing with a device fence, so
+  the numbers measure execution rather than dispatch.
+- :class:`MetricLogger` — JSONL metric sink alongside (not replacing) the
+  reference-parity stdout prints.
+
+Formerly ``dgmc_tpu.train.observe``; that module remains as a deprecated
+alias of this one.
+"""
+
+import contextlib
+import json
+import os
+import time
+
+
+@contextlib.contextmanager
+def trace(log_dir):
+    """Profile the enclosed steps into ``log_dir`` (no-op if ``log_dir`` is
+    falsy). The trace captures XLA device activity on the real TPU and
+    host-side dispatch everywhere."""
+    if not log_dir:
+        yield
+        return
+    # Lazy: this module must import without jax so the report CLI can
+    # render telemetry from a dead run on any box.
+    import jax
+    os.makedirs(log_dir, exist_ok=True)
+    with jax.profiler.trace(log_dir):
+        yield
+
+
+def percentile(sorted_times, q):
+    """Linear-interpolated percentile (``q`` in [0, 1]) of an already
+    sorted list — numpy's default 'linear' rule, so the p50 of an
+    even-length window is the mean of the two middle elements rather than
+    the upper one."""
+    if not sorted_times:
+        raise ValueError('percentile of an empty window')
+    pos = q * (len(sorted_times) - 1)
+    lo = int(pos)
+    hi = min(lo + 1, len(sorted_times) - 1)
+    return sorted_times[lo] + (sorted_times[hi] - sorted_times[lo]) * (
+        pos - lo)
+
+
+class StepTimer:
+    """Accumulates fenced per-step wall-clock times.
+
+    ``fence`` should be a device scalar from the step's outputs (e.g. the
+    loss); fetching it to host guarantees the step actually finished before
+    the clock stops. Without a fence the recorded time is host-observed
+    dispatch+wait, which still averages to true step time over a window
+    that ends in a host fetch.
+    """
+
+    def __init__(self):
+        self.times = []
+        self._t0 = None
+
+    def start(self):
+        self._t0 = time.perf_counter()
+
+    def stop(self, fence=None):
+        if self._t0 is None:
+            raise RuntimeError(
+                'StepTimer.stop() called without a matching start(); call '
+                'start() before each timed step')
+        if fence is not None:
+            float(fence)
+        self.times.append(time.perf_counter() - self._t0)
+        self._t0 = None
+        return self.times[-1]
+
+    @property
+    def mean(self):
+        return sum(self.times) / max(len(self.times), 1)
+
+    def summary(self):
+        if not self.times:
+            return {}
+        ts = sorted(self.times)
+        return {
+            'steps': len(ts),
+            'mean_s': self.mean,
+            'p50_s': percentile(ts, 0.5),
+            'p95_s': percentile(ts, 0.95),
+            'max_s': ts[-1],
+            'total_s': sum(ts),
+        }
+
+
+class MetricLogger:
+    """Append-only JSONL metric sink (one object per ``log`` call).
+
+    Cheap enough to leave on: one ``json.dumps`` + buffered write per step.
+    Pass ``path=None`` to disable (all calls become no-ops). ``mode='a'``
+    (default) appends across invocations — the standalone ``--metrics_log``
+    contract; :class:`~dgmc_tpu.obs.run.RunObserver` passes ``'w'`` so a
+    reused ``--obs-dir`` holds ONE run, consistent with the other
+    artifacts it rewrites.
+    """
+
+    def __init__(self, path, mode='a'):
+        self.path = path
+        self._fh = None
+        if path:
+            os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+            self._fh = open(path, mode)
+
+    def log(self, step, **metrics):
+        if self._fh is None:
+            return
+        rec = {'step': step, 'time': time.time()}
+        for k, v in metrics.items():
+            # Device scalars / numpy types to float; bools stay bools.
+            coerce = hasattr(v, '__float__') and not isinstance(v, bool)
+            rec[k] = float(v) if coerce else v
+        self._fh.write(json.dumps(rec) + '\n')
+        self._fh.flush()
+
+    def close(self):
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

@@ -1,0 +1,259 @@
+"""Process-wide telemetry registry: counters, gauges, kernel-dispatch
+outcomes, and jit compile events.
+
+Everything here is host-side and cheap (a dict increment under a lock), so
+it is always on — there is no "enabled" switch to forget. The registry is
+the source of truth that :class:`~dgmc_tpu.obs.run.RunObserver` snapshots
+into ``dispatch.json`` / ``timings.json``.
+
+Counting semantics worth knowing:
+
+- **Dispatch counters** (:func:`record_dispatch`) increment when a kernel
+  *decision* is made. Auto decisions are resolved in un-jitted wrappers or
+  at module trace time (see ``ops/topk.chunked_topk``,
+  ``models/dgmc.py``), so each count corresponds to one traced program,
+  not one executed device step — exactly the granularity at which the
+  decision can change (a recompile). A program that traces once and runs
+  10k steps contributes one count per decision site.
+- **Compile events** (:class:`CompileWatcher`) come from
+  ``jax.monitoring``: one event per XLA backend compile
+  (``backend_compile_duration``) or per persistent-cache hit (a hit still
+  builds a new executable from the cached binary). Repeated same-shape
+  calls of a jitted function produce zero further events; a new padding
+  bucket produces one — which makes recompile churn from unstable batch
+  shapes directly visible.
+"""
+
+import contextlib
+import threading
+import time
+
+
+class Registry:
+    """Thread-safe labelled counters and gauges."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters = {}
+        self._gauges = {}
+
+    @staticmethod
+    def _key(name, labels):
+        return (name, tuple(sorted(labels.items())))
+
+    def inc(self, name, value=1, **labels):
+        with self._lock:
+            k = self._key(name, labels)
+            self._counters[k] = self._counters.get(k, 0) + value
+
+    def gauge(self, name, value, **labels):
+        with self._lock:
+            self._gauges[self._key(name, labels)] = value
+
+    def counter_value(self, name, **labels):
+        with self._lock:
+            return self._counters.get(self._key(name, labels), 0)
+
+    def total(self, name):
+        """Sum of a counter over all label combinations."""
+        with self._lock:
+            return sum(v for (n, _), v in self._counters.items()
+                       if n == name)
+
+    def snapshot(self):
+        """JSON-ready dump: ``{'counters': [...], 'gauges': [...]}``."""
+        with self._lock:
+            return {
+                'counters': [
+                    {'name': n, 'labels': dict(ls), 'value': v}
+                    for (n, ls), v in sorted(self._counters.items())],
+                'gauges': [
+                    {'name': n, 'labels': dict(ls), 'value': v}
+                    for (n, ls), v in sorted(self._gauges.items())],
+            }
+
+    def reset(self):
+        with self._lock:
+            self._counters.clear()
+            self._gauges.clear()
+
+
+#: The process-wide registry every call site records into.
+REGISTRY = Registry()
+
+
+# ---------------------------------------------------------------------------
+# Kernel-dispatch outcomes
+# ---------------------------------------------------------------------------
+
+DISPATCH_COUNTER = 'pallas_dispatch'
+
+
+def record_dispatch(kernel, outcome, reason):
+    """Record one kernel-dispatch decision.
+
+    Args:
+        kernel: decision site, e.g. ``'topk'``, ``'dense_consensus'``,
+            ``'sparse_consensus'``, ``'spline_route'``.
+        outcome: ``'pallas'`` (fused kernel taken) or ``'fallback'``
+            (XLA path taken).
+        reason: why, e.g. ``'auto-tpu'``, ``'backend=cpu'``,
+            ``'gspmd-silenced'``, ``'explicit'``, ``'size'``,
+            ``'default-off'``.
+    """
+    REGISTRY.inc(DISPATCH_COUNTER, kernel=kernel, outcome=outcome,
+                 reason=reason)
+
+
+def dispatch_table():
+    """Dispatch counts as sorted rows of
+    ``{'kernel', 'outcome', 'reason', 'count'}``."""
+    rows = []
+    for rec in REGISTRY.snapshot()['counters']:
+        if rec['name'] != DISPATCH_COUNTER:
+            continue
+        rows.append({**rec['labels'], 'count': rec['value']})
+    return sorted(rows, key=lambda r: (r.get('kernel', ''),
+                                       r.get('outcome', ''),
+                                       r.get('reason', '')))
+
+
+def padding_bucket_table():
+    """Padding-bucket collation counts (``utils.data.pad_pair_batch``):
+    one row per distinct (batch, nodes, edges) padding — more rows means
+    more XLA programs for the consuming step function."""
+    rows = [dict(rec['labels'], count=rec['value'])
+            for rec in REGISTRY.snapshot()['counters']
+            if rec['name'] == 'padding_bucket']
+    return sorted(rows, key=lambda r: -r['count'])
+
+
+# ---------------------------------------------------------------------------
+# Compile events (jax.monitoring)
+# ---------------------------------------------------------------------------
+
+# jax.monitoring has no unregister API, so ONE module-level listener is
+# installed on first use and fans out to the registry + every live watcher.
+_listener_lock = threading.Lock()
+_listener_installed = False
+_watchers = []
+_COMPILE_DURATION_EVENT = '/jax/core/compile/backend_compile_duration'
+_CACHE_HIT_EVENT = '/jax/compilation_cache/cache_hits'
+
+
+def _on_event_duration(event, duration, **kw):
+    if event != _COMPILE_DURATION_EVENT:
+        return
+    REGISTRY.inc('compile_events')
+    REGISTRY.inc('compile_seconds', value=duration)
+    rec = {'time': time.time(), 'kind': 'backend_compile',
+           'duration_s': round(duration, 4)}
+    with _listener_lock:
+        for w in _watchers:
+            w._record(rec)
+
+
+def _on_event(event, **kw):
+    # A persistent-cache hit skips backend_compile but still builds a new
+    # executable — count it as a compile event so the churn signal does
+    # not vanish when the on-disk cache is warm.
+    if event != _CACHE_HIT_EVENT:
+        return
+    REGISTRY.inc('compile_events')
+    REGISTRY.inc('compile_cache_hits')
+    rec = {'time': time.time(), 'kind': 'cache_hit', 'duration_s': 0.0}
+    with _listener_lock:
+        for w in _watchers:
+            w._record(rec)
+
+
+def _ensure_listener():
+    global _listener_installed
+    with _listener_lock:
+        if _listener_installed:
+            return
+        import jax
+        jax.monitoring.register_event_duration_secs_listener(
+            _on_event_duration)
+        jax.monitoring.register_event_listener(_on_event)
+        _listener_installed = True
+
+
+def compile_event_count():
+    """Process-lifetime compile-event count (compiles + cache hits) seen
+    since the first watcher/observer was installed."""
+    return REGISTRY.total('compile_events')
+
+
+class CompileWatcher:
+    """Scoped view over compile events, with optional phase labels.
+
+    ``jax.monitoring`` reports compile durations without attribution, so a
+    watcher lets the caller bracket regions (``with w.label('phase2')``)
+    and attributes every event inside the bracket to that label — the
+    per-step-function attribution the events themselves lack.
+
+    Use as a context manager; events are collected between ``__enter__``
+    and ``close()``/``__exit__`` (the module listener stays installed —
+    there is no unregister API — but a closed watcher stops recording).
+    """
+
+    def __init__(self):
+        self._events = []
+        self._label = 'run'
+        self._open = False
+
+    # -- listener callback (under _listener_lock) --
+    def _record(self, rec):
+        if self._open:
+            self._events.append(dict(rec, label=self._label))
+
+    def __enter__(self):
+        _ensure_listener()
+        with _listener_lock:
+            self._open = True
+            _watchers.append(self)
+        return self
+
+    def close(self):
+        with _listener_lock:
+            self._open = False
+            if self in _watchers:
+                _watchers.remove(self)
+
+    def __exit__(self, *exc):
+        self.close()
+
+    @contextlib.contextmanager
+    def label(self, name):
+        """Attribute compile events inside the block to ``name``."""
+        prev, self._label = self._label, name
+        try:
+            yield
+        finally:
+            self._label = prev
+
+    @property
+    def events(self):
+        with _listener_lock:
+            return list(self._events)
+
+    def count(self):
+        return len(self.events)
+
+    def summary(self):
+        """``{'events', 'compile_s', 'cache_hits', 'by_label'}`` for
+        ``timings.json``."""
+        evs = self.events
+        by_label = {}
+        for e in evs:
+            d = by_label.setdefault(e['label'], {'events': 0,
+                                                 'compile_s': 0.0})
+            d['events'] += 1
+            d['compile_s'] = round(d['compile_s'] + e['duration_s'], 4)
+        return {
+            'events': len(evs),
+            'compile_s': round(sum(e['duration_s'] for e in evs), 4),
+            'cache_hits': sum(e['kind'] == 'cache_hit' for e in evs),
+            'by_label': by_label,
+        }

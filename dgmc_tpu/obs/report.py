@@ -1,0 +1,240 @@
+"""Run-report CLI: render ``--obs-dir`` telemetry as a table + JSON.
+
+Usage::
+
+    python -m dgmc_tpu.obs.report <obs_dir>            # human table
+    python -m dgmc_tpu.obs.report <obs_dir> --json     # summary JSON only
+    python -m dgmc_tpu.obs.report run1/ run2/          # several runs
+    python -m dgmc_tpu.obs.report metrics.jsonl        # bare metric files
+
+The table shows throughput, step-time percentiles, recompile counts and
+time, HBM (or host-RSS) peaks, and the kernel-dispatch outcome table. The
+``--json`` form emits one machine-readable summary object per input (a
+JSON list when given several) — what CI asserts on.
+
+This module deliberately has **no jax import**: it must render telemetry
+from a dead run on any box.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+
+def _read_json(path):
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def _read_jsonl(path):
+    recs = []
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    recs.append(json.loads(line))
+                except ValueError:
+                    recs.append({'_unparsed': line[:200]})
+    except OSError:
+        pass
+    return recs
+
+
+def load_run(path):
+    """Load one obs dir (or one bare JSONL file) into a run dict."""
+    if os.path.isdir(path):
+        return {
+            'path': path,
+            'metrics': _read_jsonl(os.path.join(path, 'metrics.jsonl')),
+            'timings': _read_json(os.path.join(path, 'timings.json')),
+            'memory': _read_json(os.path.join(path, 'memory.json')),
+            'dispatch': _read_json(os.path.join(path, 'dispatch.json')),
+        }
+    return {'path': path, 'metrics': _read_jsonl(path), 'timings': None,
+            'memory': None, 'dispatch': None}
+
+
+def peak_memory(memory):
+    """(bytes, source) — the maximum device allocator peak across all
+    snapshots, else the host RSS high-water mark."""
+    if not memory:
+        return None, None
+    dev_peak = host_peak = 0
+    for snap in memory.get('snapshots', []):
+        for d in snap.get('devices', []):
+            dev_peak = max(dev_peak, d.get('peak_bytes_in_use', 0),
+                           d.get('bytes_in_use', 0))
+        host_peak = max(host_peak,
+                        snap.get('host', {}).get('peak_rss_bytes', 0),
+                        snap.get('host', {}).get('rss_bytes', 0))
+    if dev_peak:
+        return dev_peak, 'device'
+    if host_peak:
+        return host_peak, 'host'
+    return None, None
+
+
+def summarize(run):
+    """One machine-readable summary object for a loaded run."""
+    out = {'path': run['path'],
+           'metrics_records': len(run['metrics'] or [])}
+    if run['metrics']:
+        last = run['metrics'][-1]
+        out['last_metrics'] = {k: v for k, v in last.items()
+                               if k != '_unparsed'}
+    t = run['timings'] or {}
+    steps = t.get('steps') or {}
+    if steps:
+        out['steps'] = steps.get('steps')
+        out['step_mean_s'] = round(steps.get('mean_s', 0.0), 6)
+        out['step_p50_s'] = round(steps.get('p50_s', 0.0), 6)
+        out['step_p95_s'] = round(steps.get('p95_s', 0.0), 6)
+        out['step_max_s'] = round(steps.get('max_s', 0.0), 6)
+        if steps.get('mean_s'):
+            out['steps_per_sec'] = round(1.0 / steps['mean_s'], 3)
+    if t.get('wall_s') is not None:
+        out['wall_s'] = t['wall_s']
+    comp = t.get('compile') or {}
+    out['compile_events'] = comp.get('events', 0)
+    out['compile_s'] = comp.get('compile_s', 0.0)
+    if comp.get('by_label'):
+        out['compile_by_label'] = comp['by_label']
+    buckets = t.get('padding_buckets') or []
+    if buckets:
+        out['padding_buckets'] = len(buckets)
+        out['padding_bucket_rows'] = buckets
+
+    peak, source = peak_memory(run['memory'])
+    if peak is not None:
+        out['peak_memory_bytes'] = peak
+        out['peak_memory_gib'] = round(peak / 2 ** 30, 3)
+        out['peak_memory_source'] = source
+
+    rows = (run['dispatch'] or {}).get('counts', [])
+    if rows:
+        out['dispatch'] = rows
+        out['dispatch_pallas'] = sum(r['count'] for r in rows
+                                     if r.get('outcome') == 'pallas')
+        out['dispatch_fallback'] = sum(r['count'] for r in rows
+                                       if r.get('outcome') == 'fallback')
+    return out
+
+
+def _fmt_bytes(n):
+    if n is None:
+        return '-'
+    for unit in ('B', 'KiB', 'MiB', 'GiB', 'TiB'):
+        if n < 1024 or unit == 'TiB':
+            return f'{n:.2f} {unit}' if unit != 'B' else f'{n} B'
+        n /= 1024
+
+
+def _fmt_s(v):
+    if v is None:
+        return '-'
+    if v >= 1.0:
+        return f'{v:.3f} s'
+    return f'{v * 1e3:.2f} ms'
+
+
+def render(run):
+    """Human-readable report for one loaded run."""
+    s = summarize(run)
+    lines = [f'== run report: {run["path"]} ==']
+
+    steps = s.get('steps')
+    lines.append('-- step timing --')
+    if steps:
+        lines.append(f'  steps            {steps}')
+        lines.append(f'  throughput       '
+                     f'{s.get("steps_per_sec", "-")} steps/s')
+        lines.append(f'  mean / p50 / p95 / max   '
+                     f'{_fmt_s(s["step_mean_s"])} / '
+                     f'{_fmt_s(s["step_p50_s"])} / '
+                     f'{_fmt_s(s["step_p95_s"])} / '
+                     f'{_fmt_s(s["step_max_s"])}')
+    else:
+        lines.append('  (no step timings recorded)')
+    if 'wall_s' in s:
+        lines.append(f'  run wall-clock   {_fmt_s(s["wall_s"])}')
+
+    lines.append('-- compiles --')
+    lines.append(f'  compile events   {s["compile_events"]}'
+                 f'   (total {_fmt_s(s["compile_s"])})')
+    for label, d in (s.get('compile_by_label') or {}).items():
+        lines.append(f'    {label:<16} {d["events"]} events, '
+                     f'{_fmt_s(d["compile_s"])}')
+    if s.get('padding_buckets'):
+        lines.append(f'  padding buckets  {s["padding_buckets"]} distinct')
+        for b in s['padding_bucket_rows'][:5]:
+            lines.append(f'    batch={b.get("batch")} '
+                         f'nodes={b.get("nodes")} edges={b.get("edges")} '
+                         f'x{b.get("count")}')
+
+    lines.append('-- memory --')
+    if 'peak_memory_bytes' in s:
+        lines.append(f'  peak ({s["peak_memory_source"]})    '
+                     f'{_fmt_bytes(s["peak_memory_bytes"])}')
+    else:
+        lines.append('  (no memory snapshots recorded)')
+
+    lines.append('-- kernel dispatch --')
+    rows = s.get('dispatch', [])
+    if rows:
+        lines.append(f'  {"kernel":<20} {"outcome":<10} {"reason":<18} '
+                     f'{"count":>6}')
+        for r in rows:
+            lines.append(f'  {r.get("kernel", "?"):<20} '
+                         f'{r.get("outcome", "?"):<10} '
+                         f'{r.get("reason", "?"):<18} '
+                         f'{r.get("count", 0):>6}')
+        lines.append(f'  pallas taken: {s.get("dispatch_pallas", 0)}   '
+                     f'fallback: {s.get("dispatch_fallback", 0)}')
+    else:
+        lines.append('  (no dispatch decisions recorded)')
+
+    lines.append('-- metrics --')
+    lines.append(f'  records          {s["metrics_records"]}')
+    if s.get('last_metrics'):
+        lines.append(f'  last             '
+                     f'{json.dumps(s["last_metrics"], sort_keys=True)}')
+    return '\n'.join(lines)
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        prog='python -m dgmc_tpu.obs.report',
+        description='Render --obs-dir telemetry (or bare metric JSONL '
+                    'files) as a report.')
+    parser.add_argument('paths', nargs='+',
+                        help='obs directories or metrics JSONL files')
+    parser.add_argument('--json', action='store_true',
+                        help='print only the machine-readable summary')
+    args = parser.parse_args(argv)
+
+    runs = []
+    for p in args.paths:
+        if not os.path.exists(p):
+            print(f'report: no such path: {p}', file=sys.stderr)
+            return 2
+        runs.append(load_run(p))
+
+    if args.json:
+        summaries = [summarize(r) for r in runs]
+        print(json.dumps(summaries[0] if len(summaries) == 1
+                         else summaries, indent=1))
+    else:
+        for r in runs:
+            print(render(r))
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

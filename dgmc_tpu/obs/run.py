@@ -1,0 +1,179 @@
+"""The ``--obs-dir`` facade: one flag, four artifacts.
+
+:class:`RunObserver` bundles the metric sink, the step timer, the compile
+watcher, memory snapshots and the dispatch-counter snapshot behind a
+single directory:
+
+- ``metrics.jsonl`` — one record per :meth:`RunObserver.log` call.
+- ``timings.json``  — step-time percentiles + compile-event summary +
+  run wall-clock.
+- ``memory.json``   — labelled device/host memory snapshots + the peak.
+- ``dispatch.json`` — the kernel-dispatch outcome table.
+
+Every method is a no-op when constructed with a falsy directory, so CLIs
+call the observer unconditionally::
+
+    obs = RunObserver(args.obs_dir)      # None => disabled
+    with obs:
+        for batch in loader:
+            with obs.step():
+                state, out = step(state, batch, key)
+        obs.log(epoch, loss=loss)
+        obs.snapshot_memory(f'epoch{epoch}')
+
+Artifacts are rewritten on every :meth:`flush` (each ``log`` /
+``snapshot_memory`` flushes), so a run killed by a timeout still leaves
+analyzable telemetry on disk — the failure mode ``BENCH_r05.json``
+(``rc: 124``, no evidence) exposed.
+"""
+
+import contextlib
+import json
+import os
+import sys
+import time
+
+from dgmc_tpu.obs.memory import memory_snapshot
+from dgmc_tpu.obs.observe import MetricLogger, StepTimer
+from dgmc_tpu.obs.registry import (CompileWatcher, dispatch_table,
+                                   padding_bucket_table)
+
+
+def add_obs_flag(parser):
+    """Register the standard ``--obs-dir`` flag on an argparse parser."""
+    parser.add_argument(
+        '--obs-dir', '--obs_dir', dest='obs_dir', type=str, default=None,
+        help='write run telemetry (metrics.jsonl, timings.json, '
+             'memory.json, dispatch.json) into this directory; render it '
+             'with `python -m dgmc_tpu.obs.report <dir>`')
+    return parser
+
+
+class RunObserver:
+    """Facade collecting one run's telemetry into ``obs_dir``."""
+
+    def __init__(self, obs_dir):
+        self.dir = obs_dir
+        self.enabled = bool(obs_dir)
+        self.timer = StepTimer()
+        self._t_start = time.time()
+        self._snapshots = []
+        self._watcher = None
+        # mode='w': an obs dir describes ONE run — a reused --obs-dir must
+        # not append a second run's metrics to artifacts the observer
+        # rewrites from scratch.
+        self._metrics = MetricLogger(
+            os.path.join(obs_dir, 'metrics.jsonl') if self.enabled else None,
+            mode='w')
+        if self.enabled:
+            os.makedirs(obs_dir, exist_ok=True)
+            # Registry counters are process-lifetime; baseline them here so
+            # the artifacts attribute only THIS run's activity (the same
+            # scoping CompileWatcher gives compile events).
+            self._dispatch_base = self._count_index(dispatch_table())
+            self._buckets_base = self._count_index(padding_bucket_table())
+            self._watcher = CompileWatcher().__enter__()
+            self.snapshot_memory('start')
+
+    # -- collection --------------------------------------------------------
+
+    @contextlib.contextmanager
+    def step(self, fence=None):
+        """Time one training/eval step (host-observed; pass ``fence`` a
+        device scalar to time actual execution)."""
+        if not self.enabled:
+            yield
+            return
+        self.timer.start()
+        try:
+            yield
+        finally:
+            self.timer.stop(fence=fence)
+
+    def log(self, step, **metrics):
+        """Append one record to ``metrics.jsonl`` and refresh the derived
+        artifacts."""
+        if not self.enabled:
+            return
+        self._metrics.log(step, **metrics)
+        self.flush()
+
+    @contextlib.contextmanager
+    def compile_label(self, name):
+        """Attribute compile events inside the block to ``name`` in
+        ``timings.json``'s ``by_label`` breakdown."""
+        if not self.enabled:
+            yield
+            return
+        with self._watcher.label(name):
+            yield
+
+    def snapshot_memory(self, tag=''):
+        """Record a labelled device/host memory snapshot."""
+        if not self.enabled:
+            return None
+        snap = memory_snapshot(tag)
+        self._snapshots.append(snap)
+        self.flush()
+        return snap
+
+    # -- artifacts ---------------------------------------------------------
+
+    @staticmethod
+    def _count_index(rows):
+        return {tuple(sorted((k, v) for k, v in r.items() if k != 'count')):
+                r['count'] for r in rows}
+
+    @staticmethod
+    def _since(rows, base):
+        """Rows with the baseline counts subtracted (drop zero rows)."""
+        out = []
+        for r in rows:
+            key = tuple(sorted((k, v) for k, v in r.items()
+                               if k != 'count'))
+            delta = r['count'] - base.get(key, 0)
+            if delta > 0:
+                out.append(dict(r, count=delta))
+        return out
+
+    def _write(self, name, payload):
+        path = os.path.join(self.dir, name)
+        tmp = path + '.tmp'
+        with open(tmp, 'w') as f:
+            json.dump(payload, f, indent=1)
+        os.replace(tmp, path)
+
+    def timings(self):
+        return {
+            'wall_s': round(time.time() - self._t_start, 3),
+            'argv': sys.argv,
+            'steps': self.timer.summary(),
+            'compile': self._watcher.summary() if self._watcher else {},
+            'padding_buckets': self._since(padding_bucket_table(),
+                                           self._buckets_base),
+        }
+
+    def flush(self):
+        """Rewrite ``timings.json`` / ``memory.json`` / ``dispatch.json``
+        from current state (atomic per file)."""
+        if not self.enabled:
+            return
+        self._write('timings.json', self.timings())
+        self._write('memory.json', {'snapshots': self._snapshots})
+        self._write('dispatch.json', {'counts': self._since(
+            dispatch_table(), self._dispatch_base)})
+
+    def close(self):
+        if not self.enabled:
+            return
+        self.snapshot_memory('end')
+        self.flush()
+        self._metrics.close()
+        self._watcher.close()
+        self.enabled = False
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

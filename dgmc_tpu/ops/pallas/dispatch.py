@@ -8,12 +8,19 @@ module — so the orchestrator (:class:`~dgmc_tpu.models.DGMC`) wraps its
 partitioned region in :func:`disable_fused_kernels`, and each auto gate
 consults :func:`fused_kernels_allowed`. Explicitly requested kernels
 (``fused=True``) are not silenced — DGMC rejects those loudly instead.
+
+Every decision site reports its outcome through :func:`record_dispatch`
+(pallas-taken vs XLA-fallback, with reason — including
+``gspmd-silenced``), so a run's ``dispatch.json`` shows which kernels a
+program actually used instead of leaving it to inference from timings.
 """
 
 import contextlib
 import contextvars
 
 import jax
+
+from dgmc_tpu.obs.registry import record_dispatch  # noqa: F401  (re-export)
 
 _fused_ok = contextvars.ContextVar('dgmc_tpu_fused_kernels_ok',
                                    default=True)
@@ -27,6 +34,17 @@ _embedded_ok = contextvars.ContextVar('dgmc_tpu_embedded_kernels_ok',
                                       default=True)
 
 
+def vma_of(x):
+    """Varying-manual-axes set of ``x`` — empty outside ``shard_map``
+    manual mode, and always empty on JAX versions predating the vma type
+    system (where manual-mode Pallas embedding is unavailable anyway)."""
+    try:
+        t = jax.typeof(x)
+    except AttributeError:
+        return frozenset()
+    return frozenset(getattr(t, 'vma', ()))
+
+
 def vma_union(*arrays):
     """Union of the varying-manual-axes sets of ``arrays`` — empty outside
     ``shard_map`` manual mode. Pallas kernels are shard-local, so they run
@@ -34,7 +52,7 @@ def vma_union(*arrays):
     (b) the ``out_shape`` declares it; see :func:`promote_vma`."""
     out = frozenset()
     for a in arrays:
-        out |= frozenset(jax.typeof(a).vma)
+        out |= vma_of(a)
     return out
 
 
@@ -61,6 +79,24 @@ def disable_fused_kernels():
 
 def fused_kernels_allowed():
     return _fused_ok.get()
+
+
+def auto_fused(kernel, size_ok=True, size_reason='size'):
+    """Resolve one auto kernel gate (TPU backend, not GSPMD-silenced,
+    size/shape constraints satisfied) and record the outcome + reason in
+    the telemetry registry. Call sites that honor an *explicit* user
+    setting record it themselves with reason ``'explicit'``.
+    """
+    if not fused_kernels_allowed():
+        take, reason = False, 'gspmd-silenced'
+    elif jax.default_backend() != 'tpu':
+        take, reason = False, f'backend={jax.default_backend()}'
+    elif not size_ok:
+        take, reason = False, size_reason
+    else:
+        take, reason = True, 'auto-tpu'
+    record_dispatch(kernel, 'pallas' if take else 'fallback', reason)
+    return take
 
 
 @contextlib.contextmanager

@@ -44,7 +44,7 @@ def dense_topk(h_s, h_t, k, t_mask=None):
 
 
 def chunked_topk(h_s, h_t, k, t_mask=None, block=256, return_values=False,
-                 pallas=None):
+                 pallas=None, dispatch_reason='explicit'):
     """Blockwise running top-k of ``h_s @ h_t^T`` along the target axis.
 
     Produces indices identical to :func:`dense_topk` (including tie order)
@@ -79,10 +79,21 @@ def chunked_topk(h_s, h_t, k, t_mask=None, block=256, return_values=False,
     nested ``jax.jit`` cache would otherwise bake into a cached jaxpr and
     never consult again.
     """
+    from dgmc_tpu.ops.pallas import dispatch
+    from dgmc_tpu.ops.pallas.topk import BLOCK_T
     if pallas is None:
-        from dgmc_tpu.ops.pallas import dispatch
-        pallas = (dispatch.fused_kernels_allowed()
-                  and jax.default_backend() == 'tpu')
+        pallas = dispatch.auto_fused('topk', size_ok=k <= BLOCK_T,
+                                     size_reason=f'k>{BLOCK_T}')
+    else:
+        # The kernel itself still requires k <= BLOCK_T (the jitted body
+        # silently falls back otherwise) — record what actually runs.
+        # ``dispatch_reason`` lets an orchestrator that forces the path
+        # label WHY (DGMC passes 'gspmd-silenced' under corr_sharding);
+        # a plain user-passed flag stays 'explicit'.
+        taken = bool(pallas) and k <= BLOCK_T
+        dispatch.record_dispatch(
+            'topk', 'pallas' if taken else 'fallback',
+            dispatch_reason if taken == bool(pallas) else f'k>{BLOCK_T}')
     return _chunked_topk(h_s, h_t, k, t_mask, block, return_values,
                          bool(pallas))
 
@@ -119,7 +130,8 @@ def _chunked_topk(h_s, h_t, k, t_mask, block, return_values, pallas):
     init_idx = jnp.zeros((B, N_s, k), dtype=jnp.int32)
     # Under shard_map the scan body output varies over the manual mesh axes
     # of h_s; the constant init carry must carry the same varying type.
-    vma = tuple(jax.typeof(h_s).vma)
+    from dgmc_tpu.ops.pallas.dispatch import vma_of
+    vma = tuple(vma_of(h_s))
     if vma:
         init_vals = jax.lax.pcast(init_vals, vma, to='varying')
         init_idx = jax.lax.pcast(init_idx, vma, to='varying')

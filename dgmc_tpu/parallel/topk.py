@@ -94,9 +94,15 @@ def corr_sharded_topk(sharding, h_s, h_t, k, t_mask, block=256):
     # kernel supports — so that contextvar is deliberately ignored. The
     # dedicated disable_embedded_kernels() switch remains as the escape
     # hatch if the shard_map Pallas path misbehaves on some topology.
-    from dgmc_tpu.ops.pallas.dispatch import embedded_kernels_allowed
+    from dgmc_tpu.ops.pallas.dispatch import (embedded_kernels_allowed,
+                                              record_dispatch)
     use_kernel = (jax.default_backend() == 'tpu'
                   and embedded_kernels_allowed())
+    record_dispatch(
+        'topk_embedded', 'pallas' if use_kernel else 'fallback',
+        'auto-tpu' if use_kernel
+        else ('embedded-disabled' if jax.default_backend() == 'tpu'
+              else f'backend={jax.default_backend()}'))
 
     @functools.partial(
         jax.shard_map, mesh=mesh,

@@ -4,7 +4,11 @@ from dgmc_tpu.train.steps import (make_train_step, make_eval_step,
                                   aggregate_eval)
 from dgmc_tpu.train.checkpoint import (Checkpointer, resume_or_init,
                                        snapshot_params, restore_params)
-from dgmc_tpu.train.observe import MetricLogger, StepTimer, trace
+# Deprecated aliases: the observability layer moved to dgmc_tpu.obs
+# (which adds the registry, RunObserver and the report CLI); these names
+# stay importable so existing experiment code and runs/ tooling keep
+# working.
+from dgmc_tpu.obs import MetricLogger, StepTimer, trace
 
 __all__ = [
     'TrainState',

@@ -1,106 +1,14 @@
-"""Observability: profiler traces, per-step timing, metric logging.
+"""Deprecated alias of :mod:`dgmc_tpu.obs.observe`.
 
-The reference has no tracing, timing, or metric sink of any kind — training
-progress is bare ``print()`` lines (SURVEY.md §5: reference
-``examples/dbp15k.py:75-76``, ``examples/pascal.py:109-110``). Here these
-are first-class:
-
-- :func:`trace` — a ``jax.profiler`` trace of a step window, viewable in
-  TensorBoard/Perfetto, for finding MXU idle time and HBM stalls.
-- :class:`StepTimer` — wall-clock per-step timing with a device fence, so
-  the numbers measure execution rather than dispatch.
-- :class:`MetricLogger` — JSONL metric sink alongside (not replacing) the
-  reference-parity stdout prints.
+The observability primitives grew into a subsystem of their own
+(``dgmc_tpu/obs/``: telemetry registry, ``RunObserver``/``--obs-dir``
+artifacts, report CLI). This module remains so existing experiment code
+and ``runs/`` tooling importing ``dgmc_tpu.train.{trace, StepTimer,
+MetricLogger}`` keep working; new code should import from
+:mod:`dgmc_tpu.obs`.
 """
 
-import contextlib
-import json
-import os
-import time
+from dgmc_tpu.obs.observe import (MetricLogger, StepTimer,  # noqa: F401
+                                  trace)
 
-import jax
-
-
-@contextlib.contextmanager
-def trace(log_dir):
-    """Profile the enclosed steps into ``log_dir`` (no-op if ``log_dir`` is
-    falsy). The trace captures XLA device activity on the real TPU and
-    host-side dispatch everywhere."""
-    if not log_dir:
-        yield
-        return
-    os.makedirs(log_dir, exist_ok=True)
-    with jax.profiler.trace(log_dir):
-        yield
-
-
-class StepTimer:
-    """Accumulates fenced per-step wall-clock times.
-
-    ``fence`` should be a device scalar from the step's outputs (e.g. the
-    loss); fetching it to host guarantees the step actually finished before
-    the clock stops.
-    """
-
-    def __init__(self):
-        self.times = []
-        self._t0 = None
-
-    def start(self):
-        self._t0 = time.perf_counter()
-
-    def stop(self, fence=None):
-        if fence is not None:
-            float(fence)
-        self.times.append(time.perf_counter() - self._t0)
-        return self.times[-1]
-
-    @property
-    def mean(self):
-        return sum(self.times) / max(len(self.times), 1)
-
-    def summary(self):
-        if not self.times:
-            return {}
-        ts = sorted(self.times)
-        return {
-            'steps': len(ts),
-            'mean_s': self.mean,
-            'p50_s': ts[len(ts) // 2],
-            'max_s': ts[-1],
-        }
-
-
-class MetricLogger:
-    """Append-only JSONL metric sink (one object per ``log`` call).
-
-    Cheap enough to leave on: one ``json.dumps`` + buffered write per step.
-    Pass ``path=None`` to disable (all calls become no-ops).
-    """
-
-    def __init__(self, path):
-        self.path = path
-        self._fh = None
-        if path:
-            os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
-            self._fh = open(path, 'a')
-
-    def log(self, step, **metrics):
-        if self._fh is None:
-            return
-        rec = {'step': step, 'time': time.time()}
-        for k, v in metrics.items():
-            rec[k] = float(v) if hasattr(v, '__float__') else v
-        self._fh.write(json.dumps(rec) + '\n')
-        self._fh.flush()
-
-    def close(self):
-        if self._fh is not None:
-            self._fh.close()
-            self._fh = None
-
-    def __enter__(self):
-        return self
-
-    def __exit__(self, *exc):
-        self.close()
+__all__ = ['MetricLogger', 'StepTimer', 'trace']

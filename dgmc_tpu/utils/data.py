@@ -237,6 +237,14 @@ def pad_pair_batch(pairs: List[GraphPair], num_nodes_s, num_edges_s,
     """Collate :class:`GraphPair` lists into a :class:`PairBatch`."""
     num_nodes_t = num_nodes_t or num_nodes_s
     num_edges_t = num_edges_t or num_edges_s
+    # Telemetry: every distinct padding bucket is a distinct XLA program
+    # for whatever jitted step consumes the batch — recording the bucket
+    # per collation makes recompile churn from unstable padding visible
+    # next to the compile-event counter (obs.report renders both).
+    from dgmc_tpu.obs.registry import REGISTRY
+    REGISTRY.inc('padding_bucket', batch=len(pairs),
+                 nodes=f'{num_nodes_s}x{num_nodes_t}',
+                 edges=f'{num_edges_s}x{num_edges_t}')
     g_s = pad_graphs([p.s for p in pairs], num_nodes_s, num_edges_s,
                      native=native)
     g_t = pad_graphs([p.t for p in pairs], num_nodes_t, num_edges_t,

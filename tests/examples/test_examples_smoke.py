@@ -54,16 +54,31 @@ def willow_root(tmp_path):
     return tmp_path
 
 
-def test_pascal_pf_runs(capsys):
+def test_pascal_pf_runs(capsys, tmp_path):
     from examples import pascal_pf
+    obs_dir = str(tmp_path / 'obs')
     state = pascal_pf.main([
         '--epochs', '1', '--batch_size', '8', '--dim', '16',
         '--rnd_dim', '8', '--num_steps', '1', '--synthetic_eval', '8',
-        '--data_root', '/nonexistent'])
+        '--data_root', '/nonexistent', '--obs-dir', obs_dir])
     assert state is not None
     # The held-out synthetic eval (the offline stand-in for the real
     # PascalPF zero-shot eval) must have run and printed a number.
     assert 'Held-out synthetic:' in capsys.readouterr().out
+
+    # --obs-dir produced all four telemetry artifacts, and the report
+    # summary carries step percentiles, a compile count, a memory peak
+    # and the CPU-forced kernel fallbacks (ISSUE acceptance contract).
+    import os
+    for name in ('metrics.jsonl', 'timings.json', 'memory.json',
+                 'dispatch.json'):
+        assert os.path.exists(os.path.join(obs_dir, name)), name
+    from dgmc_tpu.obs import report
+    s = report.summarize(report.load_run(obs_dir))
+    assert s['steps'] > 0 and s['step_p50_s'] > 0
+    assert s['compile_events'] >= 1
+    assert s['peak_memory_bytes'] > 0
+    assert s['dispatch_fallback'] >= 1
 
 
 def test_dbp15k_runs(dbp_root):

@@ -1,0 +1,67 @@
+"""bench.py timeout diagnosability: the SIGTERM/SIGALRM path emits a
+partial JSON line with per-section progress instead of dying silently
+(the BENCH_r05 ``rc: 124, parsed: null`` failure mode)."""
+
+import json
+import os
+import signal
+import time
+
+import pytest
+
+
+@pytest.fixture
+def bench_mod():
+    import bench
+    saved = dict(bench._PROGRESS)
+    bench._PROGRESS.update(sections={}, current=None, current_t0=None,
+                           start=time.time())
+    yield bench
+    bench._PROGRESS.clear()
+    bench._PROGRESS.update(saved)
+
+
+def test_sections_record_success_and_failure(bench_mod):
+    with bench_mod._section('good'):
+        pass
+    with pytest.raises(RuntimeError):
+        with bench_mod._section('bad'):
+            raise RuntimeError('boom')
+    secs = bench_mod._PROGRESS['sections']
+    assert secs['good']['ok'] is True
+    assert secs['bad']['ok'] is False and 'boom' in secs['bad']['error']
+    assert bench_mod._PROGRESS['current'] is None
+
+
+def test_partial_line_on_sigterm(bench_mod, monkeypatch, capsys):
+    exit_codes = []
+    monkeypatch.setattr(os, '_exit', lambda code: exit_codes.append(code))
+    with bench_mod._section('sparse_f32'):
+        pass
+    # Simulate the signal landing mid-section.
+    bench_mod._PROGRESS['current'] = 'dense_f32'
+    bench_mod._PROGRESS['current_t0'] = time.perf_counter()
+    bench_mod._emit_partial(signal.SIGTERM, None)
+
+    assert exit_codes == [124]
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rec['partial'] is True
+    assert rec['signal'] == 'SIGTERM'
+    assert rec['value'] is None
+    assert rec['sections']['sparse_f32']['ok'] is True
+    assert rec['current']['name'] == 'dense_f32'
+    assert rec['current']['elapsed_s'] >= 0
+
+
+def test_obs_section_logging(bench_mod, tmp_path, monkeypatch):
+    """With --obs-dir, each finished section lands in metrics.jsonl and
+    flushes the artifacts."""
+    from dgmc_tpu.obs import RunObserver
+    obs = RunObserver(str(tmp_path / 'obs'))
+    monkeypatch.setattr(bench_mod, '_OBS', obs)
+    with bench_mod._section('topk_scan'):
+        pass
+    obs.close()
+    recs = [json.loads(ln) for ln in
+            (tmp_path / 'obs' / 'metrics.jsonl').read_text().splitlines()]
+    assert recs and recs[0]['step'] == 'topk_scan' and recs[0]['ok'] is True

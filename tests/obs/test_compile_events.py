@@ -1,0 +1,56 @@
+"""Compile-event telemetry: one event per new program shape, none for
+cache-hit repeats — recompile churn from unstable padding buckets becomes
+a visible counter."""
+
+import jax
+import jax.numpy as jnp
+
+from dgmc_tpu.obs import CompileWatcher
+
+
+def test_one_compile_per_padding_bucket():
+    # Materialize both "padding buckets" BEFORE the watcher opens so the
+    # array-creation programs don't pollute the step-function counts.
+    small = jax.block_until_ready(jnp.ones((4, 4)))
+    large = jax.block_until_ready(jnp.ones((8, 8)))
+
+    def step(a):
+        return (a * 2.0).sum()
+
+    step = jax.jit(step)
+    with CompileWatcher() as w:
+        jax.block_until_ready(step(small))
+        first = w.count()
+        assert first == 1   # exactly one compile for the first shape
+
+        for _ in range(3):  # same shape: served from the jit cache
+            jax.block_until_ready(step(small))
+        assert w.count() == first
+
+        jax.block_until_ready(step(large))   # new padding bucket
+        assert w.count() == first + 1
+
+
+def test_labels_attribute_compiles():
+    x = jax.block_until_ready(jnp.ones((5, 5)))
+    y = jax.block_until_ready(jnp.ones((6, 6)))
+    f = jax.jit(lambda a: a.sum() * 3.0)
+    with CompileWatcher() as w:
+        with w.label('phase1'):
+            jax.block_until_ready(f(x))
+        with w.label('phase2'):
+            jax.block_until_ready(f(y))
+    s = w.summary()
+    assert s['events'] == 2
+    assert s['by_label']['phase1']['events'] == 1
+    assert s['by_label']['phase2']['events'] == 1
+    assert s['compile_s'] >= 0.0
+
+
+def test_closed_watcher_stops_recording():
+    x = jax.block_until_ready(jnp.ones((7, 3)))
+    f = jax.jit(lambda a: (a + 1.0).sum())
+    w = CompileWatcher().__enter__()
+    w.close()
+    jax.block_until_ready(f(x))
+    assert w.count() == 0

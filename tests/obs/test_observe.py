@@ -1,0 +1,52 @@
+"""StepTimer contract: misuse raises clearly, percentiles interpolate."""
+
+import pytest
+
+from dgmc_tpu.obs import StepTimer
+from dgmc_tpu.obs.observe import percentile
+
+
+def test_stop_without_start_raises():
+    t = StepTimer()
+    with pytest.raises(RuntimeError, match='start'):
+        t.stop()
+
+
+def test_double_stop_raises():
+    t = StepTimer()
+    t.start()
+    t.stop()
+    with pytest.raises(RuntimeError, match='start'):
+        t.stop()
+
+
+def test_p50_interpolates_even_windows():
+    t = StepTimer()
+    t.times = [0.1, 0.2, 0.3, 0.4]
+    s = t.summary()
+    assert s['p50_s'] == pytest.approx(0.25)   # mean of the middle pair
+    assert s['p95_s'] == pytest.approx(0.1 + 0.95 * 0.3)
+    assert s['max_s'] == pytest.approx(0.4)
+    assert s['total_s'] == pytest.approx(1.0)
+
+
+def test_p50_odd_window_is_exact_middle():
+    t = StepTimer()
+    t.times = [0.3, 0.1, 0.2]
+    assert t.summary()['p50_s'] == pytest.approx(0.2)
+
+
+def test_percentile_bounds():
+    ts = [1.0, 2.0, 3.0]
+    assert percentile(ts, 0.0) == 1.0
+    assert percentile(ts, 1.0) == 3.0
+    with pytest.raises(ValueError):
+        percentile([], 0.5)
+
+
+def test_fence_forces_value():
+    import jax.numpy as jnp
+    t = StepTimer()
+    t.start()
+    dt = t.stop(fence=jnp.ones(()).sum())
+    assert dt > 0 and t.summary()['steps'] == 1

@@ -1,0 +1,133 @@
+"""Telemetry registry: counters/gauges, and kernel-dispatch outcomes
+recorded by the real decision sites (fused vs CPU-forced fallback vs
+GSPMD-silenced)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dgmc_tpu.obs import REGISTRY, dispatch_table, record_dispatch
+from dgmc_tpu.obs.registry import Registry
+from dgmc_tpu.ops.pallas import dispatch
+from dgmc_tpu.ops.topk import chunked_topk
+
+
+@pytest.fixture(autouse=True)
+def clean_registry():
+    REGISTRY.reset()
+    yield
+    REGISTRY.reset()
+
+
+def test_counter_labels_and_totals():
+    r = Registry()
+    r.inc('x', kernel='a')
+    r.inc('x', 2, kernel='a')
+    r.inc('x', kernel='b')
+    assert r.counter_value('x', kernel='a') == 3
+    assert r.total('x') == 4
+    r.gauge('g', 7.5, dev=0)
+    snap = r.snapshot()
+    assert {'name': 'g', 'labels': {'dev': 0}, 'value': 7.5} in snap['gauges']
+    r.reset()
+    assert r.snapshot() == {'counters': [], 'gauges': []}
+
+
+def test_dispatch_fallback_recorded_on_cpu_auto():
+    """The un-jitted auto gate in chunked_topk must record an XLA-fallback
+    decision on the CPU backend (reason names the backend)."""
+    h_s = jnp.asarray(np.random.RandomState(0).randn(1, 8, 4),
+                      jnp.float32)
+    h_t = jnp.asarray(np.random.RandomState(1).randn(1, 10, 4),
+                      jnp.float32)
+    chunked_topk(h_s, h_t, 3)
+    rows = dispatch_table()
+    assert rows == [{'kernel': 'topk', 'outcome': 'fallback',
+                     'reason': 'backend=cpu', 'count': 1}]
+
+
+def test_dispatch_pallas_recorded_when_gate_passes(monkeypatch):
+    """When the auto gate resolves to the fused kernel, a pallas-taken
+    outcome is recorded (backend faked — no kernel actually runs)."""
+    monkeypatch.setattr(dispatch.jax, 'default_backend', lambda: 'tpu')
+    assert dispatch.auto_fused('dense_consensus') is True
+    assert REGISTRY.counter_value(
+        'pallas_dispatch', kernel='dense_consensus', outcome='pallas',
+        reason='auto-tpu') == 1
+
+
+def test_dispatch_gspmd_silenced_recorded(monkeypatch):
+    monkeypatch.setattr(dispatch.jax, 'default_backend', lambda: 'tpu')
+    with dispatch.disable_fused_kernels():
+        assert dispatch.auto_fused('topk') is False
+    assert REGISTRY.counter_value(
+        'pallas_dispatch', kernel='topk', outcome='fallback',
+        reason='gspmd-silenced') == 1
+
+
+def test_dispatch_size_gate_recorded(monkeypatch):
+    monkeypatch.setattr(dispatch.jax, 'default_backend', lambda: 'tpu')
+    assert dispatch.auto_fused('spline_route', size_ok=False,
+                               size_reason='vmem') is False
+    assert REGISTRY.counter_value(
+        'pallas_dispatch', kernel='spline_route', outcome='fallback',
+        reason='vmem') == 1
+
+
+def test_explicit_false_recorded():
+    h = jnp.ones((1, 4, 2))
+    chunked_topk(h, h, 2, pallas=False)
+    assert REGISTRY.counter_value(
+        'pallas_dispatch', kernel='topk', outcome='fallback',
+        reason='explicit') == 1
+
+
+def test_sparse_model_trace_records_both_stages():
+    """Tracing the sparse matcher on CPU records the top-k fallback AND
+    the sparse-consensus default-off fallback in one table."""
+    import jax
+    from dgmc_tpu.models import DGMC, RelCNN
+    from dgmc_tpu.ops.graph import GraphBatch
+
+    rng = np.random.RandomState(0)
+
+    def side(n, e):
+        return GraphBatch(
+            x=rng.randn(1, n, 4).astype(np.float32),
+            senders=rng.randint(0, n, (1, e)).astype(np.int32),
+            receivers=rng.randint(0, n, (1, e)).astype(np.int32),
+            node_mask=np.ones((1, n), bool),
+            edge_mask=np.ones((1, e), bool), edge_attr=None)
+
+    model = DGMC(RelCNN(4, 8, num_layers=1), RelCNN(4, 4, num_layers=1),
+                 num_steps=1, k=2)
+    s, t = side(6, 12), side(8, 16)
+    model.init({'params': jax.random.key(0), 'noise': jax.random.key(1)},
+               s, t)
+    kernels = {r['kernel']: r for r in dispatch_table()}
+    assert kernels['topk']['outcome'] == 'fallback'
+    assert kernels['sparse_consensus']['reason'] == 'default-off'
+
+
+def test_padding_bucket_counter():
+    """Every pad_pair_batch collation records its padding bucket, so
+    recompile churn from unstable padding is visible next to the
+    compile-event counter."""
+    from dgmc_tpu.obs.registry import padding_bucket_table
+    from dgmc_tpu.utils.data import Graph, GraphPair, pad_pair_batch
+
+    g = Graph(edge_index=np.zeros((2, 0), np.int64),
+              x=np.zeros((3, 2), np.float32))
+    pad_pair_batch([GraphPair(s=g, t=g)], 4, 8)
+    pad_pair_batch([GraphPair(s=g, t=g)], 4, 8)
+    pad_pair_batch([GraphPair(s=g, t=g)], 6, 8)   # a second bucket
+    rows = padding_bucket_table()
+    assert len(rows) == 2
+    assert rows[0]['count'] == 2 and rows[0]['nodes'] == '4x4'
+
+
+def test_record_dispatch_direct():
+    record_dispatch('k', 'pallas', 'explicit')
+    record_dispatch('k', 'pallas', 'explicit')
+    assert dispatch_table() == [{'kernel': 'k', 'outcome': 'pallas',
+                                 'reason': 'explicit', 'count': 2}]

@@ -1,0 +1,116 @@
+"""RunObserver → obs-dir artifacts → report CLI round trip."""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from dgmc_tpu.obs import REGISTRY, RunObserver, record_dispatch
+from dgmc_tpu.obs import report
+
+
+@pytest.fixture(autouse=True)
+def clean_registry():
+    REGISTRY.reset()
+    yield
+    REGISTRY.reset()
+
+
+def _make_run(tmp_path):
+    d = str(tmp_path / 'obs')
+    f = jax.jit(lambda a: (a * 2.0).sum())
+    with RunObserver(d) as obs:
+        record_dispatch('topk', 'fallback', 'backend=cpu')
+        for i in range(4):
+            with obs.step():
+                jax.block_until_ready(f(jnp.ones((4, 4)) * i))
+        obs.log(1, loss=0.5, acc=0.25)
+        obs.log(2, loss=0.4, acc=0.5)
+        obs.snapshot_memory('epoch2')
+    return d
+
+
+def test_observer_emits_all_four_artifacts(tmp_path):
+    d = _make_run(tmp_path)
+    for name in ('metrics.jsonl', 'timings.json', 'memory.json',
+                 'dispatch.json'):
+        assert os.path.exists(os.path.join(d, name)), name
+
+
+def test_report_round_trip_summary(tmp_path):
+    d = _make_run(tmp_path)
+    s = report.summarize(report.load_run(d))
+    assert s['steps'] == 4
+    assert s['step_p50_s'] > 0 and s['step_p95_s'] >= s['step_p50_s']
+    assert s['compile_events'] >= 1       # the jitted step compiled
+    assert s['metrics_records'] == 2
+    assert s['last_metrics']['loss'] == 0.4
+    assert s['peak_memory_bytes'] > 0     # host-RSS fallback on CPU
+    assert s['dispatch_fallback'] >= 1
+
+
+def test_report_cli_table_and_json(tmp_path, capsys):
+    d = _make_run(tmp_path)
+    assert report.main([d]) == 0
+    out = capsys.readouterr().out
+    for needle in ('step timing', 'compile events', 'kernel dispatch',
+                   'topk', 'fallback'):
+        assert needle in out, needle
+
+    assert report.main([d, '--json']) == 0
+    s = json.loads(capsys.readouterr().out)
+    assert s['steps'] == 4 and s['compile_events'] >= 1
+
+
+def test_report_reads_bare_jsonl(tmp_path, capsys):
+    p = tmp_path / 'm.jsonl'
+    p.write_text(json.dumps({'step': 1, 'loss': 1.0}) + '\n' +
+                 json.dumps({'step': 2, 'loss': 0.5}) + '\n')
+    assert report.main([str(p), '--json']) == 0
+    s = json.loads(capsys.readouterr().out)
+    assert s['metrics_records'] == 2
+    assert s['last_metrics']['loss'] == 0.5
+
+
+def test_report_missing_path_errors(capsys):
+    assert report.main(['/nonexistent/obs']) == 2
+
+
+def test_disabled_observer_is_noop(tmp_path):
+    obs = RunObserver(None)
+    with obs.step():
+        pass
+    obs.log(1, loss=0.1)
+    obs.snapshot_memory('x')
+    with obs.compile_label('y'):
+        pass
+    obs.close()
+    assert not any(tmp_path.iterdir())
+
+
+def test_obs_dir_reuse_holds_one_run(tmp_path):
+    """Re-running with the same --obs-dir must not append a second run's
+    metrics to artifacts the observer rewrites from scratch."""
+    d = _make_run(tmp_path)
+    first = report.summarize(report.load_run(d))
+    d2 = _make_run(tmp_path)   # same directory, second run
+    assert d2 == d
+    s = report.summarize(report.load_run(d))
+    assert s['metrics_records'] == first['metrics_records']
+    assert s['steps'] == first['steps']
+
+
+def test_artifacts_survive_midrun(tmp_path):
+    """Artifacts are rewritten on every log/snapshot, so a killed run
+    still leaves analyzable telemetry (the BENCH_r05 failure mode)."""
+    d = str(tmp_path / 'obs')
+    obs = RunObserver(d)
+    with obs.step():
+        pass
+    obs.log(1, loss=1.0)
+    # No close(): simulate a SIGKILL here.
+    data = json.load(open(os.path.join(d, 'timings.json')))
+    assert data['steps']['steps'] == 1
+    obs.close()
