@@ -125,6 +125,7 @@ def _section(name):
     sections finished and where time went."""
     # t0 before name: a signal between the two assignments must never see
     # current set with current_t0 still None (the handler reads both).
+    wall0 = time.time()
     t0 = _PROGRESS['current_t0'] = time.perf_counter()
     _PROGRESS['current'] = name
     try:
@@ -139,6 +140,7 @@ def _section(name):
     finally:
         _PROGRESS['current'] = _PROGRESS['current_t0'] = None
         if _OBS is not None:
+            _OBS.record_section(name, wall0, time.perf_counter() - t0)
             _OBS.log(name, **_PROGRESS['sections'].get(name, {}))
             _OBS.snapshot_memory(name)
 
@@ -432,12 +434,19 @@ def bench_sparse():
 
 def main(argv=None):
     parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    from dgmc_tpu.obs import RunObserver, add_obs_flag
+    from dgmc_tpu.obs import (RunObserver, add_obs_flag, add_profile_flag,
+                              start_profile)
     add_obs_flag(parser)
+    add_profile_flag(parser)
     args = parser.parse_args(argv)
     global _OBS
-    if args.obs_dir:
-        _OBS = RunObserver(args.obs_dir)
+    if args.obs_dir or args.probes:
+        # --probes without --obs-dir still flips the trace-time probe
+        # switch (a disabled observer carries no sink) so a probe-overhead
+        # bench run measures what it claims to — same contract as the
+        # experiment CLIs, which construct their observer unconditionally.
+        _OBS = RunObserver(args.obs_dir, probes=args.probes)
+    prof = start_profile(args.profile_dir)
     _install_signal_handlers()
 
     # Sparse first: the allocator's peak_bytes_in_use is process-lifetime,
@@ -506,6 +515,7 @@ def main(argv=None):
         'sparse_dbp15k': sparse,
         'sections': _PROGRESS['sections'],
     }))
+    prof.close()
     if _OBS is not None:
         _OBS.close()
 

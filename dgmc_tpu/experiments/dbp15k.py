@@ -22,7 +22,8 @@ import jax
 import numpy as np
 
 from dgmc_tpu.models import DGMC, RelCNN
-from dgmc_tpu.obs import RunObserver, add_obs_flag
+from dgmc_tpu.obs import (RunObserver, add_obs_flag, add_profile_flag,
+                          start_profile)
 from dgmc_tpu.train import (MetricLogger, create_train_state, make_eval_step,
                             make_train_step, resume_or_init, trace)
 from dgmc_tpu.utils.data import GraphPair, pad_pair_batch
@@ -95,6 +96,7 @@ def parse_args(argv=None):
     parser.add_argument('--num_processes', type=int, default=None)
     parser.add_argument('--process_id', type=int, default=None)
     add_obs_flag(parser)
+    add_profile_flag(parser)
     return parser.parse_args(argv)
 
 
@@ -262,7 +264,9 @@ def main(argv=None):
     profile_epoch = min(start_epoch + 1, args.epochs)
 
     logger = MetricLogger(args.metrics_log if is_coordinator() else None)
-    obs = RunObserver(args.obs_dir if is_coordinator() else None)
+    obs = RunObserver(args.obs_dir if is_coordinator() else None,
+                      probes=args.probes)
+    prof = start_profile(args.profile_dir)
     if start_epoch > 1:
         logger.log(start_epoch - 1, event='resume')
     if is_coordinator():
@@ -321,6 +325,7 @@ def main(argv=None):
             ckpt.save(epoch, state)
     if ckpt:
         ckpt.close()
+    prof.close()
     logger.close()
     obs.close()
     return state

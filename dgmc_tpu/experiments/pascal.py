@@ -19,7 +19,8 @@ import jax.numpy as jnp
 
 from dgmc_tpu.data import Cartesian, Compose, Delaunay, Distance, FaceToEdge
 from dgmc_tpu.models import DGMC, SplineCNN
-from dgmc_tpu.obs import RunObserver, add_obs_flag
+from dgmc_tpu.obs import (RunObserver, add_obs_flag, add_profile_flag,
+                          start_profile)
 from dgmc_tpu.train import (MetricLogger, create_train_state, make_eval_step,
                             make_train_step, resume_or_init, trace)
 from dgmc_tpu.utils import (ConcatDataset, PairLoader, ValidPairDataset,
@@ -56,6 +57,7 @@ def parse_args(argv=None):
     parser.add_argument('--num_processes', type=int, default=None)
     parser.add_argument('--process_id', type=int, default=None)
     add_obs_flag(parser)
+    add_profile_flag(parser)
     return parser.parse_args(argv)
 
 
@@ -164,7 +166,9 @@ def main(argv=None):
     profile_epoch = min(start_epoch + 1, args.epochs)
 
     logger = MetricLogger(args.metrics_log if is_coordinator() else None)
-    obs = RunObserver(args.obs_dir if is_coordinator() else None)
+    obs = RunObserver(args.obs_dir if is_coordinator() else None,
+                      probes=args.probes)
+    prof = start_profile(args.profile_dir)
     if start_epoch > 1:
         logger.log(start_epoch - 1, event='resume')
     for epoch in range(start_epoch, args.epochs + 1):
@@ -197,6 +201,7 @@ def main(argv=None):
             ckpt.save(epoch, state)
     if ckpt:
         ckpt.close()
+    prof.close()
     logger.close()
     obs.close()
     return state

@@ -23,7 +23,8 @@ import numpy as np
 from dgmc_tpu.data import (Cartesian, Compose, Constant, KNNGraph,
                            RandomGraphPairs)
 from dgmc_tpu.models import DGMC, SplineCNN, metrics
-from dgmc_tpu.obs import RunObserver, add_obs_flag
+from dgmc_tpu.obs import (RunObserver, add_obs_flag, add_profile_flag,
+                          start_profile)
 from dgmc_tpu.utils import PairLoader, pad_pair_batch
 from dgmc_tpu.utils.data import GraphPair
 from dgmc_tpu.train import (MetricLogger, create_train_state,
@@ -53,6 +54,7 @@ def parse_args(argv=None):
     parser.add_argument('--metrics_log', type=str, default=None,
                         help='append per-epoch metrics to this JSONL file')
     add_obs_flag(parser)
+    add_profile_flag(parser)
     return parser.parse_args(argv)
 
 
@@ -108,7 +110,8 @@ def main(argv=None):
         syn_eval_step = make_eval_step(model)
 
     logger = MetricLogger(args.metrics_log)
-    obs = RunObserver(args.obs_dir)
+    obs = RunObserver(args.obs_dir, probes=args.probes)
+    prof = start_profile(args.profile_dir)
     profile_epoch = min(2, args.epochs)
     key = jax.random.key(args.seed + 1)
     for epoch in range(1, args.epochs + 1):
@@ -186,6 +189,7 @@ def main(argv=None):
             print(' '.join(c[:5].ljust(5) for c in CATEGORIES) + ' mean')
             print(' '.join(f'{a:.1f}'.ljust(5) for a in accs))
             logger.log(epoch, mean_acc=accs[-1])
+    prof.close()
     logger.close()
     obs.close()
     return state

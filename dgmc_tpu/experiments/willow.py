@@ -23,7 +23,8 @@ import numpy as np
 
 from dgmc_tpu.data import Cartesian, Compose, Delaunay, Distance, FaceToEdge
 from dgmc_tpu.models import DGMC, SplineCNN
-from dgmc_tpu.obs import RunObserver, add_obs_flag
+from dgmc_tpu.obs import (RunObserver, add_obs_flag, add_profile_flag,
+                          start_profile)
 from dgmc_tpu.train import (Checkpointer, MetricLogger, create_train_state,
                             make_eval_step, make_train_step, restore_params,
                             snapshot_params, trace)
@@ -71,6 +72,7 @@ def parse_args(argv=None):
                         help='append per-epoch/per-run metrics to this '
                              'JSONL file')
     add_obs_flag(parser)
+    add_profile_flag(parser)
     return parser.parse_args(argv)
 
 
@@ -133,7 +135,8 @@ def main(argv=None):
     # it, so a killed 20-run protocol restarts at the next unfinished run
     # instead of re-pretraining.
     logger = MetricLogger(args.metrics_log)
-    obs = RunObserver(args.obs_dir)
+    obs = RunObserver(args.obs_dir, probes=args.probes)
+    prof = start_profile(args.profile_dir)
     ckpt = Checkpointer(args.ckpt_dir) if args.ckpt_dir else None
     runs_path = (os.path.join(args.ckpt_dir, 'runs.json')
                  if args.ckpt_dir else None)
@@ -283,6 +286,7 @@ def main(argv=None):
                    for m, s in zip(mean, std)))
     if ckpt:
         ckpt.close()
+    prof.close()
     logger.close()
     obs.close()
     return all_accs

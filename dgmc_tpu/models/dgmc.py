@@ -39,10 +39,33 @@ import jax.numpy as jnp
 from flax import linen as nn
 from flax import struct
 
+from dgmc_tpu.obs import probes as _probes
 from dgmc_tpu.ops.softmax import masked_softmax
 from dgmc_tpu.ops.topk import chunked_topk
 
 EPS = 1e-8
+
+# Row-mass window for the ``topk_mass`` probe: how much probability the 10
+# best entries of each correspondence row hold (10 = the k every sparse
+# experiment ships with, reference ``examples/dbp15k.py:29-32``).
+PROBE_TOPK = 10
+
+
+def _probe_corr_stage(S, row_mask, stage):
+    """Entropy + top-k mass of a correspondence snapshot (S0/SL) — one
+    definition for the dense and sparse paths so their probe series stay
+    comparable."""
+    _probes.emit('corr_entropy', _probes.entropy(S, row_mask), stage=stage)
+    _probes.emit('topk_mass', _probes.topk_mass(S, PROBE_TOPK, row_mask),
+                 stage=stage)
+
+
+def _probe_consensus_iter(S_next, S, row_mask, step):
+    """Per-iteration correction norm + sharpening entropy."""
+    _probes.emit('consensus_delta', _probes.delta_norm(S_next, S, row_mask),
+                 iteration=step)
+    _probes.emit('corr_entropy', _probes.entropy(S_next, row_mask),
+                 iteration=step)
 
 
 @struct.dataclass
@@ -286,6 +309,16 @@ class DGMC(nn.Module):
         # lowered HLO metadata — numerics are untouched.
         with jax.named_scope('psi1'):
             h_s, h_t = run_pair(self.psi_1, graph_s.x, graph_t.x, merge_1)
+        # In-graph numerics probes (obs/probes.py). The switch is a Python
+        # bool at trace time: disabled (default) traces NOTHING — neither
+        # the metric math nor the host callback — so the lowered HLO stays
+        # byte-identical to a probe-free build (tests/obs/test_probes.py).
+        # Gated on `train` as well: the probe series documents the TRAIN
+        # step (eval forwards would pollute the aggregates and could trip
+        # the CI non-finite gate on an eval-only NaN).
+        probe = _probes.enabled() and train
+        if probe:
+            _probes.check_finite('psi1', h_s, h_t, order=0)
         if self.dtype is not None:
             h_s, h_t = h_s.astype(self.dtype), h_t.astype(self.dtype)
         if detach:
@@ -385,6 +418,9 @@ class DGMC(nn.Module):
                                preferred_element_type=jnp.float32))
                 S_mask = s_mask[:, :, None] & t_mask[:, None, :]
                 S_0 = masked_softmax(S_hat, S_mask)
+            if probe:
+                _probes.check_finite('initial_corr', S_hat, order=1)
+                _probe_corr_stage(S_0, s_mask, 'S0')
 
             # Resolve (and record) the kernel decision only when the
             # consensus loop actually runs — num_steps == 0 must not
@@ -442,13 +478,22 @@ class DGMC(nn.Module):
                         delta = consensus_factored(
                             o_s @ w1 + mlp_b1.astype(o_s.dtype),
                             (o_t @ w1)[:, None, :, :])
-                    return self._constrain(
+                    S_hat_next = self._constrain(
                         S_hat + jnp.where(S_mask, delta, 0.0))
+                    if probe:
+                        S_next = masked_softmax(S_hat_next, S_mask)
+                        _probe_consensus_iter(S_next, S, s_mask, step)
+                        _probes.check_finite('consensus_iter', S_hat_next,
+                                             order=2 + step,
+                                             iteration=step)
+                    return S_hat_next
 
             for step in range(num_steps):
                 S_hat = dense_iter(step, S_hat)
 
             S_L = masked_softmax(S_hat, S_mask)
+            if probe:
+                _probe_corr_stage(S_L, s_mask, 'SL')
             return (Correspondence(S_0, None, s_mask, t_mask),
                     Correspondence(S_L, None, s_mask, t_mask))
 
@@ -557,6 +602,9 @@ class DGMC(nn.Module):
             S_hat = jnp.einsum('bsc,bskc->bsk', h_s, h_t_cand,
                                preferred_element_type=jnp.float32)
             S_0 = masked_softmax(S_hat, entry_mask) * s_mask[..., None]
+        if probe:
+            _probes.check_finite('initial_corr', S_hat, order=1)
+            _probe_corr_stage(S_0, s_mask, 'S0')
 
         # Fused consensus-delta kernel (ops/pallas/sparse_consensus.py):
         # forms the [TILE, K, R] difference block and MLP activations in
@@ -606,12 +654,22 @@ class DGMC(nn.Module):
                         jax.default_backend() != 'tpu')
                 else:
                     delta = consensus_mlp(o_s[:, :, None, :] - o_t_cand)
-                return self._constrain(S_hat + delta)
+                S_hat_next = self._constrain(S_hat + delta)
+                if probe:
+                    S_next = (masked_softmax(S_hat_next, entry_mask)
+                              * s_mask[..., None])
+                    _probe_consensus_iter(S_next, S, s_mask, step)
+                    _probes.check_finite('consensus_iter', S_hat_next,
+                                         order=2 + step,
+                                         iteration=step)
+                return S_hat_next
 
         for step in range(num_steps):
             S_hat = sparse_iter(step, S_hat)
 
         S_L = masked_softmax(S_hat, entry_mask) * s_mask[..., None]
+        if probe:
+            _probe_corr_stage(S_L, s_mask, 'SL')
         return (Correspondence(S_0, S_idx, s_mask, t_mask),
                 Correspondence(S_L, S_idx, s_mask, t_mask))
 

@@ -21,9 +21,21 @@ measurements come from:
   ``--obs-dir`` flag of every experiment CLI and ``bench.py``: one
   directory holding ``metrics.jsonl``, ``timings.json``, ``memory.json``
   and ``dispatch.json``.
+- :mod:`~dgmc_tpu.obs.probes` — in-graph numerics probes
+  (``jax.debug.callback`` streams): correspondence entropy, top-k mass,
+  per-consensus-iteration correction norms, gradient global-norm, and
+  non-finite detection with first-offending-stage attribution. A Python
+  bool at trace time — disabled, the lowered HLO is byte-identical to a
+  probe-free build.
+- :mod:`~dgmc_tpu.obs.trace` — Chrome-trace/Perfetto export of the run
+  timeline (steps, compiles, probe series) plus the whole-run
+  ``--profile-dir`` ``jax.profiler.trace`` flag.
 - :mod:`~dgmc_tpu.obs.report` — ``python -m dgmc_tpu.obs.report <dir>``:
-  renders throughput, step-time percentiles, recompile counts, HBM peaks
-  and the kernel-dispatch table from those artifacts.
+  renders throughput, step-time percentiles, recompile counts, HBM peaks,
+  probe aggregates and the kernel-dispatch table from those artifacts.
+- :mod:`~dgmc_tpu.obs.diff` — ``python -m dgmc_tpu.obs.diff A B``:
+  cross-run regression diff with configurable thresholds and a nonzero
+  exit code — the CI perf gate.
 
 Model code carries :func:`jax.named_scope` annotations for the matching
 pipeline's stages (``psi1``, ``initial_corr``, ``topk``,
@@ -31,12 +43,20 @@ pipeline's stages (``psi1``, ``initial_corr``, ``topk``,
 HLO show the algorithm's structure instead of anonymous XLA ops.
 """
 
-from dgmc_tpu.obs.observe import MetricLogger, StepTimer, trace
+from dgmc_tpu.obs import probes
 from dgmc_tpu.obs.registry import (REGISTRY, CompileWatcher, Registry,
                                    compile_event_count, dispatch_table,
                                    record_dispatch)
 from dgmc_tpu.obs.memory import memory_snapshot
 from dgmc_tpu.obs.run import RunObserver, add_obs_flag
+from dgmc_tpu.obs.trace import (add_profile_flag, export_chrome_trace,
+                                profile_span, start_profile)
+# Imported LAST: binding the trace() *function* must win over the package
+# attribute the `dgmc_tpu.obs.trace` submodule import set just above —
+# `from dgmc_tpu.obs import trace` is the long-standing profiler-context
+# API (re-exported by dgmc_tpu.train). Reach the submodule with
+# `from dgmc_tpu.obs.trace import ...` (resolved via sys.modules).
+from dgmc_tpu.obs.observe import MetricLogger, StepTimer, trace
 
 __all__ = [
     'MetricLogger',
@@ -51,4 +71,9 @@ __all__ = [
     'memory_snapshot',
     'RunObserver',
     'add_obs_flag',
+    'probes',
+    'add_profile_flag',
+    'export_chrome_trace',
+    'profile_span',
+    'start_profile',
 ]

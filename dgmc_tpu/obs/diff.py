@@ -1,0 +1,279 @@
+"""Cross-run regression diff: compare two ``--obs-dir`` runs, gate CI.
+
+Usage::
+
+    python -m dgmc_tpu.obs.diff BASELINE CANDIDATE            # table + rc
+    python -m dgmc_tpu.obs.diff A B --json                   # machine-readable
+    python -m dgmc_tpu.obs.diff A B --max-step-p50-regression 0.5
+
+Every perf claim in this repo rests on its own measurements (the
+reference publishes no wall-clock numbers), so "measurably faster" needs
+a tool that can say *measurably slower* with a nonzero exit code. The
+diff compares the summaries :mod:`dgmc_tpu.obs.report` builds —
+throughput, step p50/p95, recompile count, memory peak, the
+kernel-dispatch table, probe aggregates — against configurable
+regression thresholds:
+
+- **step p50 / p95** — relative increase above
+  ``--max-step-p50-regression`` / ``--max-step-p95-regression`` fails.
+- **throughput** — relative decrease above
+  ``--max-throughput-regression`` fails.
+- **compile events** — more than ``--max-new-compile-events`` extra
+  events fails (padding-bucket churn shows up here).
+- **memory peak** — relative increase above
+  ``--max-memory-regression`` fails (only when both runs report the
+  same source: device peaks and host RSS are not comparable).
+- **kernel dispatch** — a kernel that ran Pallas in the baseline but
+  only fell back in the candidate fails (``--allow-kernel-fallback``
+  downgrades this to a note).
+- **probes** — a candidate run that recorded a non-finite stage fails;
+  numeric probe aggregates (entropy, consensus delta, grad norm) are
+  reported as informational drift rows.
+
+Exit codes: 0 = no regression, 1 = regression, 2 = usage/missing input.
+Like the report CLI, this module has **no jax import** — it must gate CI
+from artifacts alone.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+from dgmc_tpu.obs.report import load_run, summarize
+
+#: Default fractional/absolute thresholds; CLI flags override.
+DEFAULT_THRESHOLDS = {
+    'step_p50': 0.25,
+    'step_p95': 0.40,
+    'throughput': 0.25,
+    'memory': 0.15,
+    'new_compile_events': 5,
+}
+
+
+def _rel(a, b):
+    """(b - a) / a — the signed fractional change, None if undefined."""
+    if a is None or b is None or not a:
+        return None
+    return (b - a) / a
+
+
+def _row(metric, a, b, delta, limit, status, note=''):
+    return {'metric': metric, 'a': a, 'b': b, 'delta': delta,
+            'limit': limit, 'status': status, 'note': note}
+
+
+def _dispatch_outcomes(summary):
+    """{kernel: set(outcomes with count > 0)} from a run summary."""
+    out = {}
+    for r in summary.get('dispatch', []):
+        if r.get('count', 0) > 0 and 'kernel' in r:
+            out.setdefault(r['kernel'], set()).add(r.get('outcome'))
+    return out
+
+
+def diff_runs(a, b, thresholds=None, allow_kernel_fallback=False):
+    """Compare two run summaries (:func:`dgmc_tpu.obs.report.summarize`
+    outputs). Returns ``(rows, regressions)`` — all comparison rows, and
+    the subset that breached a threshold."""
+    thr = dict(DEFAULT_THRESHOLDS, **(thresholds or {}))
+    rows = []
+
+    def gate(metric, va, vb, delta, limit, worse, note=''):
+        status = 'REGRESSION' if worse else 'ok'
+        rows.append(_row(metric, va, vb, delta, limit, status, note))
+
+    # -- step timing ------------------------------------------------------
+    # Asymmetric absence handling, matching the dispatch section below: a
+    # metric the BASELINE recorded but the candidate lost (broken timer,
+    # run died before its first flush) is a regression — a gate that
+    # exits 0 because the numbers it gates on vanished is no gate.
+    def timing(key, thr_key, worse_when):
+        va, vb = a.get(key), b.get(key)
+        if va is None:
+            rows.append(_row(key, va, vb, None, thr[thr_key], 'skipped',
+                             'missing from baseline'))
+            return
+        if vb is None:
+            rows.append(_row(key, va, vb, None, thr[thr_key], 'REGRESSION',
+                             'missing from candidate'))
+            return
+        d = _rel(va, vb)
+        if d is None:  # zero baseline: no meaningful ratio
+            rows.append(_row(key, va, vb, None, thr[thr_key], 'skipped',
+                             'zero baseline'))
+            return
+        gate(key, va, vb, round(d, 4), thr[thr_key], worse_when(d))
+
+    timing('step_p50_s', 'step_p50', lambda d: d > thr['step_p50'])
+    timing('step_p95_s', 'step_p95', lambda d: d > thr['step_p95'])
+    timing('steps_per_sec', 'throughput',
+           lambda d: -d > thr['throughput'])
+
+    # -- compiles ---------------------------------------------------------
+    ca, cb = a.get('compile_events', 0), b.get('compile_events', 0)
+    extra = cb - ca
+    gate('compile_events', ca, cb, extra, thr['new_compile_events'],
+         extra > thr['new_compile_events'])
+
+    # -- memory -----------------------------------------------------------
+    ma, mb = a.get('peak_memory_bytes'), b.get('peak_memory_bytes')
+    src_a, src_b = (a.get('peak_memory_source'), b.get('peak_memory_source'))
+    if ma is not None and mb is None:
+        rows.append(_row('peak_memory_bytes', ma, mb, None, thr['memory'],
+                         'REGRESSION', 'missing from candidate'))
+    elif ma is None or mb is None:
+        rows.append(_row('peak_memory_bytes', ma, mb, None, thr['memory'],
+                         'skipped', 'missing from baseline'))
+    elif src_a != src_b:
+        rows.append(_row('peak_memory_bytes', ma, mb, None, thr['memory'],
+                         'skipped',
+                         f'sources differ ({src_a} vs {src_b})'))
+    else:
+        d = _rel(ma, mb)
+        gate('peak_memory_bytes', ma, mb, round(d, 4), thr['memory'],
+             d > thr['memory'], f'source={src_a}')
+
+    # -- kernel dispatch --------------------------------------------------
+    da, db = _dispatch_outcomes(a), _dispatch_outcomes(b)
+    for kernel, outcomes_a in sorted(da.items()):
+        if 'pallas' not in outcomes_a:
+            continue
+        outcomes_b = db.get(kernel, set())
+        # Absent counts as lost too: a candidate that never reached the
+        # decision site stopped exercising the Pallas path just as
+        # surely as one that fell back.
+        lost = 'pallas' not in outcomes_b
+        status = ('note' if allow_kernel_fallback else 'REGRESSION') \
+            if lost else 'ok'
+        note = '' if not lost else (
+            'kernel fell back to XLA' if outcomes_b
+            else 'kernel decision absent from candidate')
+        rows.append(_row(f'dispatch[{kernel}]', 'pallas',
+                         ','.join(sorted(x for x in outcomes_b if x))
+                         or 'absent',
+                         None, None, status, note))
+
+    # -- probes -----------------------------------------------------------
+    fn = b.get('first_nonfinite')
+    if fn:
+        rows.append(_row('first_nonfinite', a.get('first_nonfinite'), fn,
+                         None, None, 'REGRESSION',
+                         f'candidate went non-finite at step '
+                         f'{fn.get("step")} stage {fn.get("stage")!r}'))
+    pa, pb = a.get('probes') or {}, b.get('probes') or {}
+    for name in sorted(set(pa) | set(pb)):
+        if name == 'nonfinite':
+            continue
+        mean_a = (pa.get(name) or {}).get('mean')
+        mean_b = (pb.get(name) or {}).get('mean')
+        rows.append(_row(f'probe[{name}].mean', mean_a, mean_b,
+                         _rel(mean_a, mean_b), None, 'info',
+                         'informational drift'))
+
+    regressions = [r for r in rows if r['status'] == 'REGRESSION']
+    return rows, regressions
+
+
+def _fmt(v):
+    if v is None:
+        return '-'
+    if isinstance(v, float):
+        return f'{v:.6g}'
+    return str(v)
+
+
+def render_diff(a_path, b_path, rows, regressions):
+    lines = [f'== run diff: {a_path} (baseline) vs {b_path} (candidate) ==',
+             f'  {"metric":<28} {"baseline":>12} {"candidate":>12} '
+             f'{"delta":>9} {"limit":>7}  status']
+    for r in rows:
+        delta = f'{r["delta"]:+.1%}' if isinstance(r['delta'], float) \
+            else _fmt(r['delta'])
+        limit = _fmt(r['limit'])
+        note = f'  ({r["note"]})' if r['note'] else ''
+        lines.append(f'  {r["metric"]:<28} {_fmt(r["a"]):>12} '
+                     f'{_fmt(r["b"]):>12} {delta:>9} {limit:>7}  '
+                     f'{r["status"]}{note}')
+    lines.append(f'  => {len(regressions)} regression(s)')
+    return '\n'.join(lines)
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        prog='python -m dgmc_tpu.obs.diff',
+        description='Compare two --obs-dir runs; exit nonzero on '
+                    'threshold regression (the CI perf gate).')
+    parser.add_argument('baseline', help='obs dir of the baseline run')
+    parser.add_argument('candidate', help='obs dir of the candidate run')
+    parser.add_argument('--json', action='store_true',
+                        help='print the machine-readable diff object')
+    parser.add_argument('--max-step-p50-regression', type=float,
+                        default=DEFAULT_THRESHOLDS['step_p50'],
+                        metavar='FRAC',
+                        help='allowed fractional p50 step-time increase '
+                             '(default %(default)s)')
+    parser.add_argument('--max-step-p95-regression', type=float,
+                        default=DEFAULT_THRESHOLDS['step_p95'],
+                        metavar='FRAC',
+                        help='allowed fractional p95 step-time increase '
+                             '(default %(default)s)')
+    parser.add_argument('--max-throughput-regression', type=float,
+                        default=DEFAULT_THRESHOLDS['throughput'],
+                        metavar='FRAC',
+                        help='allowed fractional steps/sec decrease '
+                             '(default %(default)s)')
+    parser.add_argument('--max-memory-regression', type=float,
+                        default=DEFAULT_THRESHOLDS['memory'],
+                        metavar='FRAC',
+                        help='allowed fractional peak-memory increase '
+                             '(default %(default)s)')
+    parser.add_argument('--max-new-compile-events', type=int,
+                        default=DEFAULT_THRESHOLDS['new_compile_events'],
+                        metavar='N',
+                        help='allowed extra compile events in the '
+                             'candidate (default %(default)s)')
+    parser.add_argument('--allow-kernel-fallback', action='store_true',
+                        help='downgrade pallas->fallback dispatch changes '
+                             'from regression to note')
+    args = parser.parse_args(argv)
+
+    for p in (args.baseline, args.candidate):
+        if not os.path.isdir(p):
+            print(f'diff: no such obs dir: {p}', file=sys.stderr)
+            return 2
+
+    a = summarize(load_run(args.baseline))
+    b = summarize(load_run(args.candidate))
+    if not a.get('metrics_records') and not a.get('steps'):
+        print(f'diff: {args.baseline} holds no telemetry', file=sys.stderr)
+        return 2
+    if not b.get('metrics_records') and not b.get('steps'):
+        print(f'diff: {args.candidate} holds no telemetry', file=sys.stderr)
+        return 2
+
+    rows, regressions = diff_runs(
+        a, b,
+        thresholds={
+            'step_p50': args.max_step_p50_regression,
+            'step_p95': args.max_step_p95_regression,
+            'throughput': args.max_throughput_regression,
+            'memory': args.max_memory_regression,
+            'new_compile_events': args.max_new_compile_events,
+        },
+        allow_kernel_fallback=args.allow_kernel_fallback)
+
+    if args.json:
+        print(json.dumps({'baseline': args.baseline,
+                          'candidate': args.candidate,
+                          'rows': rows,
+                          'regressions': len(regressions),
+                          'ok': not regressions}, indent=1))
+    else:
+        print(render_diff(args.baseline, args.candidate, rows, regressions))
+    return 1 if regressions else 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

@@ -22,6 +22,7 @@ alias of this one.
 
 import contextlib
 import json
+import math
 import os
 import time
 
@@ -68,9 +69,15 @@ class StepTimer:
 
     def __init__(self):
         self.times = []
+        #: Wall-clock step spans ``(epoch_start_s, duration_s)`` — the
+        #: timeline view of ``times``, consumed by the Chrome-trace
+        #: exporter (:func:`dgmc_tpu.obs.trace.export_chrome_trace`).
+        self.spans = []
         self._t0 = None
+        self._wall0 = None
 
     def start(self):
+        self._wall0 = time.time()
         self._t0 = time.perf_counter()
 
     def stop(self, fence=None):
@@ -81,7 +88,8 @@ class StepTimer:
         if fence is not None:
             float(fence)
         self.times.append(time.perf_counter() - self._t0)
-        self._t0 = None
+        self.spans.append((self._wall0, self.times[-1]))
+        self._t0 = self._wall0 = None
         return self.times[-1]
 
     @property
@@ -125,9 +133,18 @@ class MetricLogger:
             return
         rec = {'step': step, 'time': time.time()}
         for k, v in metrics.items():
-            # Device scalars / numpy types to float; bools stay bools.
-            coerce = hasattr(v, '__float__') and not isinstance(v, bool)
-            rec[k] = float(v) if coerce else v
+            # Device scalars / numpy types to float; bools and Python ints
+            # (e.g. a probe's static `iteration` tag) keep their type.
+            coerce = (hasattr(v, '__float__')
+                      and not isinstance(v, (bool, int)))
+            v = float(v) if coerce else v
+            if isinstance(v, float) and not math.isfinite(v):
+                # NaN/inf are not valid JSON (json.dumps would emit a
+                # bare NaN token that strict parsers reject) — null
+                # records "this value went non-finite" in a file that
+                # stays loadable, which is exactly when it matters.
+                v = None
+            rec[k] = v
         self._fh.write(json.dumps(rec) + '\n')
         self._fh.flush()
 

@@ -1,0 +1,289 @@
+"""In-graph numerics probes: per-step model internals without host syncs.
+
+DGMC's accuracy hinges on dynamics invisible from outside ``jit``: how
+fast the softmax correspondence sharpens over the L consensus iterations,
+how much probability mass the top-k candidate set keeps, and how large
+the per-iteration corrections the consensus MLP applies are (Algorithm 1
+of Fey et al., ICLR 2020). This module streams those diagnostics out of
+compiled programs via ``jax.debug.callback`` — the host receives small
+scalars as the step executes, with no extra device->host fences in the
+training loop.
+
+Design contract (the zero-overhead guarantee):
+
+- The enable switch is a **Python bool read at trace time**. Probe call
+  sites pass their metric as a 0-arg thunk, so with probes disabled
+  (default) neither the metric computation nor the callback is ever
+  traced — the lowered HLO is byte-identical to a build without probe
+  call sites (pinned by ``tests/obs/test_probes.py``).
+- Because the switch is trace-time, it must be flipped **before the
+  first execution of a jitted step** (tracing happens at first call); a
+  step traced while probes were off keeps running probe-free until it is
+  retraced. :class:`~dgmc_tpu.obs.run.RunObserver` enables probes in its
+  constructor, which every CLI creates before its first step.
+
+Probes emitted by the model/train-step integration:
+
+``corr_entropy``
+    Mean per-row entropy of the soft correspondence (dense: over targets;
+    sparse: over candidate slots), for ``S^0``/``S^L`` (``stage``) and
+    per consensus iteration (``iteration``) — the sharpening curve.
+``topk_mass``
+    Mean probability mass of each row's ``k`` largest entries — how much
+    mass a top-k sparsification keeps (dense), or how concentrated the
+    kept candidate set already is (sparse).
+``consensus_delta``
+    Per-iteration correction norm ``‖S_{l+1} - S_l‖`` (masked Frobenius
+    norm, mean over the batch) — Algorithm 1's fixed-point residual.
+``grad_norm``
+    Global gradient norm of the train step.
+``nonfinite``
+    1.0 when a pipeline stage produced a non-finite value, with the
+    offending ``stage`` name — first-offender attribution is done by the
+    sink (:class:`~dgmc_tpu.obs.run.RunObserver` records the first).
+
+Host-side delivery: callbacks fan out to registered sinks (callables
+receiving one record dict). Records carry ``probe``, ``value``, ``time``
+and any static metadata the call site attached (``stage``,
+``iteration``). With JAX's async dispatch the arrival time is when the
+device computation actually runs, so step attribution by a host-side
+counter is approximate within the dispatch pipeline depth — exact
+enough for per-step series. Callbacks are UNordered (``ordered=True``
+does not compose with every transform), so nothing may depend on
+arrival order within a step; the first-nonfinite attribution sorts on
+each check's static ``order`` (pipeline position) instead.
+"""
+
+import contextlib
+import math
+import threading
+import time
+
+__all__ = [
+    'enabled', 'enable', 'disable', 'add_sink', 'remove_sink',
+    'activated', 'ProbeLog', 'emit', 'check_finite',
+    'entropy', 'topk_mass', 'delta_norm',
+]
+
+_lock = threading.Lock()
+_enabled = False
+_sinks = []
+
+
+def enabled():
+    """Trace-time probe switch (a plain Python bool)."""
+    return _enabled
+
+
+def enable(sink=None):
+    """Turn probes on (idempotent); optionally register ``sink``.
+
+    Must run before the first execution of any jitted step that should
+    carry probes (the switch is read when the step is traced).
+    """
+    global _enabled
+    with _lock:
+        _enabled = True
+        if sink is not None and sink not in _sinks:
+            _sinks.append(sink)
+
+
+def disable(sink=None):
+    """Turn probes off for subsequently-traced programs; optionally
+    unregister ``sink``. Already-traced programs keep their callbacks —
+    with no sinks registered those dispatch to nothing."""
+    global _enabled
+    with _lock:
+        _enabled = False
+        if sink is not None and sink in _sinks:
+            _sinks.remove(sink)
+
+
+def add_sink(fn):
+    with _lock:
+        if fn not in _sinks:
+            _sinks.append(fn)
+
+
+def remove_sink(fn):
+    with _lock:
+        if fn in _sinks:
+            _sinks.remove(fn)
+
+
+class ProbeLog:
+    """Minimal list sink: ``ProbeLog()`` collects records for tests."""
+
+    def __init__(self):
+        self.records = []
+
+    def __call__(self, rec):
+        self.records.append(rec)
+
+    def by_name(self, name):
+        return [r for r in self.records if r['probe'] == name]
+
+
+class Aggregator:
+    """Streaming per-probe aggregates (count/mean/last/min/max).
+
+    The ONE accumulation shared by the live sink (RunObserver) and the
+    offline rebuild (``obs.report`` over a raw ``metrics.jsonl``) so the
+    statistics themselves cannot drift. (The ``nonfinite`` probe is the
+    exception by construction: only FIRING checks reach metrics.jsonl,
+    so a rebuild sees a different population than the live sink — the
+    rebuild therefore skips it.) Pure Python — no jax — so the
+    report/diff CLIs stay importable anywhere.
+
+    Non-finite values are counted (``nonfinite_values``) but kept out of
+    mean/min/max/last: one NaN must not poison the whole run's
+    statistics, and NaN is not representable in strict JSON anyway."""
+
+    def __init__(self):
+        self._agg = {}
+
+    def add(self, name, value):
+        a = self._agg.setdefault(
+            name, {'count': 0, 'finite': 0, 'sum': 0.0, 'min': None,
+                   'max': None, 'last': None, 'nonfinite': 0})
+        a['count'] += 1
+        if math.isfinite(value):
+            a['finite'] += 1
+            a['sum'] += value
+            a['min'] = value if a['min'] is None else min(a['min'], value)
+            a['max'] = value if a['max'] is None else max(a['max'], value)
+            a['last'] = value
+        else:
+            a['nonfinite'] += 1
+
+    def __bool__(self):
+        return bool(self._agg)
+
+    def summary(self):
+        out = {}
+        for name, a in sorted(self._agg.items()):
+            r = lambda v: None if v is None else round(v, 6)  # noqa: E731
+            s = {'count': a['count'],
+                 'mean': r(a['sum'] / a['finite']) if a['finite'] else None,
+                 'last': r(a['last']),
+                 'min': r(a['min']),
+                 'max': r(a['max'])}
+            if a['nonfinite']:
+                s['nonfinite_values'] = a['nonfinite']
+            out[name] = s
+        return out
+
+
+@contextlib.contextmanager
+def activated(sink=None):
+    """Scoped enable for tests: probes on (with ``sink``) inside the
+    block, prior switch state restored after."""
+    global _enabled
+    prev = _enabled
+    enable(sink)
+    try:
+        yield sink
+    finally:
+        with _lock:
+            _enabled = prev
+            if sink is not None and sink in _sinks:
+                _sinks.remove(sink)
+
+
+def _dispatch(rec):
+    with _lock:
+        sinks = list(_sinks)
+    for s in sinks:
+        try:
+            s(rec)
+        except Exception:
+            # A broken sink must never take down the training step that
+            # happens to be streaming diagnostics through it.
+            pass
+
+
+def emit(name, value, **meta):
+    """Stream one scalar probe out of the running computation.
+
+    Args:
+        name: probe name (``corr_entropy``, ``grad_norm``, ...).
+        value: a scalar array, or a **0-arg callable** returning one —
+            pass a thunk so the metric computation itself is skipped
+            (never traced) when probes are disabled.
+        **meta: static Python metadata attached to the record
+            (``stage=...``, ``iteration=...``).
+    """
+    if not _enabled:
+        return
+    import jax
+    import jax.numpy as jnp
+    v = jnp.asarray(value() if callable(value) else value, jnp.float32)
+
+    def _cb(x, _name=name, _meta=meta):
+        _dispatch({'probe': _name, 'value': float(x), 'time': time.time(),
+                   **_meta})
+
+    jax.debug.callback(_cb, v)
+
+
+def check_finite(stage, *arrays, order=0, **meta):
+    """Emit a ``nonfinite`` probe (0.0/1.0) for ``stage`` covering
+    ``arrays``. ``order`` is the stage's static position in the pipeline
+    (psi1 < initial_corr < consensus_iter[i] < loss < grad): the
+    callbacks are unordered, so first-offender attribution must NOT
+    trust host arrival order — the sink picks the firing check with the
+    lowest ``(step, order)`` instead."""
+    if not _enabled:
+        return
+    import jax.numpy as jnp
+    bad = jnp.zeros((), bool)
+    for a in arrays:
+        bad = bad | ~jnp.all(jnp.isfinite(jnp.asarray(a)))
+    emit('nonfinite', bad.astype(jnp.float32), stage=stage, order=order,
+         **meta)
+
+
+# ---------------------------------------------------------------------------
+# In-graph metric helpers (call only inside an emit thunk / enabled branch)
+# ---------------------------------------------------------------------------
+
+_EPS = 1e-12
+
+
+def _row_mean(per_row, row_mask):
+    import jax.numpy as jnp
+    if row_mask is None:
+        return jnp.mean(per_row)
+    m = row_mask.astype(per_row.dtype)
+    return jnp.sum(per_row * m) / jnp.maximum(jnp.sum(m), 1.0)
+
+
+def entropy(S, row_mask=None):
+    """Mean per-row entropy of a probability tensor ``[..., rows, C]``
+    (zero entries contribute zero; ``row_mask`` selects valid rows)."""
+    import jax.numpy as jnp
+    S = S.astype(jnp.float32)
+    h = -jnp.sum(jnp.where(S > 0, S * jnp.log(jnp.maximum(S, _EPS)), 0.0),
+                 axis=-1)
+    return _row_mean(h, row_mask)
+
+
+def topk_mass(S, k, row_mask=None):
+    """Mean per-row probability mass of the ``k`` largest entries."""
+    import jax.lax
+    import jax.numpy as jnp
+    S = S.astype(jnp.float32)
+    k = max(1, min(int(k), S.shape[-1]))
+    top, _ = jax.lax.top_k(S, k)
+    return _row_mean(jnp.sum(top, axis=-1), row_mask)
+
+
+def delta_norm(S_new, S_old, row_mask=None):
+    """Mean-over-batch Frobenius norm of ``S_new - S_old`` (rows outside
+    ``row_mask`` zeroed): Algorithm 1's per-iteration correction size."""
+    import jax.numpy as jnp
+    d = (S_new - S_old).astype(jnp.float32)
+    if row_mask is not None:
+        d = d * row_mask[..., None].astype(d.dtype)
+    axes = tuple(range(1, d.ndim))
+    return jnp.mean(jnp.sqrt(jnp.sum(d * d, axis=axes)))

@@ -81,6 +81,31 @@ def peak_memory(memory):
     return None, None
 
 
+def probe_aggregates_from_metrics(metrics):
+    """Rebuild per-probe aggregates from the raw ``metrics.jsonl`` series
+    — the fallback when ``timings.json`` predates the probe layer or only
+    a bare metrics file was given. Uses the same accumulator the live
+    sink does (``obs.probes.Aggregator``; jax-free)."""
+    from dgmc_tpu.obs.probes import Aggregator
+    agg = Aggregator()
+    for rec in metrics or []:
+        name = rec.get('probe')
+        # 'nonfinite' is skipped by construction: only FIRING checks
+        # reach metrics.jsonl, so a rebuild would see a different
+        # population than the live sink's full-check statistics.
+        if not name or name == 'nonfinite':
+            continue
+        v = rec.get('value')
+        if isinstance(v, (int, float)) and not isinstance(v, bool):
+            agg.add(name, v)
+        elif v is None and 'value' in rec:
+            # MetricLogger writes non-finite values as null (NaN is not
+            # valid JSON): feed NaN back so the rebuilt count and the
+            # 'nonfinite_values' marker match the live sink's.
+            agg.add(name, float('nan'))
+    return agg.summary()
+
+
 def summarize(run):
     """One machine-readable summary object for a loaded run."""
     out = {'path': run['path'],
@@ -110,6 +135,12 @@ def summarize(run):
     if buckets:
         out['padding_buckets'] = len(buckets)
         out['padding_bucket_rows'] = buckets
+
+    probes = t.get('probes') or probe_aggregates_from_metrics(run['metrics'])
+    if probes:
+        out['probes'] = probes
+    if t.get('first_nonfinite'):
+        out['first_nonfinite'] = t['first_nonfinite']
 
     peak, source = peak_memory(run['memory'])
     if peak is not None:
@@ -199,6 +230,25 @@ def render(run):
                      f'fallback: {s.get("dispatch_fallback", 0)}')
     else:
         lines.append('  (no dispatch decisions recorded)')
+
+    if s.get('probes'):
+        lines.append('-- probes --')
+        lines.append(f'  {"probe":<18} {"count":>6} {"mean":>12} '
+                     f'{"last":>12} {"min":>12} {"max":>12}')
+
+        def g(v):
+            return '-' if v is None else f'{v:.6g}'
+
+        for name, a in s['probes'].items():
+            nf = (f'  ({a["nonfinite_values"]} non-finite)'
+                  if a.get('nonfinite_values') else '')
+            lines.append(f'  {name:<18} {a["count"]:>6} {g(a["mean"]):>12} '
+                         f'{g(a["last"]):>12} {g(a["min"]):>12} '
+                         f'{g(a["max"]):>12}{nf}')
+        if s.get('first_nonfinite'):
+            fn = s['first_nonfinite']
+            lines.append(f'  FIRST NON-FINITE at step {fn.get("step")} '
+                         f'stage {fn.get("stage")!r}')
 
     lines.append('-- metrics --')
     lines.append(f'  records          {s["metrics_records"]}')

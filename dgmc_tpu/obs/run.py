@@ -4,11 +4,14 @@
 watcher, memory snapshots and the dispatch-counter snapshot behind a
 single directory:
 
-- ``metrics.jsonl`` — one record per :meth:`RunObserver.log` call.
+- ``metrics.jsonl`` — one record per :meth:`RunObserver.log` call, plus
+  (with probes on) one record per in-graph probe event.
 - ``timings.json``  — step-time percentiles + compile-event summary +
-  run wall-clock.
+  run wall-clock + per-probe aggregates.
 - ``memory.json``   — labelled device/host memory snapshots + the peak.
 - ``dispatch.json`` — the kernel-dispatch outcome table.
+- ``trace.json``    — Chrome-trace/Perfetto timeline of steps, compiles
+  and probe series (:mod:`dgmc_tpu.obs.trace`).
 
 Every method is a no-op when constructed with a falsy directory, so CLIs
 call the observer unconditionally::
@@ -27,12 +30,17 @@ analyzable telemetry on disk — the failure mode ``BENCH_r05.json``
 (``rc: 124``, no evidence) exposed.
 """
 
+import collections
 import contextlib
 import json
 import os
 import sys
+import threading
 import time
 
+# Safe despite the package-cycle shape: importing ANY obs submodule runs
+# the package __init__ first, and that imports probes before run.
+from dgmc_tpu.obs import probes as probes_mod
 from dgmc_tpu.obs.memory import memory_snapshot
 from dgmc_tpu.obs.observe import MetricLogger, StepTimer
 from dgmc_tpu.obs.registry import (CompileWatcher, dispatch_table,
@@ -40,25 +48,69 @@ from dgmc_tpu.obs.registry import (CompileWatcher, dispatch_table,
 
 
 def add_obs_flag(parser):
-    """Register the standard ``--obs-dir`` flag on an argparse parser."""
+    """Register the standard ``--obs-dir`` / ``--probes`` flags on an
+    argparse parser."""
     parser.add_argument(
         '--obs-dir', '--obs_dir', dest='obs_dir', type=str, default=None,
         help='write run telemetry (metrics.jsonl, timings.json, '
-             'memory.json, dispatch.json) into this directory; render it '
-             'with `python -m dgmc_tpu.obs.report <dir>`')
+             'memory.json, dispatch.json, trace.json) into this '
+             'directory; render it with `python -m dgmc_tpu.obs.report '
+             '<dir>`, compare two runs with `python -m dgmc_tpu.obs.diff '
+             'A B`')
+    parser.add_argument(
+        '--probes', action='store_true',
+        help='stream in-graph numerics probes (correspondence entropy, '
+             'top-k mass, consensus-delta norm, grad norm, non-finite '
+             'detection) into the --obs-dir artifacts; off = the lowered '
+             'step is byte-identical to a probe-free build')
     return parser
 
 
-class RunObserver:
-    """Facade collecting one run's telemetry into ``obs_dir``."""
+#: Probe records kept in memory for the trace timeline; past this the
+#: oldest fall off (deque maxlen — metrics.jsonl still holds the full
+#: series, and the aggregates cover every event).
+MAX_TRACE_PROBES = 20000
 
-    def __init__(self, obs_dir):
+
+class RunObserver:
+    """Facade collecting one run's telemetry into ``obs_dir``.
+
+    ``probes=True`` additionally turns on the in-graph numerics probes
+    (:mod:`dgmc_tpu.obs.probes`) and streams their records into
+    ``metrics.jsonl`` (tagged with the observer's step counter),
+    per-probe aggregates into ``timings.json``, and the series timeline
+    into ``trace.json``. The observer is constructed before the first
+    jitted step runs, which is exactly when the trace-time probe switch
+    must be set. The switch is flipped even when ``obs_dir`` is falsy
+    (only the SINK needs an artifact dir): in a multi-process run the
+    coordinator-gated observers must still trace the SAME program on
+    every process — a probe-carrying step on process 0 against a
+    probe-free step on process 1 would break SPMD lockstep.
+    """
+
+    def __init__(self, obs_dir, probes=False):
         self.dir = obs_dir
         self.enabled = bool(obs_dir)
         self.timer = StepTimer()
         self._t_start = time.time()
         self._snapshots = []
         self._watcher = None
+        self._sections = []
+        self._step_index = 0
+        self._probe_sink = None
+        # _probe_lock: _on_probe runs on jax's host-callback thread while
+        # the main thread logs/flushes — both touch the records/aggregates
+        # and the metrics file handle.
+        self._probe_lock = threading.Lock()
+        self._probe_agg = probes_mod.Aggregator()
+        self._probe_records = collections.deque(maxlen=MAX_TRACE_PROBES)
+        self.first_nonfinite = None
+        self._probes_enabled_by_me = False
+        if probes:
+            self._probes_enabled_by_me = not probes_mod.enabled()
+            if self.enabled:
+                self._probe_sink = self._on_probe
+            probes_mod.enable(self._probe_sink)
         # mode='w': an obs dir describes ONE run — a reused --obs-dir must
         # not append a second run's metrics to artifacts the observer
         # rewrites from scratch.
@@ -89,13 +141,60 @@ class RunObserver:
             yield
         finally:
             self.timer.stop(fence=fence)
+            # Probe records are attributed to this counter; with async
+            # dispatch the attribution is approximate within the dispatch
+            # pipeline depth (see obs/probes.py).
+            self._step_index += 1
+
+    def _on_probe(self, rec):
+        """Probe sink (runs on jax's host-callback thread): series ->
+        metrics.jsonl, aggregates -> timings.json, timeline ->
+        trace.json. Nonfinite checks only hit metrics.jsonl when they
+        actually fire (the all-finite flood stays out)."""
+        name = rec['probe']
+        value = rec['value']
+        with self._probe_lock:
+            self._probe_agg.add(name, value)
+            meta = {k: v for k, v in rec.items()
+                    if k not in ('probe', 'value', 'time')}
+            if name == 'nonfinite':
+                if value:
+                    # Callbacks are unordered: attribute the FIRST
+                    # offender by (step, static pipeline order), not by
+                    # host arrival order.
+                    cand = {'step': self._step_index,
+                            'stage': rec.get('stage', '?'),
+                            'order': rec.get('order', 1 << 30)}
+                    cur = self.first_nonfinite
+                    if cur is None or ((cand['step'], cand['order'])
+                                       < (cur['step'],
+                                          cur.get('order', 1 << 30))):
+                        self.first_nonfinite = cand
+                else:
+                    return
+            # deque(maxlen=...): O(1) eviction once the timeline cap is
+            # hit (metrics.jsonl still holds the full series).
+            self._probe_records.append(rec)
+            self._metrics.log(self._step_index, probe=name, value=value,
+                              **meta)
+
+    def record_section(self, name, start_s, duration_s):
+        """Register one labelled wall-clock span (e.g. a bench section)
+        for the ``trace.json`` timeline."""
+        if self.enabled:
+            self._sections.append((name, start_s, duration_s))
 
     def log(self, step, **metrics):
         """Append one record to ``metrics.jsonl`` and refresh the derived
         artifacts."""
         if not self.enabled:
             return
-        self._metrics.log(step, **metrics)
+        # Same lock as the probe sink: both sides write the one
+        # metrics.jsonl handle, and late probe callbacks can still be
+        # draining on jax's host-callback thread while the main thread
+        # logs its epoch record.
+        with self._probe_lock:
+            self._metrics.log(step, **metrics)
         self.flush()
 
     @contextlib.contextmanager
@@ -143,8 +242,14 @@ class RunObserver:
             json.dump(payload, f, indent=1)
         os.replace(tmp, path)
 
+    def probe_summary(self):
+        """Per-probe aggregates ``{name: {count, mean, last, min, max}}``
+        (+ ``first_nonfinite`` when a stage went non-finite)."""
+        with self._probe_lock:
+            return self._probe_agg.summary()
+
     def timings(self):
-        return {
+        out = {
             'wall_s': round(time.time() - self._t_start, 3),
             'argv': sys.argv,
             'steps': self.timer.summary(),
@@ -152,18 +257,55 @@ class RunObserver:
             'padding_buckets': self._since(padding_bucket_table(),
                                            self._buckets_base),
         }
+        if self._probe_agg:
+            out['probes'] = self.probe_summary()
+        if self.first_nonfinite is not None:
+            out['first_nonfinite'] = self.first_nonfinite
+        return out
 
     def flush(self):
         """Rewrite ``timings.json`` / ``memory.json`` / ``dispatch.json``
-        from current state (atomic per file)."""
+        / ``trace.json`` from current state (atomic per file)."""
         if not self.enabled:
             return
         self._write('timings.json', self.timings())
         self._write('memory.json', {'snapshots': self._snapshots})
         self._write('dispatch.json', {'counts': self._since(
             dispatch_table(), self._dispatch_base)})
+        from dgmc_tpu.obs.trace import export_chrome_trace
+        with self._probe_lock:
+            # Snapshot: the deque may receive callback-thread appends
+            # while the exporter iterates.
+            probe_records = list(self._probe_records)
+        export_chrome_trace(
+            os.path.join(self.dir, 'trace.json'),
+            step_spans=self.timer.spans,
+            probe_records=probe_records,
+            compile_events=self._watcher.events if self._watcher else (),
+            sections=self._sections,
+            metadata={'argv': sys.argv})
 
     def close(self):
+        # Probe teardown first, and independent of `enabled`: a
+        # coordinator-gated observer (obs_dir=None) still flipped the
+        # global switch in __init__ and must restore it.
+        if self._probe_sink is not None or self._probes_enabled_by_me:
+            # Drain in-flight debug callbacks BEFORE detaching the sink:
+            # on async-dispatch backends the last step's probe records
+            # (possibly including the run's only non-finite) are still
+            # queued on jax's host-callback thread when the training
+            # loop returns.
+            try:
+                import jax
+                jax.effects_barrier()
+            except Exception:
+                pass
+        if self._probe_sink is not None:
+            probes_mod.remove_sink(self._probe_sink)
+            self._probe_sink = None
+        if self._probes_enabled_by_me:
+            probes_mod.disable()
+            self._probes_enabled_by_me = False
         if not self.enabled:
             return
         self.snapshot_memory('end')

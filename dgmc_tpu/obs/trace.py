@@ -1,0 +1,184 @@
+"""Trace export: run telemetry as Chrome-trace/Perfetto JSON, and the
+``--profile-dir`` profiler flag.
+
+Two complementary trace sources:
+
+1. **Host-side run trace** (:func:`export_chrome_trace`): the telemetry
+   the :class:`~dgmc_tpu.obs.run.RunObserver` already collects — step
+   spans, compile events, benchmark sections, probe events — serialized
+   in the Chrome trace-event format. Open ``<obs_dir>/trace.json`` in
+   `Perfetto <https://ui.perfetto.dev>`_ (or ``chrome://tracing``): steps
+   render as duration slices, XLA compiles as slices on their own track,
+   and every numeric probe (``corr_entropy``, ``consensus_delta``,
+   ``grad_norm``, ...) as a counter track — the sharpening curve drawn
+   over the run's real timeline. No jax import needed; this works on a
+   box that only has the artifacts.
+2. **Device-side profiler trace** (:func:`profile_span` behind
+   ``--profile-dir`` on the experiment CLIs and ``bench.py``):
+   ``jax.profiler.trace`` over the whole run, viewable in
+   TensorBoard/Perfetto, where the model's ``jax.named_scope`` stage
+   annotations (``psi1`` -> ``initial_corr``/``topk`` ->
+   ``consensus_iter``/``psi2``) label the XLA ops. This is the
+   MXU-idle/HBM-stall view; the run trace above is the what-did-the-host
+   -do view.
+
+The trace-event records follow the documented Chrome format: ``ph: 'X'``
+complete events with microsecond ``ts``/``dur``, ``ph: 'C'`` counters,
+``ph: 'i'`` instants.
+"""
+
+import atexit
+import contextlib
+import json
+import math
+import os
+
+#: Track ids inside the single "dgmc run" process row.
+_TID_STEPS = 1
+_TID_COMPILE = 2
+_TID_SECTIONS = 3
+_PID = 1
+
+
+def _us(t, origin):
+    return round((t - origin) * 1e6, 1)
+
+
+def chrome_events(step_spans=(), probe_records=(), compile_events=(),
+                  sections=()):
+    """Build the ``traceEvents`` list from host telemetry.
+
+    Args:
+        step_spans: ``(epoch_start_s, duration_s)`` pairs
+            (:attr:`StepTimer.spans <dgmc_tpu.obs.observe.StepTimer>`).
+        probe_records: probe record dicts (``probe``/``value``/``time``
+            plus optional ``stage``/``iteration``), as delivered by
+            :mod:`dgmc_tpu.obs.probes` sinks.
+        compile_events: :class:`~dgmc_tpu.obs.registry.CompileWatcher`
+            event dicts (``time`` is the event's END; ``duration_s``,
+            ``kind``, ``label``).
+        sections: ``(name, epoch_start_s, duration_s)`` triples (e.g.
+            bench.py's section ledger).
+    """
+    starts = ([t for t, _ in step_spans]
+              + [r['time'] for r in probe_records]
+              + [e['time'] - e.get('duration_s', 0.0)
+                 for e in compile_events]
+              + [t for _, t, _ in sections])
+    if not starts:
+        return []
+    origin = min(starts)
+
+    events = [
+        {'ph': 'M', 'pid': _PID, 'name': 'process_name',
+         'args': {'name': 'dgmc run'}},
+        {'ph': 'M', 'pid': _PID, 'tid': _TID_STEPS, 'name': 'thread_name',
+         'args': {'name': 'steps'}},
+        {'ph': 'M', 'pid': _PID, 'tid': _TID_COMPILE, 'name': 'thread_name',
+         'args': {'name': 'xla compile'}},
+    ]
+    if sections:
+        events.append({'ph': 'M', 'pid': _PID, 'tid': _TID_SECTIONS,
+                       'name': 'thread_name', 'args': {'name': 'sections'}})
+
+    for i, (t0, dur) in enumerate(step_spans):
+        events.append({'ph': 'X', 'pid': _PID, 'tid': _TID_STEPS,
+                       'name': f'step {i}', 'cat': 'step',
+                       'ts': _us(t0, origin), 'dur': round(dur * 1e6, 1)})
+
+    for e in compile_events:
+        dur = e.get('duration_s', 0.0)
+        events.append({'ph': 'X', 'pid': _PID, 'tid': _TID_COMPILE,
+                       'name': e.get('kind', 'compile'), 'cat': 'compile',
+                       'ts': _us(e['time'] - dur, origin),
+                       'dur': round(dur * 1e6, 1),
+                       'args': {'label': e.get('label', '')}})
+
+    for name, t0, dur in sections:
+        events.append({'ph': 'X', 'pid': _PID, 'tid': _TID_SECTIONS,
+                       'name': name, 'cat': 'section',
+                       'ts': _us(t0, origin), 'dur': round(dur * 1e6, 1)})
+
+    for r in probe_records:
+        name = r.get('probe', '?')
+        if name == 'nonfinite':
+            # Only actual failures are trace-worthy; the all-finite checks
+            # would bury the timeline under no-op instants.
+            if r.get('value'):
+                events.append({'ph': 'i', 'pid': _PID, 'tid': _TID_STEPS,
+                               'name': f'nonfinite@{r.get("stage", "?")}',
+                               'cat': 'probe', 's': 'p',
+                               'ts': _us(r['time'], origin)})
+            continue
+        v = r.get('value')
+        if not isinstance(v, (int, float)) or not math.isfinite(v):
+            # NaN/inf are not valid JSON and would make the whole trace
+            # unreadable in Perfetto — the very run worth reading. The
+            # nonfinite instants above already mark the failure.
+            continue
+        track = name if 'stage' not in r else f'{name}[{r["stage"]}]'
+        events.append({'ph': 'C', 'pid': _PID, 'name': track,
+                       'cat': 'probe', 'ts': _us(r['time'], origin),
+                       'args': {'value': v}})
+    return events
+
+
+def export_chrome_trace(path, step_spans=(), probe_records=(),
+                        compile_events=(), sections=(), metadata=None):
+    """Write a Chrome-trace JSON file; returns the number of events.
+
+    Atomic (tmp + rename) so a run killed mid-flush leaves the previous
+    complete trace, matching the other obs artifacts' contract.
+    """
+    events = chrome_events(step_spans=step_spans,
+                           probe_records=probe_records,
+                           compile_events=compile_events,
+                           sections=sections)
+    payload = {'traceEvents': events, 'displayTimeUnit': 'ms'}
+    if metadata:
+        payload['otherData'] = metadata
+    tmp = path + '.tmp'
+    with open(tmp, 'w') as f:
+        json.dump(payload, f)
+    os.replace(tmp, path)
+    return len(events)
+
+
+def add_profile_flag(parser):
+    """Register the standard ``--profile-dir`` flag on an argparse
+    parser (the whole-run ``jax.profiler.trace`` switch)."""
+    parser.add_argument(
+        '--profile-dir', '--profile_dir', dest='profile_dir', type=str,
+        default=None,
+        help='capture a jax.profiler trace of the whole run into this '
+             'directory (open in TensorBoard or ui.perfetto.dev; the '
+             'psi1/initial_corr/topk/consensus_iter/psi2 named scopes '
+             'label the pipeline stages)')
+    return parser
+
+
+@contextlib.contextmanager
+def profile_span(profile_dir):
+    """``jax.profiler.trace`` over the enclosed region; no-op when
+    ``profile_dir`` is falsy. The device-side counterpart of the
+    host-side run trace — unlike the one-step ``--profile`` flag some
+    CLIs keep, this covers everything inside the block. Host tracing
+    instruments every dispatched op, so wrap SHORT runs; on
+    syscall-filtered sandboxes the per-step overhead reaches orders of
+    magnitude."""
+    from dgmc_tpu.obs.observe import trace
+    with trace(profile_dir):
+        yield
+
+
+def start_profile(profile_dir):
+    """CLI-shaped :func:`profile_span`: enter the span now, return a
+    handle whose ``close()`` ends it — and finalize at process exit if
+    the run dies first (an exception mid-training must still leave a
+    readable trace; that failing run is exactly the one worth
+    profiling). ``close()`` is idempotent, so the success path's
+    explicit call and the ``atexit`` hook coexist."""
+    stack = contextlib.ExitStack()
+    stack.enter_context(profile_span(profile_dir))
+    atexit.register(stack.close)
+    return stack

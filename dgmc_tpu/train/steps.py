@@ -14,6 +14,7 @@ import jax
 import jax.numpy as jnp
 
 from dgmc_tpu.models import metrics
+from dgmc_tpu.obs import probes as _probes
 
 
 def _variables(state):
@@ -57,6 +58,16 @@ def make_train_step(model, loss_on_s0=False, num_steps=None, detach=None,
 
         (loss, (new_vars, S_L)), grads = jax.value_and_grad(
             loss_fn, has_aux=True)(state.params)
+        if _probes.enabled():
+            # Trace-time gate (obs/probes.py): a probe-free build lowers to
+            # byte-identical HLO (tests/obs/test_probes.py).
+            import optax
+            gnorm = optax.global_norm(grads)
+            _probes.emit('grad_norm', gnorm)
+            # order: loss precedes grad in the pipeline (forward before
+            # backward) — first-nonfinite attribution sorts on it.
+            _probes.check_finite('loss', loss, order=1000)
+            _probes.check_finite('grad', gnorm, order=1001)
         state = state.apply_gradients(grads=grads)
         if state.batch_stats:
             state = state.replace(batch_stats=new_vars['batch_stats'])

@@ -1,0 +1,170 @@
+"""Cross-run diff contract: equal runs exit 0; an injected 2x step-time
+regression (and friends) exits nonzero — the CI perf gate's teeth."""
+
+import copy
+import json
+import os
+
+import pytest
+
+from dgmc_tpu.obs import diff as diff_mod
+
+BASE_TIMINGS = {
+    'wall_s': 10.0,
+    'steps': {'steps': 50, 'mean_s': 0.1, 'p50_s': 0.1, 'p95_s': 0.12,
+              'max_s': 0.2, 'total_s': 5.0},
+    'compile': {'events': 3, 'compile_s': 2.0, 'cache_hits': 0,
+                'by_label': {}},
+    'probes': {'corr_entropy': {'count': 10, 'mean': 3.0, 'last': 2.5,
+                                'min': 2.0, 'max': 4.0}},
+}
+BASE_MEMORY = {'snapshots': [
+    {'tag': 'end', 'devices': [{'id': 0, 'peak_bytes_in_use': 1 << 30}],
+     'host': {}}]}
+BASE_DISPATCH = {'counts': [
+    {'kernel': 'topk', 'outcome': 'pallas', 'reason': 'auto-tpu',
+     'count': 1}]}
+
+
+def write_run(root, name, timings=None, memory=None, dispatch=None):
+    d = os.path.join(str(root), name)
+    os.makedirs(d, exist_ok=True)
+    with open(os.path.join(d, 'timings.json'), 'w') as f:
+        json.dump(timings or BASE_TIMINGS, f)
+    with open(os.path.join(d, 'memory.json'), 'w') as f:
+        json.dump(memory or BASE_MEMORY, f)
+    with open(os.path.join(d, 'dispatch.json'), 'w') as f:
+        json.dump(dispatch or BASE_DISPATCH, f)
+    with open(os.path.join(d, 'metrics.jsonl'), 'w') as f:
+        f.write(json.dumps({'step': 1, 'loss': 1.0}) + '\n')
+    return d
+
+
+def test_equal_runs_exit_zero(tmp_path, capsys):
+    a = write_run(tmp_path, 'a')
+    b = write_run(tmp_path, 'b')
+    assert diff_mod.main([a, b]) == 0
+    out = capsys.readouterr().out
+    assert '0 regression(s)' in out
+
+
+def test_step_time_regression_exits_nonzero(tmp_path, capsys):
+    a = write_run(tmp_path, 'a')
+    slow = copy.deepcopy(BASE_TIMINGS)
+    for k in ('mean_s', 'p50_s', 'p95_s', 'max_s'):
+        slow['steps'][k] *= 2  # the synthetic 2x step-time regression
+    b = write_run(tmp_path, 'b', timings=slow)
+    rc = diff_mod.main([a, b])
+    assert rc == 1
+    out = capsys.readouterr().out
+    assert 'REGRESSION' in out
+    # ...and the same pair passes with an explicitly relaxed threshold.
+    assert diff_mod.main([a, b, '--max-step-p50-regression', '1.5',
+                          '--max-step-p95-regression', '1.5',
+                          '--max-throughput-regression', '0.9']) == 0
+
+
+def test_compile_churn_regression(tmp_path):
+    a = write_run(tmp_path, 'a')
+    churny = copy.deepcopy(BASE_TIMINGS)
+    churny['compile']['events'] = 30
+    b = write_run(tmp_path, 'b', timings=churny)
+    assert diff_mod.main([a, b]) == 1
+    assert diff_mod.main([a, b, '--max-new-compile-events', '50']) == 0
+
+
+def test_memory_regression_and_source_mismatch(tmp_path):
+    a = write_run(tmp_path, 'a')
+    big = {'snapshots': [
+        {'tag': 'end', 'devices': [{'id': 0,
+                                    'peak_bytes_in_use': 2 << 30}],
+         'host': {}}]}
+    b = write_run(tmp_path, 'b', memory=big)
+    assert diff_mod.main([a, b]) == 1
+    # Host-RSS vs device peaks are not comparable: skipped, not failed.
+    host_only = {'snapshots': [
+        {'tag': 'end', 'devices': [],
+         'host': {'peak_rss_bytes': 3 << 30}}]}
+    c = write_run(tmp_path, 'c', memory=host_only)
+    assert diff_mod.main([a, c]) == 0
+
+
+def test_kernel_fallback_regression(tmp_path, capsys):
+    a = write_run(tmp_path, 'a')
+    fb = {'counts': [{'kernel': 'topk', 'outcome': 'fallback',
+                      'reason': 'size', 'count': 1}]}
+    b = write_run(tmp_path, 'b', dispatch=fb)
+    assert diff_mod.main([a, b]) == 1
+    assert 'fell back' in capsys.readouterr().out
+    assert diff_mod.main([a, b, '--allow-kernel-fallback']) == 0
+
+
+def test_candidate_missing_step_metrics_is_regression(tmp_path, capsys):
+    """A candidate whose step timings vanished (broken timer, died before
+    first flush) must FAIL the gate, not pass it vacuously."""
+    a = write_run(tmp_path, 'a')
+    timerless = copy.deepcopy(BASE_TIMINGS)
+    timerless['steps'] = {}
+    b = write_run(tmp_path, 'b', timings=timerless)
+    assert diff_mod.main([a, b]) == 1
+    assert 'missing from candidate' in capsys.readouterr().out
+    # The reverse (baseline never had the metric) stays a skip.
+    assert diff_mod.main([b, b]) == 0
+
+
+def test_kernel_absent_from_candidate_is_regression(tmp_path, capsys):
+    """A candidate that never reached the kernel's decision site lost
+    the Pallas path just as surely as one that fell back."""
+    a = write_run(tmp_path, 'a')
+    b = write_run(tmp_path, 'b', dispatch={'counts': []})
+    assert diff_mod.main([a, b]) == 1
+    assert 'absent' in capsys.readouterr().out
+    assert diff_mod.main([a, b, '--allow-kernel-fallback']) == 0
+
+
+def test_nonfinite_candidate_fails(tmp_path):
+    a = write_run(tmp_path, 'a')
+    poisoned = copy.deepcopy(BASE_TIMINGS)
+    poisoned['first_nonfinite'] = {'step': 7, 'stage': 'psi1'}
+    b = write_run(tmp_path, 'b', timings=poisoned)
+    assert diff_mod.main([a, b]) == 1
+
+
+def test_json_output(tmp_path, capsys):
+    a = write_run(tmp_path, 'a')
+    b = write_run(tmp_path, 'b')
+    assert diff_mod.main([a, b, '--json']) == 0
+    payload = json.loads(capsys.readouterr().out)
+    assert payload['ok'] and payload['regressions'] == 0
+    metrics = {r['metric'] for r in payload['rows']}
+    assert {'step_p50_s', 'step_p95_s', 'steps_per_sec', 'compile_events',
+            'peak_memory_bytes', 'probe[corr_entropy].mean'} <= metrics
+
+
+def test_missing_dir_is_usage_error(tmp_path):
+    a = write_run(tmp_path, 'a')
+    assert diff_mod.main([a, str(tmp_path / 'nope')]) == 2
+
+
+def test_empty_dir_is_usage_error(tmp_path):
+    a = write_run(tmp_path, 'a')
+    empty = tmp_path / 'empty'
+    empty.mkdir()
+    assert diff_mod.main([a, str(empty)]) == 2
+
+
+@pytest.mark.parametrize('probe_fallback', [True, False])
+def test_probe_aggregates_from_metrics_fallback(tmp_path, probe_fallback):
+    """Probe aggregates reach the diff even when timings.json predates
+    the probe layer (rebuilt from the metrics.jsonl series)."""
+    from dgmc_tpu.obs.report import load_run, summarize
+    t = copy.deepcopy(BASE_TIMINGS)
+    if probe_fallback:
+        del t['probes']
+    d = write_run(tmp_path, 'x', timings=t)
+    if probe_fallback:
+        with open(os.path.join(d, 'metrics.jsonl'), 'a') as f:
+            f.write(json.dumps({'step': 1, 'probe': 'corr_entropy',
+                                'value': 3.0}) + '\n')
+    s = summarize(load_run(d))
+    assert 'corr_entropy' in s['probes']

@@ -1,0 +1,220 @@
+"""In-graph probe contract tests.
+
+The two halves of the probes contract (obs/probes.py):
+
+1. **Zero overhead disabled** — with the trace-time switch off (the
+   default), lowering the jitted train step must produce HLO that is
+   byte-identical to a build whose probe call sites are stubbed out
+   entirely, and must contain no host callbacks. The probe layer being
+   *off* must be indistinguishable from it never having been written.
+2. **Full series enabled** — with a sink registered, one executed train
+   step streams the whole diagnostic set: correspondence entropy (S0 /
+   per-iteration / SL), top-k mass, per-iteration consensus-delta norms,
+   gradient global-norm, and per-stage finiteness flags with first-
+   offender attribution through the RunObserver.
+"""
+
+import collections
+
+import jax
+import numpy as np
+import pytest
+
+from dgmc_tpu.models import DGMC, GIN, RelCNN
+from dgmc_tpu.obs import probes
+from dgmc_tpu.ops.graph import GraphBatch
+from dgmc_tpu.train import create_train_state, make_train_step
+from dgmc_tpu.utils.data import PairBatch
+
+
+def _side(rng, n, e, c=4, nan=False):
+    x = rng.randn(1, n, c).astype(np.float32)
+    if nan:
+        x[0, 0, 0] = np.nan
+    return GraphBatch(
+        x=x,
+        senders=rng.randint(0, n, (1, e)).astype(np.int32),
+        receivers=rng.randint(0, n, (1, e)).astype(np.int32),
+        node_mask=np.ones((1, n), bool),
+        edge_mask=np.ones((1, e), bool),
+        edge_attr=None)
+
+
+def _fixture(k, nan=False, num_steps=2):
+    rng = np.random.RandomState(0)
+    batch = PairBatch(s=_side(rng, 8, 16, nan=nan), t=_side(rng, 10, 20),
+                      y=(np.arange(8, dtype=np.int32) % 10)[None],
+                      y_mask=np.ones((1, 8), bool))
+    model = DGMC(RelCNN(4, 8, num_layers=1), RelCNN(4, 4, num_layers=1),
+                 num_steps=num_steps, k=k)
+    state = create_train_state(model, jax.random.key(0), batch,
+                               learning_rate=1e-3)
+    return model, state, batch
+
+
+def _lower_text(model, state, batch):
+    step = make_train_step(model)
+    return step.lower(state, batch, jax.random.key(1)).as_text()
+
+
+@pytest.mark.parametrize('k', [-1, 3])
+def test_disabled_probes_are_zero_overhead(k, monkeypatch):
+    """Probes off: no host callbacks, HLO byte-identical to a build with
+    every probe call site stubbed to a no-op (the no-probe baseline)."""
+    assert not probes.enabled()
+    model, state, batch = _fixture(k)
+    off = _lower_text(model, state, batch)
+    assert 'callback' not in off, 'disabled probes leaked host callbacks'
+
+    # The no-probe baseline: emit/check_finite physically removed.
+    monkeypatch.setattr(probes, 'emit', lambda *a, **kw: None)
+    monkeypatch.setattr(probes, 'check_finite', lambda *a, **kw: None)
+    baseline = _lower_text(model, state, batch)
+    assert off == baseline, ('disabled probes changed the lowered train '
+                             'step vs a probe-free build')
+
+
+@pytest.mark.parametrize('k', [-1, 3])
+def test_enabled_probes_lower_callbacks(k):
+    model, state, batch = _fixture(k)
+    with probes.activated(probes.ProbeLog()):
+        on = _lower_text(model, state, batch)
+    assert 'callback' in on
+
+
+@pytest.mark.parametrize('k', [-1, 3])
+def test_enabled_probes_stream_full_series(k):
+    model, state, batch = _fixture(k)
+    log = probes.ProbeLog()
+    with probes.activated(log):
+        step = make_train_step(model)
+        _, out = step(state, batch, jax.random.key(1))
+        jax.block_until_ready(out['loss'])
+
+    names = collections.Counter(r['probe'] for r in log.records)
+    # S0 + SL + one per consensus iteration.
+    assert names['corr_entropy'] == 2 + model.num_steps
+    assert names['topk_mass'] == 2
+    assert names['consensus_delta'] == model.num_steps
+    assert names['grad_norm'] == 1
+    # psi1, initial_corr, one per iteration, grad, loss.
+    assert names['nonfinite'] == 4 + model.num_steps
+
+    by_iter = [r for r in log.by_name('consensus_delta')]
+    assert sorted(r['iteration'] for r in by_iter) == [0, 1]
+    for r in log.records:
+        assert np.isfinite(r['value'])
+        assert r['probe'] != 'nonfinite' or r['value'] == 0.0
+    # Probabilities: mass in [0, 1], entropy bounded by log of the row
+    # width (dense: N_t, sparse: candidate count).
+    for r in log.by_name('topk_mass'):
+        assert 0.0 <= r['value'] <= 1.0 + 1e-5
+    width = 10 if k == -1 else k
+    for r in log.by_name('corr_entropy'):
+        assert 0.0 <= r['value'] <= np.log(width) + 1e-5
+
+
+def test_nonfinite_first_stage_attribution(tmp_path):
+    """A NaN in the inputs must be attributed to the FIRST stage that saw
+    it (psi1), through the RunObserver's first_nonfinite record."""
+    from dgmc_tpu.obs import RunObserver
+    model, state, batch = _fixture(k=-1, nan=True)
+    obs = RunObserver(str(tmp_path / 'obs'), probes=True)
+    with obs:
+        step = make_train_step(model)
+        with obs.step():
+            _, out = step(state, batch, jax.random.key(1))
+        jax.block_until_ready(out['loss'])
+    assert obs.first_nonfinite is not None
+    assert obs.first_nonfinite['stage'] == 'psi1'
+    assert not probes.enabled(), 'RunObserver leaked the probe switch'
+
+
+def test_eval_step_emits_no_probes():
+    """Probes document the TRAIN step: an eval forward (train=False) must
+    stay probe-free even with the switch on — eval batches polluting the
+    aggregates could trip the CI non-finite gate on an eval-only NaN."""
+    from dgmc_tpu.train import make_eval_step
+    model, state, batch = _fixture(k=-1)
+    log = probes.ProbeLog()
+    with probes.activated(log):
+        eval_step = make_eval_step(model)
+        out = eval_step(state, batch, jax.random.key(1))
+        jax.block_until_ready(out['correct'])
+    assert log.records == []
+
+
+def test_nonfinite_attribution_uses_pipeline_order_not_arrival():
+    """Callbacks are unordered: a later-arriving check from an EARLIER
+    pipeline stage must win the first-offender slot within a step."""
+    from dgmc_tpu.obs import RunObserver
+    obs = RunObserver.__new__(RunObserver)
+    import collections
+    import threading
+    obs.enabled = False
+    obs._probe_lock = threading.Lock()
+    obs._probe_agg = probes.Aggregator()
+    obs._probe_records = collections.deque(maxlen=10)
+    obs._step_index = 0
+    obs.first_nonfinite = None
+    from dgmc_tpu.obs.observe import MetricLogger
+    obs._metrics = MetricLogger(None)
+    # grad's callback lands first, psi1's second — psi1 must win.
+    obs._on_probe({'probe': 'nonfinite', 'value': 1.0, 'time': 0.0,
+                   'stage': 'grad', 'order': 1001})
+    obs._on_probe({'probe': 'nonfinite', 'value': 1.0, 'time': 0.0,
+                   'stage': 'psi1', 'order': 0})
+    assert obs.first_nonfinite['stage'] == 'psi1'
+    # ...but an earlier STEP always beats a lower order.
+    obs._step_index = 3
+    obs._on_probe({'probe': 'nonfinite', 'value': 1.0, 'time': 0.0,
+                   'stage': 'psi1', 'order': 0})
+    assert obs.first_nonfinite['step'] == 0
+
+
+def test_probe_metric_helpers():
+    import jax.numpy as jnp
+    uniform = jnp.full((1, 4, 8), 1.0 / 8)
+    np.testing.assert_allclose(float(probes.entropy(uniform)), np.log(8),
+                               rtol=1e-6)
+    onehot = jax.nn.one_hot(jnp.zeros((1, 4), jnp.int32), 8)
+    np.testing.assert_allclose(float(probes.entropy(onehot)), 0.0,
+                               atol=1e-6)
+    np.testing.assert_allclose(float(probes.topk_mass(uniform, 2)), 0.25,
+                               rtol=1e-6)
+    np.testing.assert_allclose(float(probes.topk_mass(onehot, 2)), 1.0,
+                               rtol=1e-6)
+    # Row mask drops padded rows from the mean.
+    mask = jnp.array([[True, True, False, False]])
+    mixed = jnp.concatenate([uniform[:, :2], onehot[:, 2:]], axis=1)
+    np.testing.assert_allclose(float(probes.entropy(mixed, mask)),
+                               np.log(8), rtol=1e-6)
+    np.testing.assert_allclose(
+        float(probes.delta_norm(uniform, uniform)), 0.0, atol=1e-7)
+
+
+def test_emit_thunk_not_evaluated_when_disabled():
+    """The lazy-value contract: a disabled emit must not even evaluate
+    its thunk (that is what keeps the metric math out of the HLO)."""
+    assert not probes.enabled()
+    calls = []
+    probes.emit('x', lambda: calls.append(1))
+    assert calls == []
+
+
+def test_gin_backbone_probes_smoke():
+    """Probes ride along any backbone, not just RelCNN."""
+    rng = np.random.RandomState(2)
+    batch = PairBatch(s=_side(rng, 6, 12), t=_side(rng, 6, 12),
+                      y=np.arange(6, dtype=np.int32)[None],
+                      y_mask=np.ones((1, 6), bool))
+    model = DGMC(GIN(4, 8, num_layers=1), GIN(4, 4, num_layers=1),
+                 num_steps=1, k=-1)
+    state = create_train_state(model, jax.random.key(0), batch,
+                               learning_rate=1e-3)
+    log = probes.ProbeLog()
+    with probes.activated(log):
+        step = make_train_step(model)
+        _, out = step(state, batch, jax.random.key(1))
+        jax.block_until_ready(out['loss'])
+    assert log.by_name('corr_entropy') and log.by_name('grad_norm')

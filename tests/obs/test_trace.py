@@ -1,0 +1,107 @@
+"""Chrome-trace export contract: valid trace-event JSON from host
+telemetry, and the ``trace.json`` artifact of an observed run."""
+
+import json
+
+import jax
+import numpy as np
+
+from dgmc_tpu.obs import StepTimer, export_chrome_trace
+from dgmc_tpu.obs.trace import chrome_events
+
+
+def test_step_timer_records_spans():
+    t = StepTimer()
+    t.start()
+    t.stop()
+    t.start()
+    t.stop()
+    assert len(t.spans) == 2
+    for (wall0, dur), rec in zip(t.spans, t.times):
+        assert dur == rec and dur >= 0
+        assert wall0 > 1e9  # epoch seconds, not perf_counter origin
+
+
+def test_chrome_events_shape():
+    base = 1_700_000_000.0
+    evs = chrome_events(
+        step_spans=[(base, 0.25), (base + 0.3, 0.2)],
+        probe_records=[
+            {'probe': 'corr_entropy', 'value': 3.5, 'time': base + 0.1,
+             'stage': 'S0'},
+            {'probe': 'grad_norm', 'value': 1.0, 'time': base + 0.2},
+            {'probe': 'nonfinite', 'value': 0.0, 'time': base + 0.21,
+             'stage': 'psi1'},
+            {'probe': 'nonfinite', 'value': 1.0, 'time': base + 0.22,
+             'stage': 'grad'},
+        ],
+        compile_events=[{'time': base + 0.05, 'duration_s': 0.04,
+                         'kind': 'backend_compile', 'label': 'epoch1'}],
+        sections=[('dense_f32', base, 0.5)])
+
+    steps = [e for e in evs if e.get('cat') == 'step']
+    assert [e['name'] for e in steps] == ['step 0', 'step 1']
+    assert all(e['ph'] == 'X' and e['ts'] >= 0 and e['dur'] > 0
+               for e in steps)
+    counters = [e for e in evs if e['ph'] == 'C']
+    assert {e['name'] for e in counters} == {'corr_entropy[S0]',
+                                             'grad_norm'}
+    # Only the FIRING nonfinite check becomes an instant.
+    instants = [e for e in evs if e['ph'] == 'i']
+    assert [e['name'] for e in instants] == ['nonfinite@grad']
+    compiles = [e for e in evs if e.get('cat') == 'compile']
+    assert compiles and compiles[0]['args']['label'] == 'epoch1'
+    sections = [e for e in evs if e.get('cat') == 'section']
+    assert sections and sections[0]['name'] == 'dense_f32'
+    # ts are relative to the earliest event: none negative.
+    assert min(e.get('ts', 0) for e in evs) >= 0
+
+
+def test_chrome_events_empty():
+    assert chrome_events() == []
+
+
+def test_export_chrome_trace_file(tmp_path):
+    path = str(tmp_path / 'trace.json')
+    n = export_chrome_trace(path, step_spans=[(1e9, 0.1)],
+                            metadata={'argv': ['x']})
+    with open(path) as f:
+        payload = json.load(f)
+    assert len(payload['traceEvents']) == n
+    assert payload['otherData'] == {'argv': ['x']}
+    assert payload['displayTimeUnit'] == 'ms'
+
+
+def test_run_observer_writes_trace_artifact(tmp_path):
+    """An observed run leaves a loadable trace.json holding its steps
+    and probe counters alongside the other artifacts."""
+    from dgmc_tpu.obs import RunObserver
+
+    obs = RunObserver(str(tmp_path / 'obs'), probes=True)
+    with obs:
+        from dgmc_tpu.obs import probes as probes_mod
+
+        @jax.jit
+        def f(x):
+            probes_mod.emit('corr_entropy', x.sum(), stage='S0')
+            return x * 2
+
+        with obs.step():
+            jax.block_until_ready(f(np.ones(4, np.float32)))
+        obs.log(0, loss=1.0)
+    with open(tmp_path / 'obs' / 'trace.json') as f:
+        payload = json.load(f)
+    cats = {e.get('cat') for e in payload['traceEvents']}
+    assert 'step' in cats
+    assert any(e['ph'] == 'C' and e['name'] == 'corr_entropy[S0]'
+               for e in payload['traceEvents'])
+    # Probe aggregates surfaced in timings.json for report/diff.
+    with open(tmp_path / 'obs' / 'timings.json') as f:
+        timings = json.load(f)
+    assert timings['probes']['corr_entropy']['count'] == 1
+
+
+def test_profile_span_noop_without_dir():
+    from dgmc_tpu.obs import profile_span
+    with profile_span(None):
+        pass
