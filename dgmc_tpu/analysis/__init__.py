@@ -1,0 +1,57 @@
+"""Static TPU-hostility analysis over jaxprs, compiled executables, and
+repo source.
+
+Every hazard this repo has been bitten by so far — the jax-0.4.37
+persistent-cache donation-aliasing corruption, silent Pallas→XLA kernel
+fallbacks, compile churn across padding buckets, host callbacks leaking
+into "probe-free" steps — surfaced at *runtime*, usually
+nondeterministically. This subsystem catches those defect classes before
+a run is launched, in two tiers:
+
+- **trace tier** (:mod:`~dgmc_tpu.analysis.jaxpr_rules`,
+  :mod:`~dgmc_tpu.analysis.registry`): lower the registered hot
+  functions (DGMC forward, train/eval steps, fused ops, sharded steps)
+  under representative shape/dtype/mesh configs and walk the
+  ClosedJaxpr + compiled executable for dtype drift, giant baked-in
+  constants, host-sync callbacks, dropped donation aliasing, and
+  TPU-pathological lowerings.
+- **source tier** (:mod:`~dgmc_tpu.analysis.source_rules`): ``ast``
+  lints over the package source for tracer leaks, host syncs inside
+  jitted code, jit-inside-loop construction, and static-arg
+  hashability traps.
+
+A recompile-hazard pass (:mod:`~dgmc_tpu.analysis.recompile`) hashes
+abstract step signatures across padding buckets and cross-checks them
+against the ``obs`` compile telemetry of a recorded run.
+
+CLI: ``python -m dgmc_tpu.analysis.lint`` (installed as ``dgmc-lint``),
+with ``--json``, severity levels, and a committed baseline-suppression
+file (``lint-baseline.json``) so known findings don't fail CI while new
+ones do (``--fail-on new``).
+"""
+
+from dgmc_tpu.analysis.findings import (Finding, Severity, load_baseline,
+                                        write_baseline, split_by_baseline)
+from dgmc_tpu.analysis.jaxpr_rules import (analyze_closed_jaxpr,
+                                           analyze_donation,
+                                           callback_equations)
+from dgmc_tpu.analysis.source_rules import lint_source_tree, lint_source_file
+from dgmc_tpu.analysis.recompile import analyze_buckets, bucket_signature
+from dgmc_tpu.analysis.registry import default_specimens, run_trace_tier
+
+__all__ = [
+    'Finding',
+    'Severity',
+    'load_baseline',
+    'write_baseline',
+    'split_by_baseline',
+    'analyze_closed_jaxpr',
+    'analyze_donation',
+    'callback_equations',
+    'lint_source_tree',
+    'lint_source_file',
+    'analyze_buckets',
+    'bucket_signature',
+    'default_specimens',
+    'run_trace_tier',
+]

@@ -1,0 +1,166 @@
+"""Finding/severity model and the baseline-suppression file.
+
+A :class:`Finding` is one detected hazard: a rule id, a severity, a
+``where`` (stable location — ``specimen:file:line`` for trace findings,
+``file:line`` for source findings), and a message. Its
+:attr:`~Finding.fingerprint` is a stable hash of the identity fields
+(never the free-text detail), so a committed baseline keeps suppressing
+a finding across unrelated edits but releases it the moment the finding
+moves or changes class.
+
+The baseline file (``lint-baseline.json``) is the reviewed debt ledger:
+``dgmc-lint --write-baseline`` records the current findings;
+``dgmc-lint --fail-on new`` then fails only on findings whose
+fingerprint is not in the ledger. Pure Python — no jax — so the CLI can
+report and diff baselines anywhere.
+"""
+
+import dataclasses
+import enum
+import hashlib
+import json
+import os
+from typing import Dict, Iterable, List, Optional, Tuple
+
+
+class Severity(enum.IntEnum):
+    """Ordered severity; comparisons follow the int value."""
+    INFO = 10
+    WARNING = 20
+    ERROR = 30
+
+    @classmethod
+    def parse(cls, name):
+        try:
+            return cls[str(name).upper()]
+        except KeyError:
+            raise ValueError(
+                f'unknown severity {name!r}; expected one of '
+                f'{[s.name.lower() for s in cls]}') from None
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One detected hazard.
+
+    Args:
+        rule: stable rule id (``TRC001``, ``SRC101``, ``RCP201``...).
+        severity: :class:`Severity`.
+        where: stable location string; trace findings use
+            ``specimen:relative/file.py:line``, source findings
+            ``relative/file.py:line``.
+        message: one-line human description (identity-bearing: part of
+            the fingerprint, so keep it deterministic).
+        detail: free-form extra context (NOT fingerprinted — safe to
+            enrich without invalidating baselines).
+    """
+    rule: str
+    severity: Severity
+    where: str
+    message: str
+    detail: Optional[str] = None
+
+    @property
+    def fingerprint(self) -> str:
+        ident = f'{self.rule}|{self.where}|{self.message}'
+        return hashlib.sha256(ident.encode()).hexdigest()[:16]
+
+    def to_json(self) -> dict:
+        out = {
+            'rule': self.rule,
+            'severity': self.severity.name.lower(),
+            'where': self.where,
+            'message': self.message,
+            'fingerprint': self.fingerprint,
+        }
+        if self.detail:
+            out['detail'] = self.detail
+        return out
+
+
+def sort_findings(findings: Iterable[Finding]) -> List[Finding]:
+    """Severity-descending, then stable by (rule, where, message)."""
+    return sorted(findings,
+                  key=lambda f: (-int(f.severity), f.rule, f.where,
+                                 f.message))
+
+
+# ---------------------------------------------------------------------------
+# Baseline file
+# ---------------------------------------------------------------------------
+
+BASELINE_VERSION = 1
+DEFAULT_BASELINE_NAME = 'lint-baseline.json'
+
+
+def default_baseline_path(start: Optional[str] = None) -> str:
+    """Walk up from ``start`` (default cwd) looking for an existing
+    baseline file; fall back to the repo root guess (the directory
+    holding the ``dgmc_tpu`` package), else ``cwd/<name>``."""
+    d = os.path.abspath(start or os.getcwd())
+    while True:
+        cand = os.path.join(d, DEFAULT_BASELINE_NAME)
+        if os.path.exists(cand):
+            return cand
+        parent = os.path.dirname(d)
+        if parent == d:
+            break
+        d = parent
+    pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    repo_guess = os.path.join(os.path.dirname(pkg_root),
+                              DEFAULT_BASELINE_NAME)
+    if os.path.exists(repo_guess):
+        return repo_guess
+    return os.path.join(os.path.abspath(start or os.getcwd()),
+                        DEFAULT_BASELINE_NAME)
+
+
+def load_baseline(path: str) -> Dict[str, dict]:
+    """``{fingerprint: recorded entry}`` — empty when the file is absent."""
+    if not path or not os.path.exists(path):
+        return {}
+    with open(path) as f:
+        data = json.load(f)
+    if data.get('version') != BASELINE_VERSION:
+        raise ValueError(
+            f'{path}: unsupported baseline version {data.get("version")!r} '
+            f'(this dgmc-lint writes version {BASELINE_VERSION})')
+    return {e['fingerprint']: e for e in data.get('findings', [])}
+
+
+def write_baseline(path: str, findings: Iterable[Finding],
+                   preserved_entries: Iterable[dict] = ()) -> dict:
+    """Write the suppression ledger (sorted, stable) and return it.
+
+    ``preserved_entries`` are raw prior-baseline entries to carry over
+    verbatim — the entries of tiers/specimens the writing run did not
+    analyze (skipped tier, too few devices), so refreshing the baseline
+    in a smaller environment cannot silently un-suppress findings that
+    a bigger environment (CI's 8-device mesh) will still produce.
+    """
+    entries = {e['fingerprint']: dict(e) for e in preserved_entries}
+    for f in sort_findings(findings):
+        entries[f.fingerprint] = f.to_json()
+    payload = {
+        'version': BASELINE_VERSION,
+        'tool': 'dgmc-lint',
+        'findings': sorted(entries.values(),
+                           key=lambda e: (e['rule'], e['where'],
+                                          e['message'])),
+    }
+    tmp = path + '.tmp'
+    with open(tmp, 'w') as f:
+        json.dump(payload, f, indent=1, sort_keys=True)
+        f.write('\n')
+    os.replace(tmp, path)
+    return payload
+
+
+def split_by_baseline(findings: Iterable[Finding],
+                      baseline: Dict[str, dict],
+                      ) -> Tuple[List[Finding], List[Finding]]:
+    """Partition into (new, suppressed) against a loaded baseline."""
+    new, suppressed = [], []
+    for f in findings:
+        (suppressed if f.fingerprint in baseline else new).append(f)
+    return new, suppressed

@@ -1,0 +1,355 @@
+"""Trace-tier rules: walk ClosedJaxprs and compiled executables.
+
+Rule ids (see ``docs/source/modules/analysis.rst`` for the catalog):
+
+``TRC001`` dtype-promotion
+    An equation *introduces* a 64-bit result (f64 / i64 / u64 / c128)
+    from non-64-bit inputs. The whole pipeline is 32-bit-or-narrower by
+    design (TPUs have no f64 units — XLA emulates at >10x cost), so any
+    64-bit value is drift, flagged at the equation that created it with
+    per-equation source provenance.
+``TRC002`` giant-constant
+    A constant folded into the program exceeds a byte threshold. Big
+    baked-in arrays bloat every serialized executable, defeat donation,
+    and usually mean a dataset/table was closed over instead of being
+    passed as an argument.
+``TRC003`` host-callback
+    A host-callback equation (``debug_callback`` / ``pure_callback`` /
+    ``io_callback``...) is present in a program expected to be
+    callback-free. The obs probe layer guarantees byte-identical HLO
+    with probes disabled (PR 3); a callback here means a probe (or a
+    stray ``jax.debug.print``) leaked past its trace-time gate and will
+    fence device->host every step.
+``TRC004`` donation-dropped
+    An argument was donated but the compiled executable retains no
+    input-output aliasing for it. Donation silently degrades to a copy
+    — and dropped/broken aliasing is exactly the defect class of the
+    jax-0.4.37 persistent-cache bug root-caused in PR 3 (executables
+    deserialized with broken aliasing read freed buffers). This is the
+    static tripwire: a *fresh* compile must alias, or the step was never
+    entitled to donate.
+``TRC005`` pathological-scatter
+    A scatter without ``unique_indices`` — lowered serially (or via
+    atomics) on TPU. Inherent to GNN aggregation in places; the
+    committed baseline carries the reviewed ones, the rule catches new
+    introductions.
+``TRC006`` large-sort
+    A ``sort``/``top_k``-free path regressed into sorting a large axis
+    (e.g. a dense argsort where the streaming top-k was intended).
+"""
+
+import dataclasses
+import re
+import warnings
+from typing import Iterator, List, Optional, Tuple
+
+import jax
+from jax import core as jax_core
+
+from dgmc_tpu.analysis.findings import Finding, Severity
+
+#: Primitive names that fence the host. Matched exactly or by suffix.
+CALLBACK_PRIMITIVES = ('debug_callback', 'pure_callback', 'io_callback',
+                       'outside_call', 'debug_print')
+
+#: 64-bit dtypes that must never appear (the repo is <=32-bit by design).
+_WIDE = ('float64', 'int64', 'uint64', 'complex128')
+
+DEFAULT_CONST_BYTES = 1 << 20       # 1 MiB
+DEFAULT_SORT_DIM = 4096
+
+
+@dataclasses.dataclass
+class TraceContext:
+    """Provenance prefix + thresholds for one analyzed program."""
+    specimen: str = 'program'
+    const_bytes: int = DEFAULT_CONST_BYTES
+    sort_dim: int = DEFAULT_SORT_DIM
+    expect_no_callbacks: bool = True
+
+
+# ---------------------------------------------------------------------------
+# jaxpr walking
+# ---------------------------------------------------------------------------
+
+
+def _closed_subjaxprs(params) -> Iterator[jax_core.ClosedJaxpr]:
+    for v in params.values():
+        if isinstance(v, jax_core.ClosedJaxpr):
+            yield v
+        elif isinstance(v, (tuple, list)):
+            for x in v:
+                if isinstance(x, jax_core.ClosedJaxpr):
+                    yield x
+
+
+def iter_equations(jaxpr) -> Iterator[jax_core.JaxprEqn]:
+    """Every equation of ``jaxpr`` (Jaxpr or ClosedJaxpr), recursively
+    through call/scan/cond/pjit sub-jaxprs."""
+    if isinstance(jaxpr, jax_core.ClosedJaxpr):
+        jaxpr = jaxpr.jaxpr
+    for eqn in jaxpr.eqns:
+        yield eqn
+        for sub in _closed_subjaxprs(eqn.params):
+            yield from iter_equations(sub)
+
+
+def _iter_consts(closed) -> Iterator[Tuple[object, str]]:
+    """(const, owner) for the closed jaxpr and every nested ClosedJaxpr."""
+    for c in closed.consts:
+        yield c, 'top-level'
+    for eqn in iter_equations(closed):
+        for sub in _closed_subjaxprs(eqn.params):
+            for c in sub.consts:
+                yield c, eqn.primitive.name
+
+
+def eqn_provenance(eqn) -> str:
+    """``relative/file.py:line`` of the first user frame that created the
+    equation; ``<unknown>`` when source info is unavailable."""
+    try:
+        from jax._src import source_info_util
+        frame = source_info_util.user_frame(eqn.source_info)
+    except Exception:
+        frame = None
+    if frame is None:
+        return '<unknown>'
+    fname = frame.file_name
+    # Stable across checkouts/venvs: keep the path from the last
+    # site-packages / repo-root-ish component.
+    for marker in ('site-packages/', 'dist-packages/'):
+        if marker in fname:
+            fname = fname.split(marker, 1)[1]
+            break
+    else:
+        parts = fname.split('/')
+        for anchor in ('dgmc_tpu', 'tests', 'examples', 'benchmarks'):
+            if anchor in parts:
+                fname = '/'.join(parts[parts.index(anchor):])
+                break
+    return f'{fname}:{frame.start_line}'
+
+
+def _aval_of(var):
+    aval = getattr(var, 'aval', None)
+    return aval
+
+
+def _is_wide(aval) -> bool:
+    dtype = getattr(aval, 'dtype', None)
+    return dtype is not None and str(dtype) in _WIDE
+
+
+# ---------------------------------------------------------------------------
+# Rules over a ClosedJaxpr
+# ---------------------------------------------------------------------------
+
+
+def check_dtype_promotion(closed, ctx: TraceContext) -> List[Finding]:
+    sites = {}
+    for eqn in iter_equations(closed):
+        wide_out = [v for v in eqn.outvars if _is_wide(_aval_of(v))]
+        if not wide_out:
+            continue
+        if any(_is_wide(_aval_of(v)) for v in eqn.invars):
+            continue  # propagation, not introduction — flagged upstream
+        dtypes = tuple(sorted({str(_aval_of(v).dtype) for v in wide_out}))
+        key = (eqn.primitive.name, eqn_provenance(eqn), dtypes)
+        n, example = sites.get(key, (0, str(eqn)[:300]))
+        sites[key] = (n + 1, example)
+    return [
+        Finding(
+            rule='TRC001', severity=Severity.ERROR,
+            where=f'{ctx.specimen}:{prov}',
+            message=(f'64-bit value introduced by `{prim}` '
+                     f'({", ".join(dtypes)}) in a <=32-bit pipeline'),
+            detail=f'{n} equation(s) at this site; e.g. {example}')
+        for (prim, prov, dtypes), (n, example) in sorted(sites.items())]
+
+
+def check_giant_constants(closed, ctx: TraceContext) -> List[Finding]:
+    # Identity fields (where/message) carry only the structural facts —
+    # shape, dtype, and an index discriminating same-shaped constants —
+    # so fingerprints neither drift with byte-size rounding nor collide
+    # when a SECOND identically-shaped giant constant appears (which
+    # must show up as a new finding, not hide under the baselined one).
+    out = []
+    seen_ids = set()
+    per_shape = {}
+    for const, owner in _iter_consts(closed):
+        nbytes = getattr(const, 'nbytes', 0)
+        shape = tuple(getattr(const, 'shape', ()) or ())
+        if not nbytes or nbytes < ctx.const_bytes:
+            continue
+        if id(const) in seen_ids:
+            continue
+        seen_ids.add(id(const))
+        dtype = getattr(const, 'dtype', '?')
+        idx = per_shape.get((shape, str(dtype)), 0)
+        per_shape[(shape, str(dtype))] = idx + 1
+        out.append(Finding(
+            rule='TRC002', severity=Severity.WARNING,
+            where=f'{ctx.specimen}:const{list(shape)}#{idx}',
+            message=(f'giant constant (shape {shape}, dtype {dtype}) '
+                     f'baked into the program'),
+            detail=f'{nbytes / (1 << 20):.1f} MiB, captured under '
+                   f'`{owner}`; pass it as an argument instead of '
+                   f'closing over it'))
+    return out
+
+
+def callback_equations(closed) -> List[Tuple[str, str]]:
+    """``(primitive_name, provenance)`` for every host-callback equation
+    — empty on a program honoring the probes-off byte-identical-HLO
+    guarantee."""
+    hits = []
+    for eqn in iter_equations(closed):
+        name = eqn.primitive.name
+        if name in CALLBACK_PRIMITIVES or name.endswith('_callback'):
+            hits.append((name, eqn_provenance(eqn)))
+    return hits
+
+
+def check_host_callbacks(closed, ctx: TraceContext) -> List[Finding]:
+    if not ctx.expect_no_callbacks:
+        return []
+    sites = {}
+    for name, prov in callback_equations(closed):
+        sites[(name, prov)] = sites.get((name, prov), 0) + 1
+    return [
+        Finding(
+            rule='TRC003', severity=Severity.ERROR,
+            where=f'{ctx.specimen}:{prov}',
+            message=(f'host callback `{name}` in a program expected '
+                     f'callback-free (probes disabled) — fences '
+                     f'device->host every step'),
+            detail=f'{n} equation(s) at this site')
+        for (name, prov), n in sorted(sites.items())]
+
+
+def check_pathological_lowerings(closed, ctx: TraceContext) -> List[Finding]:
+    # One finding per code SITE (specimen + provenance + primitive), not
+    # per traced equation: a GNN layer's scatter appears once per layer,
+    # iteration, and gradient — the hazard (and its fix) lives at the
+    # source line. Occurrence counts and example shapes ride in `detail`
+    # so fingerprints stay stable as the model config changes.
+    scatters = {}
+    sorts = {}
+    for eqn in iter_equations(closed):
+        name = eqn.primitive.name
+        if name.startswith('scatter'):
+            if eqn.params.get('unique_indices', False):
+                continue
+            aval = _aval_of(eqn.outvars[0])
+            key = (name, eqn_provenance(eqn))
+            n, shapes = scatters.get(key, (0, set()))
+            shapes.add(tuple(getattr(aval, 'shape', ())))
+            scatters[key] = (n + 1, shapes)
+        elif name == 'sort':
+            aval = _aval_of(eqn.invars[0])
+            shape = tuple(getattr(aval, 'shape', ()))
+            dim = eqn.params.get('dimension', len(shape) - 1 if shape else 0)
+            if shape and shape[dim] >= ctx.sort_dim:
+                key = (name, eqn_provenance(eqn))
+                n, dims_seen = sorts.get(key, (0, set()))
+                dims_seen.add(shape[dim])
+                sorts[key] = (n + 1, dims_seen)
+    out = []
+    for (name, prov), (n, shapes) in sorted(scatters.items()):
+        out.append(Finding(
+            rule='TRC005', severity=Severity.INFO,
+            where=f'{ctx.specimen}:{prov}',
+            message=(f'`{name}` without unique_indices — serial/atomic '
+                     f'lowering on TPU'),
+            detail=(f'{n} equation(s) at this site, out shapes '
+                    f'{sorted(shapes)}; inherent to unsorted segment '
+                    f'aggregation — prefer sorted/blocked forms on hot '
+                    f'paths')))
+    for (name, prov), (n, dims_seen) in sorted(sorts.items()):
+        out.append(Finding(
+            rule='TRC006', severity=Severity.WARNING,
+            where=f'{ctx.specimen}:{prov}',
+            message=(f'sort over axis of >= {ctx.sort_dim} elements — on '
+                     f'TPU prefer top_k / the streaming blockwise top-k'),
+            detail=f'{n} equation(s) at this site, axis sizes '
+                   f'{sorted(dims_seen)}'))
+    return out
+
+
+def analyze_closed_jaxpr(closed, ctx: Optional[TraceContext] = None,
+                         ) -> List[Finding]:
+    """All jaxpr-level rules over one ClosedJaxpr."""
+    ctx = ctx or TraceContext()
+    out = []
+    out += check_dtype_promotion(closed, ctx)
+    out += check_giant_constants(closed, ctx)
+    out += check_host_callbacks(closed, ctx)
+    out += check_pathological_lowerings(closed, ctx)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Compiled-executable rules (donation aliasing)
+# ---------------------------------------------------------------------------
+
+_DONATION_WARNING = 'donated buffers were not usable'
+_ALIAS_RE = re.compile(r'input_output_alias\s*=\s*\{')
+
+
+def analyze_donation(fn, args, kwargs=None, *, donate_argnums,
+                     specimen='program') -> List[Finding]:
+    """Compile ``fn`` with donation and verify the executable kept the
+    input-output aliasing (TRC004).
+
+    Two failure shapes are reported:
+
+    - lowering declared some donated buffers unusable (shape/dtype of the
+      donated input matches no output — the donation was never real);
+    - the *optimized executable* retains no ``input_output_alias`` entry
+      at all even though donation was requested — the static face of the
+      PR 3 cache-aliasing bug class (an executable without aliasing
+      copies; one with *broken* aliasing reads freed buffers).
+    """
+    kwargs = kwargs or {}
+    jitted = jax.jit(fn, donate_argnums=donate_argnums)
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter('always')
+        compiled = jitted.lower(*args, **kwargs).compile()
+    return compiled_donation_findings(caught, compiled, donate_argnums,
+                                      specimen)
+
+
+def compiled_donation_findings(caught_warnings, compiled, donate_argnums,
+                               specimen) -> List[Finding]:
+    """The TRC004 analysis over one compile's captured warnings + its
+    compiled executable — the single implementation shared by
+    :func:`analyze_donation` (plain functions the analyzer jits itself)
+    and the registry's pre-jitted specimens (e.g. the sharded train step
+    with its own ``in_shardings``), so the warning text and alias-syntax
+    probes cannot drift apart between the two entry points."""
+    findings = []
+    dropped = [str(w.message) for w in caught_warnings
+               if _DONATION_WARNING in str(w.message)]
+    for msg in dropped:
+        findings.append(Finding(
+            rule='TRC004', severity=Severity.ERROR,
+            where=f'{specimen}:donate{tuple(donate_argnums)}',
+            message='donated argument unusable for aliasing — donation '
+                    'silently degrades to a copy',
+            detail=msg.split('\n')[0][:300]))
+    if not dropped:
+        try:
+            text = compiled.as_text()
+        except Exception:
+            text = None
+        if text is not None and not _ALIAS_RE.search(text):
+            findings.append(Finding(
+                rule='TRC004', severity=Severity.ERROR,
+                where=f'{specimen}:donate{tuple(donate_argnums)}',
+                message='compiled executable retains NO input-output '
+                        'aliasing despite donation — donated buffers '
+                        'are copied, not reused',
+                detail='fresh compile lost aliasing; if this executable '
+                       'round-trips a persistent cache, broken aliasing '
+                       'is the PR 3 garbage-read bug class'))
+    return findings

@@ -1,0 +1,254 @@
+"""``dgmc-lint`` — the TPU-hostility linter CLI.
+
+Usage::
+
+    python -m dgmc_tpu.analysis.lint [--json] [--fail-on new]
+    dgmc-lint --write-baseline          # record current findings
+    dgmc-lint --json --fail-on new      # CI gate: fail on un-baselined
+    dgmc-lint --obs-dir runs/obs_pf     # + recompile telemetry cross-check
+
+Tiers (each skippable): ``--skip-trace`` (lower + walk the registered
+hot functions), ``--skip-source`` (ast lints over the package source),
+``--skip-recompile`` (padding-bucket churn). The recompile pass needs a
+recorded run's buckets: it runs only when ``--obs-dir`` is given —
+padding buckets are a runtime artifact, there is nothing to analyze
+statically without one.
+
+Exit status: 0 clean under the ``--fail-on`` policy, 1 otherwise, 2 on
+usage errors. ``--fail-on`` policies: ``new`` (default — findings not in
+the baseline), ``error`` (new findings at ERROR), ``any`` (any finding,
+baselined or not), ``none`` (always exit 0; report only).
+"""
+
+import argparse
+import json
+import os
+import sys
+
+from dgmc_tpu.analysis import findings as findings_mod
+from dgmc_tpu.analysis.findings import (Severity, default_baseline_path,
+                                        load_baseline, sort_findings,
+                                        split_by_baseline, write_baseline)
+
+RULE_CATALOG = {
+    'TRC001': 'dtype promotion: 64-bit value introduced in a <=32-bit '
+              'pipeline',
+    'TRC002': 'giant constant folded into the program',
+    'TRC003': 'host callback in a program expected callback-free '
+              '(probes disabled)',
+    'TRC004': 'donated argument lost its input-output aliasing',
+    'TRC005': 'scatter without unique_indices (serial/atomic on TPU)',
+    'TRC006': 'large sort where a top-k selection was intended',
+    'SRC100': 'source file failed to parse',
+    'SRC101': 'tracer leak: jitted function stores to self/global',
+    'SRC102': 'host sync inside jitted code (float/int/bool/.item/'
+              'np.asarray)',
+    'SRC103': 'jax.jit constructed inside a loop',
+    'SRC104': 'static arg with an unhashable (mutable) default',
+    'RCP201': 'padding bucket dominated by another (avoidable compile '
+              'churn)',
+    'RCP202': 'compile events exceed what padding buckets explain',
+}
+
+
+def build_parser():
+    p = argparse.ArgumentParser(
+        prog='dgmc-lint',
+        description='Static TPU-hostility analysis: jaxpr/HLO trace '
+                    'rules, source ast lints, recompile-hazard checks.')
+    p.add_argument('--json', action='store_true',
+                   help='emit the machine-readable report on stdout')
+    p.add_argument('--baseline', default=None,
+                   help='baseline-suppression file (default: nearest '
+                        f'{findings_mod.DEFAULT_BASELINE_NAME} walking '
+                        'up from cwd)')
+    p.add_argument('--write-baseline', action='store_true',
+                   help='record the current findings as the baseline '
+                        'and exit 0')
+    p.add_argument('--fail-on', choices=('new', 'error', 'any', 'none'),
+                   default='new',
+                   help='exit-1 policy (default: new — findings not in '
+                        'the baseline)')
+    p.add_argument('--min-severity', default='info',
+                   help='drop findings below this severity '
+                        '(info|warning|error)')
+    p.add_argument('--rules', default=None,
+                   help='comma-separated rule ids to keep (default all)')
+    p.add_argument('--skip-trace', action='store_true',
+                   help='skip the jaxpr/HLO trace tier')
+    p.add_argument('--skip-source', action='store_true',
+                   help='skip the source ast tier')
+    p.add_argument('--skip-recompile', action='store_true',
+                   help='skip the padding-bucket recompile pass')
+    p.add_argument('--source-root', default=None,
+                   help='source tree to lint (default: the installed '
+                        'dgmc_tpu package)')
+    p.add_argument('--obs-dir', default=None,
+                   help='recorded obs run dir: cross-check its padding '
+                        'buckets + compile telemetry (RCP202)')
+    p.add_argument('--max-const-bytes', type=int, default=None,
+                   help='TRC002 threshold in bytes (default 1 MiB)')
+    p.add_argument('--list-rules', action='store_true',
+                   help='print the rule catalog and exit')
+    return p
+
+
+def collect_findings(args, progress):
+    """``(findings, skipped_specimens)`` for the enabled tiers."""
+    out = []
+    skipped = []
+    if not args.skip_source:
+        from dgmc_tpu.analysis.source_rules import lint_source_tree
+        root = args.source_root
+        if root is None:
+            import dgmc_tpu
+            root = os.path.dirname(os.path.abspath(dgmc_tpu.__file__))
+        progress(f'source tier: {root}')
+        out.extend(lint_source_tree(root))
+    if not args.skip_recompile and args.obs_dir:
+        from dgmc_tpu.analysis.recompile import (analyze_buckets,
+                                                 load_obs_buckets)
+        buckets, events = load_obs_buckets(args.obs_dir)
+        progress(f'recompile pass: {len(buckets)} observed bucket(s) '
+                 f'from {args.obs_dir}')
+        out.extend(analyze_buckets(buckets, specimen='obs',
+                                   compile_events=events))
+        # Without an obs dir there is nothing to analyze statically —
+        # buckets are a runtime artifact. (The trace tier's fixed shapes
+        # are already one program each by construction.)
+    if not args.skip_trace:
+        from dgmc_tpu.analysis.registry import run_trace_tier
+        out.extend(run_trace_tier(const_bytes=args.max_const_bytes,
+                                  on_progress=progress, skipped=skipped))
+    return out, skipped
+
+
+def _entries_not_analyzed(prior_baseline, args, skipped_specimens):
+    """Prior-baseline entries whose producing tier/specimen this run did
+    not analyze — preserved verbatim on ``--write-baseline`` so a
+    refresh from a smaller environment (fewer devices, a skipped tier)
+    cannot silently un-suppress findings CI will still produce."""
+    skipped = set(skipped_specimens)
+    keep = []
+    for e in prior_baseline.values():
+        rule = e.get('rule', '')
+        specimen = e.get('where', '').split(':', 1)[0]
+        if rule.startswith('TRC') and (args.skip_trace
+                                       or specimen in skipped):
+            keep.append(e)
+        elif rule.startswith('SRC') and args.skip_source:
+            keep.append(e)
+        elif rule.startswith('RCP') and (args.skip_recompile
+                                         or not args.obs_dir):
+            keep.append(e)
+    return keep
+
+
+def render_text(report, stream=sys.stdout):
+    w = stream.write
+    for f in report['findings']:
+        mark = '' if f['fingerprint'] not in report['_suppressed'] else \
+            ' [baselined]'
+        w(f"{f['severity'].upper():7s} {f['rule']} {f['where']}{mark}\n")
+        w(f"        {f['message']}\n")
+        if f.get('detail'):
+            w(f"        ({f['detail']})\n")
+    s = report['summary']
+    w(f"dgmc-lint: {s['total']} finding(s) — {s['new']} new, "
+      f"{s['suppressed']} baselined "
+      f"(errors {s['errors']}, warnings {s['warnings']}, "
+      f"infos {s['infos']})\n")
+
+
+def main(argv=None):
+    args = build_parser().parse_args(argv)
+    if args.list_rules:
+        for rule, desc in sorted(RULE_CATALOG.items()):
+            print(f'{rule}  {desc}')
+        return 0
+
+    quiet = args.json
+
+    def progress(msg):
+        if not quiet:
+            print(f'[dgmc-lint] {msg}', file=sys.stderr)
+
+    try:
+        min_sev = Severity.parse(args.min_severity)
+    except ValueError as e:
+        print(f'dgmc-lint: {e}', file=sys.stderr)
+        return 2
+    keep_rules = (set(r.strip() for r in args.rules.split(',') if r.strip())
+                  if args.rules else None)
+    if keep_rules is not None:
+        unknown = keep_rules - set(RULE_CATALOG)
+        if unknown:
+            print(f'dgmc-lint: unknown rule id(s): {sorted(unknown)}',
+                  file=sys.stderr)
+            return 2
+
+    if args.obs_dir and not os.path.exists(
+            os.path.join(args.obs_dir, 'timings.json')):
+        # A vanished obs dir must not silently disable the telemetry
+        # cross-check the caller asked for (e.g. the CI gate).
+        print(f'dgmc-lint: --obs-dir {args.obs_dir} has no timings.json '
+              f'(not an obs run directory?)', file=sys.stderr)
+        return 2
+
+    found, skipped_specimens = collect_findings(args, progress)
+    found = [f for f in found if f.severity >= min_sev]
+    if keep_rules is not None:
+        found = [f for f in found if f.rule in keep_rules]
+    found = sort_findings(found)
+
+    baseline_path = args.baseline or default_baseline_path()
+    if args.write_baseline:
+        preserved = _entries_not_analyzed(load_baseline(baseline_path),
+                                          args, skipped_specimens)
+        write_baseline(baseline_path, found, preserved_entries=preserved)
+        if not quiet:
+            kept = (f' (+ {len(preserved)} preserved from tiers/'
+                    f'specimens not analyzed here)' if preserved else '')
+            print(f'dgmc-lint: wrote {len(found)} finding(s) to '
+                  f'{baseline_path}{kept}')
+
+    baseline = load_baseline(baseline_path)
+    new, suppressed = split_by_baseline(found, baseline)
+
+    report = {
+        'tool': 'dgmc-lint',
+        'baseline': baseline_path if baseline or args.write_baseline
+        else None,
+        'findings': [f.to_json() for f in found],
+        'new': [f.fingerprint for f in new],
+        'summary': {
+            'total': len(found),
+            'new': len(new),
+            'suppressed': len(suppressed),
+            'errors': sum(f.severity == Severity.ERROR for f in found),
+            'warnings': sum(f.severity == Severity.WARNING for f in found),
+            'infos': sum(f.severity == Severity.INFO for f in found),
+        },
+    }
+    if args.json:
+        print(json.dumps(report, indent=1, sort_keys=True))
+    else:
+        report['_suppressed'] = {f.fingerprint for f in suppressed}
+        render_text(report)
+        del report['_suppressed']
+
+    if args.write_baseline or args.fail_on == 'none':
+        return 0
+    if args.fail_on == 'any':
+        return 1 if found else 0
+    if args.fail_on == 'error':
+        return 1 if any(f.severity == Severity.ERROR for f in new) else 0
+    return 1 if new else 0                                   # 'new'
+
+
+if __name__ == '__main__':
+    try:
+        sys.exit(main())
+    except BrokenPipeError:   # |head closed the pipe mid-report
+        os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+        sys.exit(1)

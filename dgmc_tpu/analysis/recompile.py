@@ -1,0 +1,156 @@
+"""Recompile-hazard pass: abstract step signatures across padding buckets.
+
+Every distinct padding bucket the host-side collation emits
+(``utils/data.pad_pair_batch`` — ``(batch, N_s x N_t, E_s x E_t)``) is a
+distinct abstract signature for whatever jitted step consumes the batch,
+i.e. one more XLA program: compile time, executable memory, and — with
+donation in play — one more executable that must round-trip any
+persistent cache correctly.
+
+Two findings:
+
+``RCP201`` avoidable-compile-churn
+    A bucket is *dominated* by another (every dimension <=): collating
+    into the bigger bucket's padding would serve both batches with ONE
+    program at the cost of a few masked rows. Dominated buckets are pure
+    churn.
+``RCP202`` compile-churn-telemetry
+    Cross-check against a recorded ``obs`` run (``--obs-dir``): the run
+    compiled far more programs than its distinct padding buckets can
+    explain — recompiles are coming from somewhere else (unstable static
+    args, trace-time Python values, dtype flips), which the padding
+    analysis alone cannot see.
+
+The signature hash is over flattened ``(shape, dtype)`` leaves only — by
+design the same thing jax's jit cache keys on for array arguments.
+"""
+
+import hashlib
+import json
+import os
+import re
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from dgmc_tpu.analysis.findings import Finding, Severity
+
+
+def signature_of(avals: Sequence[Tuple[Tuple[int, ...], str]]) -> str:
+    """Stable hash of a flattened abstract signature:
+    ``[(shape, dtype), ...]``."""
+    ident = ';'.join(f'{tuple(s)}:{d}' for s, d in avals)
+    return hashlib.sha256(ident.encode()).hexdigest()[:16]
+
+
+def pair_batch_avals(batch: int, nodes_s: int, nodes_t: int, edges_s: int,
+                     edges_t: int, feat_dim: int = 32,
+                     edge_dim: Optional[int] = None, dtype: str = 'float32',
+                     ) -> List[Tuple[Tuple[int, ...], str]]:
+    """The abstract leaves of a collated ``PairBatch`` for one padding
+    bucket — mirrors ``utils/data.pad_pair_batch`` exactly (same arrays,
+    same dtypes), without building a single array."""
+    def side(n, e):
+        leaves = [((batch, n, feat_dim), dtype),        # x
+                  ((batch, e), 'int32'),                # senders
+                  ((batch, e), 'int32'),                # receivers
+                  ((batch, n), 'bool'),                 # node_mask
+                  ((batch, e), 'bool')]                 # edge_mask
+        if edge_dim:
+            leaves.append(((batch, e, edge_dim), dtype))
+        return leaves
+
+    return (side(nodes_s, edges_s) + side(nodes_t, edges_t)
+            + [((batch, nodes_s), 'int32'),             # y
+               ((batch, nodes_s), 'bool')])             # y_mask
+
+
+def bucket_signature(bucket: Dict) -> str:
+    """Signature of one padding-bucket dict
+    (``{batch, nodes: 'AxB', edges: 'CxD'}`` — the obs telemetry row
+    format of ``registry.padding_bucket_table``)."""
+    ns, nt = _split_pair(bucket['nodes'])
+    es, et = _split_pair(bucket['edges'])
+    return signature_of(pair_batch_avals(int(bucket['batch']), ns, nt,
+                                         es, et))
+
+
+def _split_pair(v) -> Tuple[int, int]:
+    if isinstance(v, (list, tuple)):
+        return int(v[0]), int(v[1])
+    m = re.match(r'^(\d+)x(\d+)$', str(v))
+    if m:
+        return int(m.group(1)), int(m.group(2))
+    n = int(v)
+    return n, n
+
+
+def _dims(bucket: Dict) -> Tuple[int, ...]:
+    ns, nt = _split_pair(bucket['nodes'])
+    es, et = _split_pair(bucket['edges'])
+    return (int(bucket['batch']), ns, nt, es, et)
+
+
+def _bucket_label(bucket: Dict) -> str:
+    return (f'B={bucket["batch"]},nodes={bucket["nodes"]},'
+            f'edges={bucket["edges"]}')
+
+
+def analyze_buckets(buckets: Sequence[Dict], *, specimen='padding',
+                    compile_events: Optional[int] = None,
+                    programs_per_bucket: int = 8) -> List[Finding]:
+    """Churn findings over padding-bucket rows.
+
+    Args:
+        buckets: rows of ``{batch, nodes, edges[, count]}`` (obs
+            telemetry format).
+        compile_events: compile-event count of a recorded run (obs
+            ``timings.json``), for the RCP202 cross-check.
+        programs_per_bucket: how many compiles one bucket legitimately
+            feeds (train + eval + init + the nested op jits underneath;
+            a clean 1-epoch obs-smoke run measures 5 for one bucket);
+            the telemetry check allows ``distinct_signatures * this``
+            before flagging.
+    """
+    findings = []
+    dims = [(_dims(b), b) for b in buckets]
+    for d, b in dims:
+        dominators = [ob for od, ob in dims
+                      if od != d and all(x >= y for x, y in zip(od, d))]
+        if dominators:
+            dom = max(dominators, key=lambda ob: _dims(ob))
+            findings.append(Finding(
+                rule='RCP201', severity=Severity.WARNING,
+                where=f'{specimen}:{_bucket_label(b)}',
+                message=(f'padding bucket ({_bucket_label(b)}) is '
+                         f'dominated by ({_bucket_label(dom)}) — '
+                         f'collating into the larger padding removes '
+                         f'one XLA program per consuming step'),
+                detail=f'seen {b.get("count", "?")} time(s); each '
+                       f'distinct bucket recompiles every jitted step '
+                       f'that consumes the batch'))
+    if compile_events is not None and buckets:
+        distinct = len({bucket_signature(b) for b in buckets})
+        budget = max(1, distinct) * programs_per_bucket
+        if compile_events > budget:
+            findings.append(Finding(
+                rule='RCP202', severity=Severity.WARNING,
+                where=f'{specimen}:telemetry',
+                message=(f'{compile_events} compile events for '
+                         f'{distinct} distinct padding signature(s) '
+                         f'(budget {budget}) — recompiles not explained '
+                         f'by padding (unstable static args / trace-time '
+                         f'Python values?)'),
+                detail='cross-checked against obs compile telemetry '
+                       '(timings.json compile.events)'))
+    return findings
+
+
+def load_obs_buckets(obs_dir: str) -> Tuple[List[Dict], Optional[int]]:
+    """``(padding_bucket_rows, compile_events)`` from a recorded obs run
+    directory (``timings.json``); ``([], None)`` when absent."""
+    path = os.path.join(obs_dir, 'timings.json')
+    if not os.path.exists(path):
+        return [], None
+    with open(path) as f:
+        t = json.load(f)
+    events = (t.get('compile') or {}).get('events')
+    return list(t.get('padding_buckets') or []), events

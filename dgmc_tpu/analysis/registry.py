@@ -1,0 +1,283 @@
+"""Hot-function registry: what the trace tier lowers, and under which
+shape/dtype/mesh configs.
+
+Each :class:`Specimen` names one jit boundary the production pipeline
+actually crosses — the DGMC forward (dense and sparse top-k), the
+donating train step and the eval step from ``train/steps.py``, the fused
+ops underneath them, and (devices permitting) the GSPMD-sharded donating
+train step from ``parallel/sharding.py`` — the exact configuration of
+the jax-0.4.37 persistent-cache aliasing bug (PR 3).
+
+Probes are forced OFF while specimens trace: the lint asserts the
+probes-disabled contract (zero host callbacks in the lowered step,
+extending PR 3's byte-identical-HLO guarantee to a static CI check), so
+a probe-enabled lint process must not leak callbacks into the programs
+under analysis.
+
+Shapes are deliberately tiny (the smallest sizes the model accepts):
+every hazard the rules detect — dtype introduction, callbacks, dropped
+aliasing, scatter forms — is shape-independent, and small specimens keep
+``dgmc-lint`` fast enough to run on every CI push.
+"""
+
+import contextlib
+import dataclasses
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from dgmc_tpu.analysis.findings import Finding
+from dgmc_tpu.analysis.jaxpr_rules import (TraceContext, analyze_closed_jaxpr,
+                                           analyze_donation)
+
+
+@dataclasses.dataclass
+class Specimen:
+    """One registered hot function + config.
+
+    ``build()`` returns ``{'fn': callable, 'args': tuple}`` plus
+    optional ``'donate_argnums'`` (tuple — run the donation-aliasing
+    rule) and ``'expect_no_callbacks'`` (default True).
+    """
+    name: str
+    build: Callable[[], Dict]
+    #: None = always runnable; else the minimum jax.devices() count.
+    min_devices: int = 0
+
+
+@contextlib.contextmanager
+def probes_forced_off():
+    """Trace-time: probe call sites must not lower into specimens."""
+    from dgmc_tpu.obs import probes
+    prev = probes.enabled()
+    probes.disable()
+    try:
+        yield
+    finally:
+        if prev:
+            probes.enable()
+
+
+# ---------------------------------------------------------------------------
+# Tiny concrete fixtures (host-side numpy; shapes are the minimum the
+# model accepts)
+# ---------------------------------------------------------------------------
+
+
+def _graph_side(rng, n, e, c=4):
+    from dgmc_tpu.ops.graph import GraphBatch
+    return GraphBatch(
+        x=rng.randn(1, n, c).astype(np.float32),
+        senders=rng.randint(0, n, (1, e)).astype(np.int32),
+        receivers=rng.randint(0, n, (1, e)).astype(np.int32),
+        node_mask=np.ones((1, n), bool),
+        edge_mask=np.ones((1, e), bool),
+        edge_attr=None)
+
+
+def _pair_batch(rng, n_s=8, e_s=16, n_t=10, e_t=20):
+    from dgmc_tpu.utils.data import PairBatch
+    return PairBatch(
+        s=_graph_side(rng, n_s, e_s), t=_graph_side(rng, n_t, e_t),
+        y=(np.arange(n_s, dtype=np.int32) % n_t)[None],
+        y_mask=np.ones((1, n_s), bool))
+
+
+def _model_state_batch(k, num_steps=2):
+    import jax
+    from dgmc_tpu.models import DGMC, RelCNN
+    from dgmc_tpu.train import create_train_state
+    rng = np.random.RandomState(0)
+    batch = _pair_batch(rng)
+    model = DGMC(RelCNN(4, 8, num_layers=1), RelCNN(4, 4, num_layers=1),
+                 num_steps=num_steps, k=k)
+    state = create_train_state(model, jax.random.key(0), batch,
+                               learning_rate=1e-3)
+    return model, state, batch
+
+
+def _forward_specimen(k):
+    def build():
+        import jax
+        model, state, batch = _model_state_batch(k)
+
+        def forward(params, batch, key):
+            return model.apply({'params': params}, batch.s, batch.t,
+                               train=False, rngs={'noise': key})
+
+        return {'fn': forward,
+                'args': (state.params, batch, jax.random.key(1))}
+    return build
+
+
+def _train_step_specimen(k):
+    def build():
+        import jax
+        from dgmc_tpu.train import make_train_step
+        model, state, batch = _model_state_batch(k)
+        step = make_train_step(model, jit=False)
+        return {'fn': step,
+                'args': (state, batch, jax.random.key(1)),
+                'donate_argnums': (0,)}
+    return build
+
+
+def _eval_step_specimen():
+    def build():
+        import jax
+        from dgmc_tpu.train import make_eval_step
+        model, state, batch = _model_state_batch(k=-1)
+        step = make_eval_step(model, jit=False)
+        return {'fn': step, 'args': (state, batch, jax.random.key(1))}
+    return build
+
+
+def _topk_specimen(dtype):
+    def build():
+        from dgmc_tpu.ops.topk import chunked_topk
+        rng = np.random.RandomState(1)
+        h_s = rng.randn(1, 16, 8).astype(dtype)
+        h_t = rng.randn(1, 24, 8).astype(dtype)
+
+        def topk(h_s, h_t):
+            return chunked_topk(h_s, h_t, 4, block=8, pallas=False)
+
+        return {'fn': topk, 'args': (h_s, h_t)}
+    return build
+
+
+def _softmax_specimen():
+    def build():
+        from dgmc_tpu.ops.softmax import masked_softmax
+        rng = np.random.RandomState(2)
+        s = rng.randn(1, 8, 10).astype(np.float32)
+        mask = np.ones((1, 8, 10), bool)
+        return {'fn': masked_softmax, 'args': (s, mask)}
+    return build
+
+
+def _segment_specimen():
+    def build():
+        from dgmc_tpu.ops.segment import segment_sum
+        rng = np.random.RandomState(3)
+        vals = rng.randn(1, 16, 4).astype(np.float32)
+        idx = rng.randint(0, 8, (1, 16)).astype(np.int32)
+
+        def seg(vals, idx):
+            return segment_sum(vals, idx, 8)
+
+        return {'fn': seg, 'args': (vals, idx)}
+    return build
+
+
+def _sharded_train_step_specimen():
+    def build():
+        import jax
+        from dgmc_tpu.parallel import make_mesh, replicate, shard_batch
+        from dgmc_tpu.parallel.sharding import make_sharded_train_step
+        n_data = 2
+        from dgmc_tpu.utils.data import PairBatch
+        one = _pair_batch(np.random.RandomState(0))
+        batch = PairBatch(
+            s=jax.tree.map(lambda x: np.repeat(x, n_data, 0), one.s),
+            t=jax.tree.map(lambda x: np.repeat(x, n_data, 0), one.t),
+            y=np.repeat(one.y, n_data, 0),
+            y_mask=np.repeat(one.y_mask, n_data, 0))
+        from dgmc_tpu.models import DGMC, RelCNN
+        from dgmc_tpu.train import create_train_state
+        model = DGMC(RelCNN(4, 8, num_layers=1), RelCNN(4, 4, num_layers=1),
+                     num_steps=1, k=-1)
+        state = create_train_state(model, jax.random.key(0), one,
+                                   learning_rate=1e-3)
+        mesh = make_mesh(data=n_data, model=1,
+                         devices=jax.devices()[:n_data])
+        step = make_sharded_train_step(model, mesh)
+        return {'fn': step,
+                'args': (replicate(state, mesh), shard_batch(batch, mesh),
+                         jax.random.key(1)),
+                'prejitted': True,
+                'donate_argnums': (0,)}
+    return build
+
+
+def default_specimens() -> List[Specimen]:
+    """The registered hot-function matrix (order = report order)."""
+    return [
+        Specimen('forward_dense', _forward_specimen(k=-1)),
+        Specimen('forward_sparse_k3', _forward_specimen(k=3)),
+        Specimen('train_step_dense', _train_step_specimen(k=-1)),
+        Specimen('train_step_sparse_k3', _train_step_specimen(k=3)),
+        Specimen('eval_step_dense', _eval_step_specimen()),
+        Specimen('ops.chunked_topk_f32', _topk_specimen(np.float32)),
+        Specimen('ops.masked_softmax', _softmax_specimen()),
+        Specimen('ops.segment_sum', _segment_specimen()),
+        Specimen('parallel.sharded_train_step',
+                 _sharded_train_step_specimen(), min_devices=2),
+    ]
+
+
+def run_specimen(spec: Specimen, *, const_bytes=None) -> List[Finding]:
+    """Trace + (when donating) compile one specimen and run every
+    trace-tier rule over it."""
+    import jax
+    kw = {}
+    if const_bytes is not None:
+        kw['const_bytes'] = const_bytes
+    with probes_forced_off():
+        built = spec.build()
+        fn, args = built['fn'], built['args']
+        ctx = TraceContext(specimen=spec.name, **kw)
+        if built.get('prejitted'):
+            # Already a jitted callable (e.g. the sharded step with its
+            # in_shardings): trace through its wrapper for the jaxpr
+            # rules, and reuse its own lowering for donation.
+            closed = jax.make_jaxpr(lambda *a: fn(*a))(*args)
+        else:
+            closed = jax.make_jaxpr(fn)(*args)
+        findings = analyze_closed_jaxpr(closed, ctx)
+        donate = built.get('donate_argnums')
+        if donate:
+            if built.get('prejitted'):
+                findings += _donation_of_prejitted(fn, args, donate,
+                                                  spec.name)
+            else:
+                findings += analyze_donation(fn, args,
+                                             donate_argnums=donate,
+                                             specimen=spec.name)
+    return findings
+
+
+def _donation_of_prejitted(fn, args, donate, specimen) -> List[Finding]:
+    import warnings
+    from dgmc_tpu.analysis.jaxpr_rules import compiled_donation_findings
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter('always')
+        compiled = fn.lower(*args).compile()
+    return compiled_donation_findings(caught, compiled, donate, specimen)
+
+
+def run_trace_tier(specimens: Optional[List[Specimen]] = None, *,
+                   const_bytes=None,
+                   on_progress: Optional[Callable[[str], None]] = None,
+                   skipped: Optional[List[str]] = None) -> List[Finding]:
+    """Run every runnable specimen; skips mesh specimens when the
+    process has too few devices (reported via ``on_progress``, and
+    appended to ``skipped`` when given — baseline writers use that to
+    preserve the skipped specimens' prior entries)."""
+    import jax
+    findings = []
+    n_dev = len(jax.devices())
+    for spec in (specimens if specimens is not None
+                 else default_specimens()):
+        if spec.min_devices and n_dev < spec.min_devices:
+            if on_progress:
+                on_progress(f'skip {spec.name} '
+                            f'(needs >= {spec.min_devices} devices, '
+                            f'have {n_dev})')
+            if skipped is not None:
+                skipped.append(spec.name)
+            continue
+        if on_progress:
+            on_progress(f'trace {spec.name}')
+        findings.extend(run_specimen(spec, const_bytes=const_bytes))
+    return findings

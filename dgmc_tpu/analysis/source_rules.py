@@ -1,0 +1,308 @@
+"""Source-tier lints: ``ast`` passes over the package source.
+
+These catch the hazards that never make it into a jaxpr because they
+blow up (or silently sync) at trace time:
+
+``SRC101`` tracer-leak
+    A jit-compiled function stores into ``self.<attr>`` or a module
+    global. The stored value is a tracer; it escapes the trace and
+    poisons the next call (``UnexpectedTracerError`` at best, stale
+    constants at worst).
+``SRC102`` host-sync-in-jit
+    ``float(x)`` / ``int(x)`` / ``bool(x)`` / ``x.item()`` /
+    ``np.asarray(x)`` on a traced value inside jitted code — each forces
+    concretization: a trace-time error under jit, or a silent
+    device->host fence where tracing is avoided.
+``SRC103`` jit-in-loop
+    ``jax.jit`` constructed inside a loop body: every iteration builds a
+    fresh wrapper whose cache is thrown away — the textbook recompile
+    churn generator.
+``SRC104`` unhashable-static-arg
+    ``static_argnums``/``static_argnames`` naming a parameter whose
+    default is a mutable literal (list/dict/set). Static args are jit
+    cache keys and must be hashable; the default explodes the first time
+    it is actually used.
+
+The scanner refuses bytecode: ``__pycache__`` directories are never
+descended into, and pointing it at a ``.pyc`` (or anything inside
+``__pycache__``) raises rather than silently analyzing stale bytecode.
+"""
+
+import ast
+import os
+from typing import Iterator, List, Optional, Sequence
+
+from dgmc_tpu.analysis.findings import Finding, Severity
+
+_JIT_NAMES = {'jit'}          # bare `jit` (from jax import jit)
+_NP_MODULES = {'np', 'numpy', 'onp'}
+_CONCRETIZERS = {'float', 'int', 'bool'}
+_SKIP_DIRS = {'__pycache__', '.git', '.pytest_cache', '.hypothesis',
+              'build', 'dist', '.jax_compile_cache'}
+
+
+def _is_jax_jit(node: ast.AST) -> bool:
+    """True for expressions naming the jit transform itself: ``jax.jit``
+    or a bare ``jit``."""
+    if isinstance(node, ast.Attribute) and node.attr == 'jit':
+        return True
+    if isinstance(node, ast.Name) and node.id in _JIT_NAMES:
+        return True
+    return False
+
+
+def _jit_call(node: ast.AST) -> Optional[ast.Call]:
+    """The ``jax.jit(...)``/``partial(jax.jit, ...)`` Call under ``node``,
+    or None."""
+    if not isinstance(node, ast.Call):
+        return None
+    if _is_jax_jit(node.func):
+        return node
+    # functools.partial(jax.jit, ...) used as a decorator / wrapper.
+    f = node.func
+    is_partial = ((isinstance(f, ast.Attribute) and f.attr == 'partial')
+                  or (isinstance(f, ast.Name) and f.id == 'partial'))
+    if is_partial and node.args and _is_jax_jit(node.args[0]):
+        return node
+    return None
+
+
+def _jitted_function_defs(tree: ast.Module):
+    """FunctionDefs that are jit-compiled: decorated with ``jax.jit`` /
+    ``partial(jax.jit, ...)``, or rebound via ``f = jax.jit(f, ...)`` in
+    an enclosing scope (the factory idiom of ``train/steps.py``).
+    Yields ``(def_node, jit_call_or_None)``."""
+    defs = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs.setdefault(node.name, []).append(node)
+
+    seen = set()
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                call = _jit_call(dec)
+                if call is not None or _is_jax_jit(dec):
+                    if id(node) not in seen:
+                        seen.add(id(node))
+                        yield node, call
+        elif isinstance(node, ast.Assign):
+            call = _jit_call(node.value)
+            if call is None or not call.args:
+                continue
+            first = call.args[0]
+            # partial(jax.jit, ...)(f) has the fn elsewhere; only handle
+            # the direct jax.jit(f, ...) rebind.
+            if not _is_jax_jit(call.func):
+                continue
+            if isinstance(first, ast.Name):
+                for d in defs.get(first.id, []):
+                    if id(d) not in seen:
+                        seen.add(id(d))
+                        yield d, call
+
+
+def _finding(rule, severity, rel, node, message, detail=None) -> Finding:
+    return Finding(rule=rule, severity=severity,
+                   where=f'{rel}:{getattr(node, "lineno", 0)}',
+                   message=message, detail=detail)
+
+
+def _check_tracer_leaks(tree, rel) -> List[Finding]:
+    out = []
+    for fdef, _ in _jitted_function_defs(tree):
+        globals_declared = set()
+        for node in ast.walk(fdef):
+            if isinstance(node, ast.Global):
+                globals_declared.update(node.names)
+            elif isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+                targets = (node.targets if isinstance(node, ast.Assign)
+                           else [node.target])
+                for t in targets:
+                    if (isinstance(t, ast.Attribute)
+                            and isinstance(t.value, ast.Name)
+                            and t.value.id == 'self'):
+                        out.append(_finding(
+                            'SRC101', Severity.ERROR, rel, node,
+                            f'jitted `{fdef.name}` stores a traced value '
+                            f'on `self.{t.attr}` — the tracer escapes the '
+                            f'trace'))
+                    elif (isinstance(t, ast.Name)
+                          and t.id in globals_declared):
+                        out.append(_finding(
+                            'SRC101', Severity.ERROR, rel, node,
+                            f'jitted `{fdef.name}` assigns module global '
+                            f'`{t.id}` — the tracer escapes the trace'))
+    return out
+
+
+def _check_host_syncs(tree, rel) -> List[Finding]:
+    out = []
+    for fdef, _ in _jitted_function_defs(tree):
+        for node in ast.walk(fdef):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if (isinstance(f, ast.Name) and f.id in _CONCRETIZERS
+                    and node.args
+                    and not isinstance(node.args[0], ast.Constant)):
+                out.append(_finding(
+                    'SRC102', Severity.WARNING, rel, node,
+                    f'`{f.id}(...)` on a traced value inside jitted '
+                    f'`{fdef.name}` — concretization error / host sync'))
+            elif isinstance(f, ast.Attribute) and f.attr == 'item':
+                out.append(_finding(
+                    'SRC102', Severity.WARNING, rel, node,
+                    f'`.item()` inside jitted `{fdef.name}` — '
+                    f'concretization error / host sync'))
+            elif (isinstance(f, ast.Attribute)
+                  and f.attr in ('asarray', 'array')
+                  and isinstance(f.value, ast.Name)
+                  and f.value.id in _NP_MODULES):
+                out.append(_finding(
+                    'SRC102', Severity.WARNING, rel, node,
+                    f'`{f.value.id}.{f.attr}(...)` inside jitted '
+                    f'`{fdef.name}` — pulls the traced value to host '
+                    f'(use jnp)'))
+    return out
+
+
+def _check_jit_in_loop(tree, rel) -> List[Finding]:
+    out = []
+
+    class LoopVisitor(ast.NodeVisitor):
+        def __init__(self):
+            self.loop_depth = 0
+
+        def _loop(self, node):
+            self.loop_depth += 1
+            self.generic_visit(node)
+            self.loop_depth -= 1
+
+        visit_For = visit_While = visit_AsyncFor = _loop
+
+        def visit_FunctionDef(self, node):
+            # A def inside a loop resets loop context for its own body
+            # (the function runs later, not per-iteration).
+            depth, self.loop_depth = self.loop_depth, 0
+            self.generic_visit(node)
+            self.loop_depth = depth
+
+        visit_AsyncFunctionDef = visit_FunctionDef
+
+        def visit_Call(self, node):
+            if self.loop_depth and _is_jax_jit(node.func):
+                out.append(_finding(
+                    'SRC103', Severity.WARNING, rel, node,
+                    'jax.jit constructed inside a loop — a fresh wrapper '
+                    '(and compile cache) per iteration'))
+            self.generic_visit(node)
+
+    LoopVisitor().visit(tree)
+    return out
+
+
+def _check_static_arg_hashability(tree, rel) -> List[Finding]:
+    out = []
+    for fdef, call in _jitted_function_defs(tree):
+        if call is None:
+            continue
+        static_names = set()
+        static_nums = []
+        for kw in call.keywords:
+            if kw.arg == 'static_argnames':
+                for e in ast.walk(kw.value):
+                    if isinstance(e, ast.Constant) and isinstance(e.value,
+                                                                  str):
+                        static_names.add(e.value)
+            elif kw.arg == 'static_argnums':
+                for e in ast.walk(kw.value):
+                    if isinstance(e, ast.Constant) and isinstance(e.value,
+                                                                  int):
+                        static_nums.append(e.value)
+        if not static_names and not static_nums:
+            continue
+        # Positional params: posonly args come first and shift
+        # static_argnums indexing; defaults covers the TAIL of the
+        # combined posonly+regular list.
+        pos = list(fdef.args.posonlyargs) + list(fdef.args.args)
+        defaults = fdef.args.defaults
+        offset = len(pos) - len(defaults)
+        checks = []
+        for i, arg in enumerate(pos):
+            if (arg.arg in static_names or i in static_nums) \
+                    and i >= offset:
+                checks.append((arg, defaults[i - offset]))
+        # Keyword-only params: reachable via static_argnames only;
+        # kw_defaults aligns 1:1 with kwonlyargs (None = no default).
+        for arg, default in zip(fdef.args.kwonlyargs,
+                                fdef.args.kw_defaults):
+            if arg.arg in static_names and default is not None:
+                checks.append((arg, default))
+        for arg, default in checks:
+            if isinstance(default, (ast.List, ast.Dict, ast.Set)):
+                kind = type(default).__name__.lower()
+                out.append(_finding(
+                    'SRC104', Severity.WARNING, rel, default,
+                    f'static arg `{arg.arg}` of jitted `{fdef.name}` '
+                    f'defaults to a mutable {kind} — static args are '
+                    f'cache keys and must be hashable'))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# File / tree drivers
+# ---------------------------------------------------------------------------
+
+
+def _refuse_bytecode(path: str):
+    norm = os.path.normpath(path)
+    if norm.endswith(('.pyc', '.pyo')) or '__pycache__' in norm.split(os.sep):
+        raise ValueError(
+            f'{path}: refusing to scan bytecode — the source tier lints '
+            f'.py sources only (and never descends into __pycache__)')
+
+
+def lint_source_file(path: str, rel: Optional[str] = None) -> List[Finding]:
+    """All source rules over one ``.py`` file. ``rel`` overrides the
+    location prefix used in findings (defaults to ``path``)."""
+    _refuse_bytecode(path)
+    rel = rel or path
+    with open(path, encoding='utf-8') as f:
+        src = f.read()
+    try:
+        tree = ast.parse(src, filename=path)
+    except SyntaxError as e:
+        return [Finding(rule='SRC100', severity=Severity.ERROR,
+                        where=f'{rel}:{e.lineno or 0}',
+                        message=f'syntax error: {e.msg}')]
+    out = []
+    out += _check_tracer_leaks(tree, rel)
+    out += _check_host_syncs(tree, rel)
+    out += _check_jit_in_loop(tree, rel)
+    out += _check_static_arg_hashability(tree, rel)
+    return out
+
+
+def iter_source_files(root: str) -> Iterator[str]:
+    """``.py`` files under ``root``, never entering bytecode/cache dirs."""
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = sorted(d for d in dirnames if d not in _SKIP_DIRS)
+        for fn in sorted(filenames):
+            if fn.endswith('.py'):
+                yield os.path.join(dirpath, fn)
+
+
+def lint_source_tree(root: str,
+                     exclude: Sequence[str] = ()) -> List[Finding]:
+    """Source rules over every ``.py`` under ``root`` (recursively),
+    reporting repo-relative locations."""
+    root = os.path.abspath(root)
+    base = os.path.dirname(root)
+    out = []
+    for path in iter_source_files(root):
+        rel = os.path.relpath(path, base)
+        if any(rel.startswith(e) for e in exclude):
+            continue
+        out.extend(lint_source_file(path, rel=rel))
+    return out

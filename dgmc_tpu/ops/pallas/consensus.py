@@ -26,6 +26,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from dgmc_tpu.parallel.compat import shape_dtype_struct
+
 TILE_S = 128
 TILE_T = 128
 
@@ -85,8 +87,8 @@ def _forward_pallas(o_s, o_t, w1, b1, w2, b2, interpret=False):
         out_specs=pl.BlockSpec((1, TILE_S, TILE_T),
                                lambda b, i, j: (b, i, j),
                                memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((B, N_s + pad_s, N_t + pad_t),
-                                       jnp.float32, vma=vma),
+        out_shape=shape_dtype_struct((B, N_s + pad_s, N_t + pad_t),
+                                     jnp.float32, vma=vma),
         interpret=interpret,
     )(o_s_p, o_t_p, w1, b1[None, :], w2, b2[None, :])
     return out[:, :N_s, :N_t]

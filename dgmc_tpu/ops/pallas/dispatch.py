@@ -58,9 +58,13 @@ def vma_union(*arrays):
 
 def promote_vma(vma, *arrays):
     """Promote every array to carry ``vma`` (replicated → varying is
-    free); no-op when ``vma`` is empty."""
+    free); no-op when ``vma`` is empty — including on pre-vma JAX, where
+    :func:`vma_of` always reports empty and this path is never taken."""
+    if not vma:
+        return tuple(arrays)
+
     def one(a):
-        missing = tuple(sorted(vma - set(jax.typeof(a).vma)))
+        missing = tuple(sorted(vma - vma_of(a)))
         return jax.lax.pcast(a, missing, to='varying') if missing else a
 
     return tuple(one(a) for a in arrays)

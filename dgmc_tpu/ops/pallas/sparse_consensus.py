@@ -31,6 +31,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from dgmc_tpu.parallel.compat import shape_dtype_struct
+
 TILE_S = 128
 
 
@@ -138,8 +140,8 @@ def _forward(o_s, cand, w1, b1, w2, b2, interpret):
         ],
         out_specs=pl.BlockSpec((1, TILE_S * K, 1), lambda b, i: (b, i, 0),
                                memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((B, (N_s + pad) * K, 1),
-                                       jnp.float32, vma=vma),
+        out_shape=shape_dtype_struct((B, (N_s + pad) * K, 1),
+                                     jnp.float32, vma=vma),
         interpret=interpret,
     )(o_s_p, cand_p, w1, b1[None, :], w2, b2.reshape(1, 1))
     return out.reshape(B, N_s + pad, K)[:, :N_s]
@@ -213,12 +215,12 @@ def _bwd(interpret, res, g):
                          memory_space=pltpu.VMEM),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((B, n_pad, R), o_s.dtype, vma=vma),
-            jax.ShapeDtypeStruct((B, n_pad * K, R), cand.dtype, vma=vma),
-            jax.ShapeDtypeStruct((R, R), f32, vma=vma),
-            jax.ShapeDtypeStruct((1, R), f32, vma=vma),
-            jax.ShapeDtypeStruct((R, 1), f32, vma=vma),
-            jax.ShapeDtypeStruct((1, 1), f32, vma=vma),
+            shape_dtype_struct((B, n_pad, R), o_s.dtype, vma=vma),
+            shape_dtype_struct((B, n_pad * K, R), cand.dtype, vma=vma),
+            shape_dtype_struct((R, R), f32, vma=vma),
+            shape_dtype_struct((1, R), f32, vma=vma),
+            shape_dtype_struct((R, 1), f32, vma=vma),
+            shape_dtype_struct((1, 1), f32, vma=vma),
         ],
         interpret=interpret,
     )(o_s_p, cand_p, w1, b1[None, :], w2.reshape(1, R), g_p)

@@ -36,6 +36,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from dgmc_tpu.parallel.compat import shape_dtype_struct
+
 M_TILE = 256
 
 # Dispatch gate: per-cell VMEM is dominated by the [M_TILE, E] route chunk
@@ -175,8 +177,8 @@ def _fwd_impl(t, flat, basis, receivers, edge_mask, num_nodes, interpret):
         + _common_specs(flat_t, basis_t, rcv, emask_f),
         out_specs=pl.BlockSpec((1, num_nodes, O), lambda b, j: (b, 0, 0),
                                memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((B, num_nodes, O), t.dtype,
-                                       vma=vma),
+        out_shape=shape_dtype_struct((B, num_nodes, O), t.dtype,
+                                     vma=vma),
         scratch_shapes=[pltpu.VMEM((E, O), jnp.float32)],
         interpret=interpret,
     )(t_p, flat_t, basis_t, rcv, emask_f)
@@ -196,7 +198,10 @@ def _fwd(t, flat, basis, receivers, edge_mask, num_nodes, interpret):
 
 def _symzero(shape, dtype):
     from jax.custom_derivatives import SymbolicZero
-    aval = jax.typeof(jax.ShapeDtypeStruct(shape, dtype))
+    try:
+        aval = jax.typeof(shape_dtype_struct(shape, dtype))
+    except AttributeError:  # pre-vma JAX: no jax.typeof
+        aval = jax.core.ShapedArray(shape, dtype)
     return SymbolicZero(aval.to_tangent_aval())
 
 
@@ -218,8 +223,8 @@ def _bwd(num_nodes, interpret, res, g):
         + _common_specs(flat_t, basis_t, rcv, emask_f),
         out_specs=pl.BlockSpec((1, M_TILE, O), lambda b, j: (b, j, 0),
                                memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((B, M + pad, O), g.dtype,
-                                       vma=vma),
+        out_shape=shape_dtype_struct((B, M + pad, O), g.dtype,
+                                     vma=vma),
         scratch_shapes=[pltpu.VMEM((E, O), jnp.float32)],
         interpret=interpret,
     )(g, flat_t, basis_t, rcv, emask_f)[:, :M]

@@ -118,6 +118,7 @@ def pallas_topk(h_s, h_t, k, t_mask=None, return_values=False,
     # every input to the union vma and stamp it on the outputs. Outside
     # shard_map all vma sets are empty and this is a no-op.
     from dgmc_tpu.ops.pallas.dispatch import promote_vma, vma_union
+    from dgmc_tpu.parallel.compat import shape_dtype_struct
     vma = vma_union(h_s, h_t, t_mask)
     h_s, h_t, t_mask = promote_vma(vma, h_s, h_t, t_mask)
 
@@ -150,8 +151,8 @@ def pallas_topk(h_s, h_t, k, t_mask=None, return_values=False,
         ],
         out_shape=[
             # Values ride in the carry's float32; cast back on return.
-            jax.ShapeDtypeStruct((B, n_s_pad, k), jnp.float32, vma=vma),
-            jax.ShapeDtypeStruct((B, n_s_pad, k), jnp.int32, vma=vma),
+            shape_dtype_struct((B, n_s_pad, k), jnp.float32, vma=vma),
+            shape_dtype_struct((B, n_s_pad, k), jnp.int32, vma=vma),
         ],
         scratch_shapes=[
             pltpu.VMEM((TILE_S, k), jnp.float32),

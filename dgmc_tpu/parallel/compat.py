@@ -1,0 +1,63 @@
+"""JAX version-compat shims for the sharding API surface.
+
+The repo targets the current ``jax.shard_map`` API (top-level export,
+``check_vma=`` keyword, vma-typed ``ShapeDtypeStruct``), but deployment
+containers routinely pin older releases — this container ships
+jax 0.4.37, where ``shard_map`` still lives in ``jax.experimental``,
+the replication check is spelled ``check_rep``, and the vma type system
+does not exist. Every call site routes through this module so the
+version split is resolved in exactly one place:
+
+- :func:`shard_map` — top-level ``jax.shard_map`` when present, else
+  ``jax.experimental.shard_map.shard_map`` with ``check_vma`` translated
+  to ``check_rep`` (same semantics: both gate the out-spec replication /
+  varying-axes check).
+- :func:`shape_dtype_struct` — ``jax.ShapeDtypeStruct`` that only
+  forwards ``vma=`` where the constructor accepts it (pre-vma JAX has no
+  manual-axes type to declare; dropping it is exact there).
+
+The Pallas-side vma helpers (``vma_of`` / ``promote_vma``) live in
+:mod:`dgmc_tpu.ops.pallas.dispatch`; they degrade to no-ops through the
+same feature probes.
+"""
+
+import jax
+
+__all__ = ['HAS_NATIVE_SHARD_MAP', 'shard_map', 'shape_dtype_struct']
+
+#: True when this JAX exports top-level ``jax.shard_map`` (>= 0.6 API).
+HAS_NATIVE_SHARD_MAP = hasattr(jax, 'shard_map')
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma=None, **kwargs):
+    """Version-portable ``shard_map`` (keyword-only, partial-friendly).
+
+    Accepts the modern keyword surface; on pre-export JAX the call is
+    forwarded to ``jax.experimental.shard_map.shard_map`` with
+    ``check_vma`` renamed to its predecessor ``check_rep``.
+    """
+    if HAS_NATIVE_SHARD_MAP:
+        if check_vma is not None:
+            kwargs['check_vma'] = check_vma
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, **kwargs)
+    from jax.experimental.shard_map import shard_map as _shard_map
+    if check_vma is not None:
+        kwargs.setdefault('check_rep', check_vma)
+    return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, **kwargs)
+
+
+def shape_dtype_struct(shape, dtype, *, vma=None, **kwargs):
+    """``jax.ShapeDtypeStruct`` forwarding ``vma`` only where supported.
+
+    Pallas ``out_shape`` declarations stamp the varying-manual-axes set on
+    their outputs under the vma type system; earlier JAX has no such type,
+    so the annotation is meaningless there and is dropped.
+    """
+    if vma:
+        try:
+            return jax.ShapeDtypeStruct(shape, dtype, vma=vma, **kwargs)
+        except TypeError:
+            pass
+    return jax.ShapeDtypeStruct(shape, dtype, **kwargs)

@@ -50,8 +50,9 @@ def _already_initialized() -> bool:
 # is benign ONLY for the auto-detect path (a single-process script calling
 # late); an explicit multi-process request that cannot be honored must
 # fail loudly, not degrade into isolated single-process jobs.
-_BENIGN_ALWAYS = ('only be called once',)
-_BENIGN_AUTO = ('only be called once', 'before any JAX calls')
+_BENIGN_ALWAYS = ('only be called once', 'called more than once')
+_BENIGN_AUTO = ('only be called once', 'called more than once',
+                'before any JAX calls', 'before any JAX computations')
 
 
 def initialize_distributed(coordinator_address: Optional[str] = None,

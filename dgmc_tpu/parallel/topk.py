@@ -25,6 +25,7 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from dgmc_tpu.ops.topk import chunked_topk
+from dgmc_tpu.parallel.compat import shard_map
 from dgmc_tpu.parallel.mesh import MODEL_AXIS
 
 
@@ -36,7 +37,7 @@ def sharded_topk_rows(mesh, h_s, h_t, k, t_mask=None, block=1024,
         t_mask = jnp.ones((h_t.shape[0], h_t.shape[1]), bool)
 
     @functools.partial(
-        jax.shard_map, mesh=mesh,
+        shard_map, mesh=mesh,
         in_specs=(P(None, axis, None), P(), P()),
         out_specs=P(None, axis, None))
     def inner(h_s_l, h_t_l, t_mask_l):
@@ -105,7 +106,7 @@ def corr_sharded_topk(sharding, h_s, h_t, k, t_mask, block=256):
               else f'backend={jax.default_backend()}'))
 
     @functools.partial(
-        jax.shard_map, mesh=mesh,
+        shard_map, mesh=mesh,
         in_specs=(P(b_ax, s_ax, None), P(b_ax, None, None), P(b_ax, None)),
         out_specs=P(b_ax, s_ax, None))
     def local(hs, ht, tm):
@@ -133,7 +134,7 @@ def sharded_topk_cols(mesh, h_s, h_t, k, t_mask=None, block=1024,
     # check_vma off: every shard derives the identical merge from the
     # all_gathered candidates, a replication the type system can't infer.
     @functools.partial(
-        jax.shard_map, mesh=mesh,
+        shard_map, mesh=mesh,
         in_specs=(P(), P(None, axis, None), P(None, axis)),
         out_specs=P(), check_vma=False)
     def inner(h_s_l, h_t_l, t_mask_l):

@@ -1,0 +1,23 @@
+"""The analyzer's donation rule compiles donating multi-device programs
+(the sharded train-step specimen) — the exact configuration whose
+persistent-cache round-trip is broken on jax 0.4.37 (see
+tests/parallel/conftest.py for the root cause). Cache hits there could
+make TRC004 flicker (or hand back an executable with broken aliasing),
+so the analysis tests opt out of the persistent cache the same way."""
+
+import jax
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def _no_persistent_compile_cache():
+    from jax._src import compilation_cache
+
+    prev = jax.config.jax_enable_compilation_cache
+    jax.config.update('jax_enable_compilation_cache', False)
+    compilation_cache.reset_cache()  # un-latch is_cache_used
+    try:
+        yield
+    finally:
+        jax.config.update('jax_enable_compilation_cache', prev)
+        compilation_cache.reset_cache()

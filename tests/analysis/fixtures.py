@@ -1,0 +1,92 @@
+"""Deliberately-defective functions — one per analyzer defect class.
+
+Each fixture is the smallest program exhibiting exactly one hazard, so
+the golden tests can assert that each defect class is detected by its
+intended rule AND by no other (a fixture tripping two rules means a rule
+lost precision).
+
+The source-level fixtures at the bottom are never executed — they exist
+to be *parsed* by the ast tier. Do not "fix" them.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# ---------------------------------------------------------------------------
+# Trace-tier fixtures
+# ---------------------------------------------------------------------------
+
+#: 600*600*4 bytes = 1.37 MiB — above the default TRC002 threshold.
+BIG_TABLE = np.ones((600, 600), np.float32)
+
+
+def dtype_drift(x):
+    """TRC001: a float64 scalar promotes the whole product to f64 (trace
+    under ``jax.experimental.enable_x64`` — with x64 off, jax truncates
+    the promotion and the hazard is masked)."""
+    return x * np.float64(2.0)
+
+
+def giant_constant(x):
+    """TRC002: closes over a >1 MiB table; it constant-folds into every
+    executable instead of riding in as an argument."""
+    return x @ jnp.asarray(BIG_TABLE)
+
+
+def leaked_callback(x):
+    """TRC003: a host callback with no trace-time gate — fences
+    device->host every step even when nobody listens."""
+    jax.debug.callback(lambda v: None, jnp.sum(x))
+    return x * 2.0
+
+
+def dropped_donation(x):
+    """TRC004: reduces the donated ``[N, N]`` input to a scalar — no
+    output matches the donated buffer, so the donation silently degrades
+    to a copy (lowering warns 'donated buffers were not usable')."""
+    return jnp.sum(x)
+
+
+def big_sort(x):
+    """TRC006: a full sort over a large axis where a top-k selection was
+    intended."""
+    return jnp.sort(x, axis=-1)[..., -8:]
+
+
+# ---------------------------------------------------------------------------
+# Source-tier fixtures (parsed, never run)
+# ---------------------------------------------------------------------------
+
+
+class TracerHoarder:
+    """SRC101: the jitted method stores a traced value on ``self``."""
+
+    @jax.jit
+    def step(self, x):
+        self.last = x          # noqa: B003  — the leak under test
+        return x * 2.0
+
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def unhashable_static(x, cfg=[1, 2]):  # noqa: B006 — SRC104 under test
+    """SRC104: static args are jit cache keys; the mutable default is
+    unhashable the first time it is actually used."""
+    return x * cfg[0]
+
+
+@jax.jit
+def host_sync(x):
+    """SRC102: concretization inside jitted code."""
+    scale = float(x)
+    return x * scale
+
+
+def jit_factory_in_loop(fns):
+    """SRC103: a fresh jit wrapper (and compile cache) per iteration."""
+    out = []
+    for f in fns:
+        out.append(jax.jit(f))
+    return out

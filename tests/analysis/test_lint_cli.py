@@ -1,0 +1,149 @@
+"""CLI contract: --json report shape, --fail-on policies, baseline
+round-trip. (Trace tier is exercised by test_repo_clean; here it is
+skipped so the CLI paths stay fast.)"""
+
+import json
+import os
+import shutil
+
+import pytest
+
+from dgmc_tpu.analysis.lint import RULE_CATALOG, main
+
+FIXTURES = os.path.join(os.path.dirname(__file__), 'fixtures.py')
+
+
+@pytest.fixture
+def bad_tree(tmp_path):
+    """A tiny source tree with known source-tier findings."""
+    root = tmp_path / 'pkg'
+    root.mkdir()
+    shutil.copy(FIXTURES, root / 'fixtures.py')
+    return str(root)
+
+
+def _run(args, capsys):
+    rc = main(args)
+    out = capsys.readouterr().out
+    return rc, out
+
+
+def test_list_rules(capsys):
+    rc, out = _run(['--list-rules'], capsys)
+    assert rc == 0
+    for rule in RULE_CATALOG:
+        assert rule in out
+
+
+def test_json_report_and_fail_on_new(bad_tree, tmp_path, capsys):
+    baseline = str(tmp_path / 'bl.json')
+    args = ['--json', '--skip-trace', '--skip-recompile',
+            '--source-root', bad_tree, '--baseline', baseline]
+    rc, out = _run(args + ['--fail-on', 'new'], capsys)
+    assert rc == 1
+    report = json.loads(out)
+    rules = {f['rule'] for f in report['findings']}
+    assert {'SRC101', 'SRC102', 'SRC103', 'SRC104'} <= rules
+    assert report['summary']['new'] == report['summary']['total'] > 0
+    assert report['summary']['suppressed'] == 0
+    for f in report['findings']:
+        assert f['fingerprint']
+        assert f['severity'] in ('error', 'warning', 'info')
+
+
+def test_baseline_roundtrip_suppresses(bad_tree, tmp_path, capsys):
+    baseline = str(tmp_path / 'bl.json')
+    args = ['--json', '--skip-trace', '--skip-recompile',
+            '--source-root', bad_tree, '--baseline', baseline]
+    rc, _ = _run(args + ['--write-baseline'], capsys)
+    assert rc == 0
+    rc, out = _run(args + ['--fail-on', 'new'], capsys)
+    assert rc == 0
+    report = json.loads(out)
+    assert report['summary']['new'] == 0
+    assert report['summary']['suppressed'] == report['summary']['total'] > 0
+    # 'any' still fails on baselined findings; 'none' never fails.
+    assert _run(args + ['--fail-on', 'any'], capsys)[0] == 1
+    assert _run(args + ['--fail-on', 'none'], capsys)[0] == 0
+
+
+def test_fail_on_error_ignores_warnings(bad_tree, tmp_path, capsys):
+    baseline = str(tmp_path / 'bl.json')
+    args = ['--json', '--skip-trace', '--skip-recompile',
+            '--source-root', bad_tree, '--baseline', baseline]
+    # Only warnings (drop the SRC101 ERROR): rc 0 under --fail-on error.
+    rc, _ = _run(args + ['--fail-on', 'error',
+                         '--rules', 'SRC102,SRC103,SRC104'], capsys)
+    assert rc == 0
+    # With the ERROR rule kept, it fails.
+    rc, _ = _run(args + ['--fail-on', 'error', '--rules', 'SRC101'],
+                 capsys)
+    assert rc == 1
+
+
+def test_min_severity_filter(bad_tree, tmp_path, capsys):
+    baseline = str(tmp_path / 'bl.json')
+    args = ['--json', '--skip-trace', '--skip-recompile',
+            '--source-root', bad_tree, '--baseline', baseline,
+            '--fail-on', 'none']
+    rc, out = _run(args + ['--min-severity', 'error'], capsys)
+    assert rc == 0
+    report = json.loads(out)
+    assert report['findings']
+    assert all(f['severity'] == 'error' for f in report['findings'])
+
+
+def test_unknown_rule_is_a_usage_error(bad_tree, tmp_path, capsys):
+    rc, _ = _run(['--json', '--skip-trace', '--skip-recompile',
+                  '--source-root', bad_tree,
+                  '--baseline', str(tmp_path / 'bl.json'),
+                  '--rules', 'NOPE999'], capsys)
+    assert rc == 2
+
+
+def test_missing_obs_dir_is_a_usage_error(tmp_path, capsys):
+    """A vanished obs dir must not silently disable the telemetry
+    cross-check the caller asked for."""
+    rc, _ = _run(['--json', '--skip-trace', '--skip-source',
+                  '--obs-dir', str(tmp_path / 'gone'),
+                  '--baseline', str(tmp_path / 'bl.json')], capsys)
+    assert rc == 2
+
+
+def test_write_baseline_preserves_unanalyzed_tiers(bad_tree, tmp_path,
+                                                   capsys):
+    """Refreshing the baseline in a smaller environment (skipped tier /
+    too few devices) keeps the entries that environment cannot
+    reproduce, so CI's bigger run does not see them as 'new'."""
+    baseline = str(tmp_path / 'bl.json')
+    sharded = {'rule': 'TRC005', 'severity': 'info',
+               'where': 'parallel.sharded_train_step:dgmc_tpu/x.py:1',
+               'message': 'm', 'fingerprint': 'feedfacefeedface'}
+    (tmp_path / 'bl.json').write_text(json.dumps(
+        {'version': 1, 'findings': [sharded]}))
+    rc, _ = _run(['--skip-trace', '--skip-recompile',
+                  '--source-root', bad_tree, '--baseline', baseline,
+                  '--write-baseline'], capsys)
+    assert rc == 0
+    entries = json.loads((tmp_path / 'bl.json').read_text())['findings']
+    fps = {e['fingerprint'] for e in entries}
+    assert 'feedfacefeedface' in fps, 'skipped-tier entry was dropped'
+    assert len(fps) > 1, 'current source findings missing'
+
+
+def test_obs_dir_recompile_crosscheck(tmp_path, capsys):
+    obs = tmp_path / 'obs'
+    obs.mkdir()
+    (obs / 'timings.json').write_text(json.dumps({
+        'compile': {'events': 40},
+        'padding_buckets': [
+            {'batch': 8, 'nodes': '32x40', 'edges': '64x80', 'count': 2},
+            {'batch': 8, 'nodes': '24x40', 'edges': '64x80', 'count': 1}],
+    }))
+    rc, out = _run(['--json', '--skip-trace', '--skip-source',
+                    '--obs-dir', str(obs),
+                    '--baseline', str(tmp_path / 'bl.json'),
+                    '--fail-on', 'none'], capsys)
+    assert rc == 0
+    rules = [f['rule'] for f in json.loads(out)['findings']]
+    assert 'RCP201' in rules and 'RCP202' in rules

@@ -1,0 +1,64 @@
+"""Recompile-hazard pass: bucket dominance + telemetry cross-check."""
+
+from dgmc_tpu.analysis import analyze_buckets, bucket_signature
+
+
+def _bucket(batch, nodes, edges, count=1):
+    return {'batch': batch, 'nodes': nodes, 'edges': edges, 'count': count}
+
+
+def test_identical_buckets_share_a_signature():
+    a = _bucket(8, '32x40', '64x80')
+    b = _bucket(8, '32x40', '64x80', count=5)
+    assert bucket_signature(a) == bucket_signature(b)
+
+
+def test_different_padding_changes_the_signature():
+    assert (bucket_signature(_bucket(8, '32x40', '64x80'))
+            != bucket_signature(_bucket(8, '33x40', '64x80')))
+
+
+def test_dominated_bucket_flagged_rcp201():
+    buckets = [_bucket(8, '32x40', '64x80'),
+               _bucket(8, '24x40', '64x80', count=3)]
+    findings = analyze_buckets(buckets)
+    assert [f.rule for f in findings] == ['RCP201']
+    assert 'nodes=24x40' in findings[0].message
+    assert 'dominated by' in findings[0].message
+
+
+def test_incomparable_buckets_are_clean():
+    # Neither dominates: one is wider in nodes, the other in edges.
+    buckets = [_bucket(8, '48x40', '64x80'),
+               _bucket(8, '32x40', '96x80')]
+    assert analyze_buckets(buckets) == []
+
+
+def test_single_bucket_is_clean():
+    assert analyze_buckets([_bucket(8, '32x40', '64x80')]) == []
+
+
+def test_telemetry_crosscheck_fires_rcp202():
+    buckets = [_bucket(8, '32x40', '64x80')]
+    findings = analyze_buckets(buckets, compile_events=50)
+    assert [f.rule for f in findings] == ['RCP202']
+    assert '50 compile events' in findings[0].message
+
+
+def test_telemetry_within_budget_is_clean():
+    buckets = [_bucket(8, '32x40', '64x80')]
+    assert analyze_buckets(buckets, compile_events=3) == []
+
+
+def test_obs_dir_roundtrip(tmp_path):
+    import json
+    from dgmc_tpu.analysis.recompile import load_obs_buckets
+    (tmp_path / 'timings.json').write_text(json.dumps({
+        'compile': {'events': 4},
+        'padding_buckets': [
+            {'batch': 8, 'nodes': '32x40', 'edges': '64x80', 'count': 7}],
+    }))
+    buckets, events = load_obs_buckets(str(tmp_path))
+    assert events == 4
+    assert buckets[0]['count'] == 7
+    assert load_obs_buckets(str(tmp_path / 'missing')) == ([], None)

@@ -1,0 +1,66 @@
+"""The full repo lint matches the committed baseline, and the probe-free
+train step carries zero host callbacks (the PR 3 byte-identical-HLO
+guarantee, as a static check)."""
+
+import os
+
+import jax
+
+from dgmc_tpu.analysis import (callback_equations, load_baseline,
+                               lint_source_tree, run_trace_tier,
+                               split_by_baseline)
+from dgmc_tpu.analysis.jaxpr_rules import TraceContext, analyze_closed_jaxpr
+from dgmc_tpu.analysis.registry import default_specimens, probes_forced_off
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+BASELINE = os.path.join(REPO, 'lint-baseline.json')
+
+
+def test_repo_lint_matches_committed_baseline():
+    """No finding outside the reviewed ledger — the exact check CI runs
+    (``dgmc-lint --fail-on new``)."""
+    baseline = load_baseline(BASELINE)
+    assert baseline, f'missing committed baseline at {BASELINE}'
+    import dgmc_tpu
+    pkg = os.path.dirname(os.path.abspath(dgmc_tpu.__file__))
+    findings = lint_source_tree(pkg) + run_trace_tier()
+    new, suppressed = split_by_baseline(findings, baseline)
+    assert not new, (
+        'findings not in lint-baseline.json (fix them or re-run '
+        '`dgmc-lint --write-baseline` after review): '
+        + '; '.join(f'{f.rule} {f.where}: {f.message}' for f in new))
+    assert suppressed, 'baseline matched nothing — ledger is stale'
+
+
+def _train_step_jaxpr():
+    (spec,) = [s for s in default_specimens()
+               if s.name == 'train_step_dense']
+    built = spec.build()
+    return jax.make_jaxpr(built['fn'])(*built['args'])
+
+
+def test_probe_free_train_step_has_zero_callback_equations():
+    from dgmc_tpu.obs import probes
+    assert not probes.enabled()
+    with probes_forced_off():
+        closed = _train_step_jaxpr()
+    assert callback_equations(closed) == []
+    assert analyze_closed_jaxpr(
+        closed, TraceContext(specimen='train_step_dense')) == [
+        f for f in analyze_closed_jaxpr(
+            closed, TraceContext(specimen='train_step_dense'))
+        if f.rule == 'TRC005'], 'only the known scatter sites may fire'
+
+
+def test_probe_enabled_train_step_is_flagged():
+    """Positive control: with probes on, the same specimen DOES lower
+    callbacks — and TRC003 reports every site."""
+    from dgmc_tpu.obs import probes
+    with probes.activated(probes.ProbeLog()):
+        closed = _train_step_jaxpr()
+    hits = callback_equations(closed)
+    assert hits, 'probes enabled but no callbacks lowered'
+    findings = analyze_closed_jaxpr(
+        closed, TraceContext(specimen='train_step_dense'))
+    assert any(f.rule == 'TRC003' for f in findings)

@@ -1,0 +1,112 @@
+"""Source-tier golden tests over the fixtures module + bytecode refusal."""
+
+import os
+
+import pytest
+
+from dgmc_tpu.analysis import lint_source_file
+from dgmc_tpu.analysis.source_rules import iter_source_files
+
+FIXTURES = os.path.join(os.path.dirname(__file__), 'fixtures.py')
+
+
+def _by_rule(findings):
+    out = {}
+    for f in findings:
+        out.setdefault(f.rule, []).append(f)
+    return out
+
+
+@pytest.fixture(scope='module')
+def findings():
+    return lint_source_file(FIXTURES)
+
+
+def test_fixture_file_trips_every_source_rule(findings):
+    assert sorted(_by_rule(findings)) == ['SRC101', 'SRC102', 'SRC103',
+                                          'SRC104']
+
+
+def test_tracer_leak_on_self(findings):
+    (f,) = _by_rule(findings)['SRC101']
+    assert '`self.last`' in f.message
+    assert '`step`' in f.message
+
+
+def test_host_sync_float(findings):
+    (f,) = _by_rule(findings)['SRC102']
+    assert '`float(...)`' in f.message
+    assert '`host_sync`' in f.message
+
+
+def test_jit_in_loop(findings):
+    (f,) = _by_rule(findings)['SRC103']
+    assert 'inside a loop' in f.message
+
+
+def test_unhashable_static_default(findings):
+    (f,) = _by_rule(findings)['SRC104']
+    assert '`cfg`' in f.message
+    assert 'list' in f.message
+
+
+def test_findings_carry_file_line_locations(findings):
+    for f in findings:
+        path, line = f.where.rsplit(':', 1)
+        assert path.endswith('fixtures.py')
+        assert int(line) > 0
+
+
+def test_refuses_pyc(tmp_path):
+    pyc = tmp_path / 'mod.pyc'
+    pyc.write_bytes(b'\x00\x00\x00\x00')
+    with pytest.raises(ValueError, match='refusing to scan bytecode'):
+        lint_source_file(str(pyc))
+
+
+def test_refuses_pycache_paths(tmp_path):
+    d = tmp_path / '__pycache__'
+    d.mkdir()
+    src = d / 'mod.py'
+    src.write_text('x = 1\n')
+    with pytest.raises(ValueError, match='refusing to scan bytecode'):
+        lint_source_file(str(src))
+
+
+def test_walker_never_descends_into_pycache(tmp_path):
+    (tmp_path / 'ok.py').write_text('x = 1\n')
+    cache = tmp_path / '__pycache__'
+    cache.mkdir()
+    (cache / 'stale.py').write_text('x = 1\n')
+    (cache / 'stale.pyc').write_bytes(b'\x00')
+    found = [os.path.basename(p) for p in iter_source_files(str(tmp_path))]
+    assert found == ['ok.py']
+
+
+def test_unhashable_static_kwonly_and_posonly(tmp_path):
+    """static_argnames reaching a KEYWORD-ONLY param's mutable default,
+    and static_argnums indexing across positional-only params."""
+    p = tmp_path / 'kwonly.py'
+    p.write_text(
+        'import functools\n'
+        'import jax\n\n\n'
+        "@functools.partial(jax.jit, static_argnames=('cfg',))\n"
+        'def step(x, *, cfg={}):\n'
+        '    return x\n\n\n'
+        '@functools.partial(jax.jit, static_argnums=(1,))\n'
+        'def posonly(x, /, opts=[1]):\n'
+        '    return x\n')
+    findings = lint_source_file(str(p))
+    assert sorted(f.rule for f in findings) == ['SRC104', 'SRC104']
+    msgs = ' '.join(f.message for f in findings)
+    assert '`cfg`' in msgs and '`opts`' in msgs
+
+
+def test_clean_file_produces_no_findings(tmp_path):
+    p = tmp_path / 'clean.py'
+    p.write_text(
+        'import jax\n\n'
+        '@jax.jit\n'
+        'def f(x):\n'
+        '    return x * 2.0\n')
+    assert lint_source_file(str(p)) == []

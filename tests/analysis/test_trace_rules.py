@@ -1,0 +1,130 @@
+"""Golden detection tests: each trace-tier defect class fires exactly
+its intended rule, and clean programs fire nothing."""
+
+import jax
+import jax.numpy as jnp
+
+from dgmc_tpu.analysis import (analyze_closed_jaxpr, analyze_donation,
+                               callback_equations)
+from dgmc_tpu.analysis.jaxpr_rules import TraceContext
+from tests.analysis import fixtures
+
+
+def _rules(findings):
+    return sorted({f.rule for f in findings})
+
+
+def _analyze(fn, *args, **ctx_kw):
+    closed = jax.make_jaxpr(fn)(*args)
+    return analyze_closed_jaxpr(closed, TraceContext(specimen='fixture',
+                                                     **ctx_kw))
+
+
+def test_dtype_drift_fires_trc001_only():
+    from jax.experimental import enable_x64
+    with enable_x64():
+        findings = _analyze(fixtures.dtype_drift,
+                            jnp.ones((4,), jnp.float32))
+    assert _rules(findings) == ['TRC001']
+    f = findings[0]
+    assert 'float64' in f.message
+    assert f.where.startswith('fixture:')
+    assert 'fixtures.py' in f.where
+
+
+def test_dtype_drift_masked_without_x64_is_clean():
+    # With x64 off jax truncates the promotion — nothing to flag (and
+    # nothing false-positive about the f32 math that remains).
+    findings = _analyze(fixtures.dtype_drift, jnp.ones((4,), jnp.float32))
+    assert findings == []
+
+
+def test_giant_constant_fires_trc002_only():
+    findings = _analyze(fixtures.giant_constant, jnp.ones((600,)))
+    assert _rules(findings) == ['TRC002']
+    assert '(600, 600)' in findings[0].message
+
+
+def test_giant_constant_respects_threshold():
+    findings = _analyze(fixtures.giant_constant, jnp.ones((600,)),
+                        const_bytes=16 << 20)
+    assert findings == []
+
+
+def test_leaked_callback_fires_trc003_only():
+    findings = _analyze(fixtures.leaked_callback, jnp.ones((8,)))
+    assert _rules(findings) == ['TRC003']
+    assert 'debug_callback' in findings[0].message
+
+
+def test_callback_rule_respects_expectation_flag():
+    findings = _analyze(fixtures.leaked_callback, jnp.ones((8,)),
+                        expect_no_callbacks=False)
+    assert findings == []
+
+
+def test_dropped_donation_fires_trc004_only():
+    findings = analyze_donation(fixtures.dropped_donation,
+                                (jnp.ones((64, 64)),),
+                                donate_argnums=(0,), specimen='fixture')
+    assert _rules(findings) == ['TRC004']
+    assert findings[0].severity.name == 'ERROR'
+
+
+def test_retained_donation_is_clean():
+    findings = analyze_donation(lambda x: x * 2.0, (jnp.ones((64, 64)),),
+                                donate_argnums=(0,), specimen='fixture')
+    assert findings == []
+
+
+def test_big_sort_fires_trc006_only():
+    findings = _analyze(fixtures.big_sort, jnp.ones((2, 8192)),
+                        sort_dim=4096)
+    assert _rules(findings) == ['TRC006']
+
+
+def test_small_sort_is_clean():
+    findings = _analyze(fixtures.big_sort, jnp.ones((2, 64)))
+    assert findings == []
+
+
+def test_scatter_without_unique_indices_fires_trc005():
+    def scatter_add(x, idx, upd):
+        return x.at[idx].add(upd)
+
+    findings = _analyze(scatter_add, jnp.zeros((16,)),
+                        jnp.array([1, 2, 2]), jnp.ones((3,)))
+    assert _rules(findings) == ['TRC005']
+    # One finding per site, occurrence count in detail.
+    assert len(findings) == 1
+    assert '1 equation(s)' in findings[0].detail
+
+
+def test_clean_program_produces_no_findings():
+    def clean(x, y):
+        return jnp.tanh(x) @ y
+
+    findings = _analyze(clean, jnp.ones((8, 8)), jnp.ones((8, 4)))
+    assert findings == []
+
+
+def test_rules_walk_nested_jaxprs():
+    """Hazards inside scan/pjit sub-jaxprs are still found."""
+    def nested(x):
+        def body(c, _):
+            jax.debug.callback(lambda v: None, jnp.sum(c))
+            return c * 2.0, None
+        y, _ = jax.lax.scan(body, x, None, length=3)
+        return y
+
+    findings = _analyze(nested, jnp.ones((4,)))
+    assert _rules(findings) == ['TRC003']
+
+
+def test_callback_equations_reports_provenance():
+    closed = jax.make_jaxpr(fixtures.leaked_callback)(jnp.ones((4,)))
+    hits = callback_equations(closed)
+    assert len(hits) == 1
+    name, prov = hits[0]
+    assert name == 'debug_callback'
+    assert 'fixtures.py' in prov

@@ -23,8 +23,39 @@ use, so the fixture must also ``reset_cache()`` on every transition —
 flipping the config flag alone would be silently ignored.
 """
 
+import contextlib
+
 import jax
 import pytest
+
+
+@contextlib.contextmanager
+def pinned_partitionable_threefry():
+    """Pin the modern RNG partitioning for sharded-lowering assertions.
+
+    The collective-profile tests assert that row-sharded state never
+    rides a full-width collective; with the pre-0.5 default
+    ``jax_threefry_partitionable=False``, GSPMD materializes each shard's
+    random bits at full width and collective-permutes them — an artifact
+    of the legacy RNG lowering, not of this repo's sharding. The flag is
+    part of jax's trace context (jit caches key on it), so scoping it to
+    this package cannot leak compiled programs elsewhere.
+
+    A contextmanager (not only a fixture) because module-scoped fixtures
+    lowering HLO set up BEFORE function-scoped autouse fixtures — they
+    must pin the flag around their own lowering."""
+    prev = jax.config.jax_threefry_partitionable
+    jax.config.update('jax_threefry_partitionable', True)
+    try:
+        yield
+    finally:
+        jax.config.update('jax_threefry_partitionable', prev)
+
+
+@pytest.fixture(autouse=True)
+def _partitionable_threefry():
+    with pinned_partitionable_threefry():
+        yield
 
 
 @pytest.fixture(autouse=True)

@@ -70,8 +70,13 @@ def hlo_text():
     state = create_train_state(base, jax.random.key(0), tiny,
                                learning_rate=1e-3)
     step = make_sharded_train_step(model, mesh, batch_axis=None)
-    return step.lower(replicate(state, mesh), replicate(batch, mesh),
-                      jax.random.key(1)).compile().as_text()
+    # Module-scoped: lowers BEFORE the conftest's function-scoped
+    # autouse fixture, so the RNG pin must wrap this lowering itself
+    # (see pinned_partitionable_threefry for why the pin exists).
+    from tests.parallel.conftest import pinned_partitionable_threefry
+    with pinned_partitionable_threefry():
+        return step.lower(replicate(state, mesh), replicate(batch, mesh),
+                          jax.random.key(1)).compile().as_text()
 
 
 def _collectives(txt):
@@ -113,8 +118,15 @@ def test_projection_all_reduce_present(hlo_text):
 
 
 def test_grad_reduction_bounded(hlo_text):
+    from dgmc_tpu.parallel.compat import HAS_NATIVE_SHARD_MAP
     n = sum(1 for c in _collectives(hlo_text) if c[0] == 'all-reduce')
     # 2 consensus iterations: 2-3 projection reduces + a handful of grad
     # group reduces. A regression into per-iteration re-reduction of
-    # gradients or re-gathered state would blow well past this.
-    assert n <= 20, f'{n} all-reduces — grads should reduce once per group'
+    # gradients or re-gathered state would blow well past this. Pre-0.5
+    # GSPMD emits one all-reduce per gradient LEAF (no combiner pass on
+    # this path — ~50 for this model) where modern XLA merges them per
+    # group; the bound scales accordingly so the per-iteration blowup
+    # (O(num_steps * leaves), >100 here) is still caught.
+    limit = 20 if HAS_NATIVE_SHARD_MAP else 64
+    assert n <= limit, (f'{n} all-reduces (limit {limit}) — grads should '
+                        f'reduce once per group')

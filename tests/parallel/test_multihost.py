@@ -15,7 +15,15 @@ import socket
 import subprocess
 import sys
 
+import jax
+import pytest
+
 WORKER = os.path.join(os.path.dirname(__file__), '_multihost_worker.py')
+
+# Cross-process collectives on the CPU backend arrived with the
+# cpu_collectives_implementation knob (gloo); a jaxlib without it fails
+# every multiprocess CPU computation with INVALID_ARGUMENT.
+_CPU_MULTIPROCESS = hasattr(jax.config, 'jax_cpu_collectives_implementation')
 
 
 def _free_port():
@@ -24,6 +32,9 @@ def _free_port():
         return s.getsockname()[1]
 
 
+@pytest.mark.skipif(not _CPU_MULTIPROCESS,
+                    reason='this jaxlib has no CPU multiprocess '
+                           'collectives (no gloo backend)')
 def test_two_process_sharded_training():
     port = _free_port()
     env = dict(os.environ)

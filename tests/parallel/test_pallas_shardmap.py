@@ -21,6 +21,7 @@ from jax.sharding import PartitionSpec as P
 
 from dgmc_tpu.ops.pallas.topk import pallas_topk
 from dgmc_tpu.ops.topk import dense_topk
+from dgmc_tpu.parallel.compat import HAS_NATIVE_SHARD_MAP, shard_map
 from dgmc_tpu.parallel.mesh import make_mesh
 
 pytestmark = pytest.mark.skipif(len(jax.devices()) < 8,
@@ -36,7 +37,7 @@ def test_pallas_topk_rows_under_shard_map():
     interp = jax.default_backend() != 'tpu'
 
     @functools.partial(
-        jax.shard_map, mesh=mesh,
+        shard_map, mesh=mesh,
         in_specs=(P(None, 'model', None), P(), P()),
         out_specs=P(None, 'model', None), check_vma=False)
     def rows(hs, ht, tm):
@@ -47,6 +48,9 @@ def test_pallas_topk_rows_under_shard_map():
     np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
 
 
+@pytest.mark.skipif(not HAS_NATIVE_SHARD_MAP,
+                    reason='pre-vma JAX: shard_map has no pallas_call '
+                           'replication rule; check_rep cannot pass')
 def test_pallas_topk_vma_declared_under_check_vma():
     """With check_vma ON (the default), the kernel's declared vma makes
     the shard_map typecheck pass on TPU; on CPU the interpret-mode body
@@ -59,7 +63,7 @@ def test_pallas_topk_vma_declared_under_check_vma():
     h_t = jnp.asarray(r.randn(1, 96, 16).astype(np.float32))
 
     @functools.partial(
-        jax.shard_map, mesh=mesh,
+        shard_map, mesh=mesh,
         in_specs=(P(None, 'model', None), P()),
         out_specs=P(None, 'model', None))
     def rows(hs, ht):
