@@ -43,13 +43,26 @@ protocol change (the timed region is identical).
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", extras...}.
 
 Timeout diagnosability: every section reports into a host-side progress
-ledger, and a SIGTERM/SIGALRM (what ``timeout(1)`` sends) makes the
+ledger (and a one-line JSON progress record per completed section on
+stderr), and a SIGTERM/SIGALRM (what ``timeout(1)`` sends) makes the
 process print a PARTIAL JSON line — sections completed, per-section
 elapsed, the section in flight — before exiting 124, instead of dying
 silently like ``BENCH_r05.json`` (``rc: 124, parsed: null``). With
 ``--obs-dir`` the run additionally leaves the standard telemetry
 artifacts (``python -m dgmc_tpu.obs.report <dir>``), flushed after every
-section so they survive a kill too.
+section so they survive a kill too, and ``--watchdog-deadline SEC`` arms
+the run-health watchdog (``hang_report.json`` on stall or SIGTERM —
+``dgmc_tpu/obs/watchdog.py``).
+
+``--section-timeout SEC`` gives every section its own deadline budget
+(``signal.setitimer``): a section exceeding it is recorded as
+``{'ok': False, 'timeout': True}`` and the run MOVES ON to the next
+section, so one stuck section no longer consumes the whole run and the
+final JSON line still carries every completed section's numbers (the
+BENCH_r05/MULTICHIP failure mode left ``parsed: null`` for everything).
+Caveat: the timeout interrupts at the next Python bytecode — a hang
+inside one C-level XLA call still needs the external ``timeout(1)``,
+which the partial-line handler and the watchdog then make diagnosable.
 """
 
 import argparse
@@ -94,19 +107,14 @@ SP_ITERS = 10
 TOPK_ITERS = 10
 
 
-# Documented dense-matmul peak FLOP/s per chip (bf16, from the public TPU
-# spec sheets). MFU below is flops / (step_time * peak): an honest ceiling
-# ratio — f32 HIGHEST-precision matmuls can at best reach ~1/6 of the bf16
-# peak, so these MFU numbers understate kernel quality but are comparable
-# round over round and across chips.
-PEAK_FLOPS = {
-    'TPU v4': 275e12,
-    'TPU v5 lite': 197e12,   # v5e
-    'TPU v5e': 197e12,
-    'TPU v5': 459e12,        # v5p
-    'TPU v5p': 459e12,
-    'TPU v6 lite': 918e12,   # v6e / Trillium
-}
+# Peak-FLOPs accounting moved to dgmc_tpu/obs/cost.py (one table for
+# bench, the efficiency.json artifact, and the report/diff layers); the
+# alias keeps this module's historical surface. MFU remains
+# flops / (step_time * peak) against the bf16 peak — see obs/cost.py for
+# the honest-ceiling caveats — and now also resolves on CPU via the
+# nominal fallback entry.
+from dgmc_tpu.obs.cost import (PEAK_FLOPS,  # noqa: E402,F401  (re-export)
+                               peak_flops_entry)
 
 
 # ---------------------------------------------------------------------------
@@ -114,35 +122,80 @@ PEAK_FLOPS = {
 # ---------------------------------------------------------------------------
 
 _PROGRESS = {'sections': {}, 'current': None, 'current_t0': None,
-             'start': time.time()}
+             'in_body': False, 'start': time.time()}
 _OBS = None  # RunObserver when --obs-dir is set
+#: Per-section deadline budget in seconds (0 = off); set by
+#: --section-timeout. While a section runs with a budget, SIGALRM means
+#: "this section blew its budget" and raises SectionTimeout into the
+#: section body instead of killing the run.
+_SECTION_TIMEOUT = {'seconds': 0.0}
+
+
+class SectionTimeout(Exception):
+    """Raised (from the SIGALRM handler) into a section body that
+    exceeded its ``--section-timeout`` budget."""
 
 
 @contextlib.contextmanager
 def _section(name):
     """Track one benchmark section in the progress ledger (and in the
     --obs-dir artifacts), so a timeout mid-run still reports which
-    sections finished and where time went."""
+    sections finished and where time went.
+
+    With ``--section-timeout``, arms a per-section ``setitimer`` budget;
+    a :class:`SectionTimeout` is recorded (``'timeout': True``) and
+    SWALLOWED — the caller's leg variables keep their pre-section
+    values (``None``) and the run proceeds to the next section. Real
+    exceptions still propagate. Every completed section also emits one
+    JSON progress line on stderr (stdout stays the one-line protocol).
+    """
     # t0 before name: a signal between the two assignments must never see
     # current set with current_t0 still None (the handler reads both).
     wall0 = time.time()
     t0 = _PROGRESS['current_t0'] = time.perf_counter()
     _PROGRESS['current'] = name
+    budget = _SECTION_TIMEOUT['seconds']
+    if budget > 0:
+        signal.setitimer(signal.ITIMER_REAL, budget)
+    timed_out = False
     try:
+        _PROGRESS['in_body'] = True
         yield
+        # Body done: a budget alarm delivered from here on is moot (the
+        # section DID finish) — the handler checks in_body and ignores
+        # it instead of raising into bookkeeping or, worse, out of the
+        # finally block after the itimer-cancel point.
+        _PROGRESS['in_body'] = False
         _PROGRESS['sections'][name] = {
             'ok': True, 'elapsed_s': round(time.perf_counter() - t0, 3)}
+    except SectionTimeout:
+        timed_out = True
+        _PROGRESS['sections'][name] = {
+            'ok': False, 'timeout': True,
+            'elapsed_s': round(time.perf_counter() - t0, 3),
+            'error': f'section exceeded --section-timeout {budget}s'}
     except Exception as e:
         _PROGRESS['sections'][name] = {
             'ok': False, 'elapsed_s': round(time.perf_counter() - t0, 3),
             'error': f'{type(e).__name__}: {e}'}
         raise
     finally:
+        _PROGRESS['in_body'] = False
+        if budget > 0:
+            signal.setitimer(signal.ITIMER_REAL, 0)
         _PROGRESS['current'] = _PROGRESS['current_t0'] = None
+        rec = _PROGRESS['sections'].get(name, {})
+        print(json.dumps({'section': name, **rec}), file=sys.stderr,
+              flush=True)
         if _OBS is not None:
             _OBS.record_section(name, wall0, time.perf_counter() - t0)
-            _OBS.log(name, **_PROGRESS['sections'].get(name, {}))
+            _OBS.log(name, **rec)
             _OBS.snapshot_memory(name)
+    if timed_out and _OBS is not None:
+        # The stuck section is worth a hang report even though the run
+        # survives: the all-thread stacks say WHERE the budget went.
+        if _OBS.watchdog is not None:
+            _OBS.watchdog.dump(f'section-timeout:{name}')
 
 
 def _emit_partial(signum, frame):
@@ -170,9 +223,33 @@ def _emit_partial(signum, frame):
     os._exit(124)
 
 
+def _on_signal(signum, frame):
+    """SIGTERM/SIGALRM dispatcher.
+
+    A SIGALRM is the section's OWN budget expiry only when a budgeted
+    section is current AND its elapsed time has actually reached the
+    budget — an external SIGALRM (``timeout -s ALRM``) landing mid-body
+    before that must still kill the run with the partial line, not be
+    swallowed as a fake section timeout. An own-budget alarm delivered
+    in the section's bookkeeping (body finished within epsilon of the
+    budget) is moot and ignored — raising there would escape the
+    context manager's except scope and kill the run without its JSON
+    line. Everything else is the external kill: emit the partial line
+    and exit 124."""
+    budget = _SECTION_TIMEOUT['seconds']
+    t0 = _PROGRESS['current_t0']
+    if (signum == signal.SIGALRM and budget > 0
+            and _PROGRESS['current'] is not None and t0 is not None
+            and time.perf_counter() - t0 >= budget - 0.05):
+        if _PROGRESS['in_body']:
+            raise SectionTimeout(_PROGRESS['current'])
+        return
+    _emit_partial(signum, frame)
+
+
 def _install_signal_handlers():
     for sig in (signal.SIGTERM, signal.SIGALRM):
-        signal.signal(sig, _emit_partial)
+        signal.signal(sig, _on_signal)
 
 
 def _aot_compile(jitted, *args, attempts=3):
@@ -208,11 +285,12 @@ def _perf_stats(compiled, step_seconds):
         flops = float(ca.get('flops', 0.0))
         if flops > 0:
             out['flops_per_step'] = flops
-            kind = jax.devices()[0].device_kind
-            peak = PEAK_FLOPS.get(kind)
-            if peak and step_seconds:
-                out['mfu'] = round(flops / (step_seconds * peak), 4)
-                out['mfu_peak_ref'] = f'{kind} bf16 {peak:.0f}'
+            peak = peak_flops_entry(jax.devices()[0])
+            if peak['peak_flops'] and step_seconds:
+                out['mfu'] = round(
+                    flops / (step_seconds * peak['peak_flops']), 6)
+                out['mfu_peak_ref'] = (f'{peak["ref"]} '
+                                       f'{peak["peak_flops"]:.0f}')
     except Exception:
         pass
     from dgmc_tpu.obs.memory import compiled_memory
@@ -220,6 +298,13 @@ def _perf_stats(compiled, step_seconds):
     if cm:
         out['peak_hbm_gib'] = round(cm['total_bytes'] / 2**30, 3)
     return out
+
+
+def _obs_cost(name, compiled, step_seconds):
+    """Register one AOT-compiled leg in the --obs-dir efficiency.json
+    (exact Compiled.cost_analysis totals + post-GSPMD collectives)."""
+    if _OBS is not None:
+        _OBS.record_cost(name, compiled, step_time_s=step_seconds)
 
 
 def _best_of(run_window, windows=3):
@@ -295,6 +380,7 @@ def bench_dense(bf16=False):
 
     dt = _best_of(window)
     assert np.isfinite(loss)
+    _obs_cost('dense_bf16' if bf16 else 'dense_f32', step, dt / ITERS)
     return BATCH * ITERS / dt, _perf_stats(step, dt / ITERS)
 
 
@@ -364,6 +450,7 @@ def _bench_sparse_leg(bf16):
 
     step_ms = _best_of(window) / SP_ITERS * 1e3
     assert np.isfinite(loss)
+    _obs_cost('sparse_bf16' if bf16 else 'sparse_f32', step, step_ms / 1e3)
     perf = _perf_stats(step, step_ms / 1e3)
     # Live allocator peak is PROCESS-LIFETIME: only the first (f32) leg
     # can attribute it; later legs would just echo the earlier maximum,
@@ -383,6 +470,10 @@ def bench_sparse():
     of it would measure the same kernel repeatedly; r03's did)."""
     from dgmc_tpu.ops.topk import chunked_topk
 
+    # Legs pre-initialize to None so a --section-timeout'd section
+    # (SectionTimeout swallowed by _section) degrades to a missing leg
+    # in the result instead of an unbound variable.
+    f32_ms = f32_perf = step_ms = perf = None
     with _section('sparse_f32'):
         f32_ms, f32_perf = _bench_sparse_leg(bf16=False)
     with _section('sparse_bf16'):
@@ -420,16 +511,15 @@ def bench_sparse():
 
             topk_ms[name] = round(_best_of(window) / TOPK_ITERS * 1e3, 2)
 
-    return {
-        'shape': f'{SP_N_S}x{SP_N_T} k={SP_K} steps={NUM_STEPS}',
+    out = {'shape': f'{SP_N_S}x{SP_N_T} k={SP_K} steps={NUM_STEPS}',
+           'topk_ms': topk_ms}
+    if step_ms is not None:
         # Flagship leg: the bf16 compute policy (quality-gated; see
         # module docstring). The f32 leg ships alongside it.
-        'step_ms': round(step_ms, 1),
-        'flagship': 'bf16',
-        'f32': {'step_ms': round(f32_ms, 1), **f32_perf},
-        'topk_ms': topk_ms,
-        **perf,
-    }
+        out.update(step_ms=round(step_ms, 1), flagship='bf16', **perf)
+    if f32_ms is not None:
+        out['f32'] = {'step_ms': round(f32_ms, 1), **f32_perf}
+    return out
 
 
 def main(argv=None):
@@ -438,16 +528,30 @@ def main(argv=None):
                               start_profile)
     add_obs_flag(parser)
     add_profile_flag(parser)
+    parser.add_argument(
+        '--section-timeout', '--section_timeout', dest='section_timeout',
+        type=float, default=0.0, metavar='SEC',
+        help='per-section deadline budget: a section exceeding SEC '
+             'seconds is recorded as timed out and the run moves on, so '
+             'one stuck section cannot consume the whole run (0 = off)')
     args = parser.parse_args(argv)
+    _SECTION_TIMEOUT['seconds'] = max(0.0, args.section_timeout)
+    # Bench's own handlers FIRST, then the observer: the watchdog chains
+    # to whatever was installed before it, so a SIGTERM dumps
+    # hang_report.json and THEN prints the partial line + exit 124.
+    _install_signal_handlers()
     global _OBS
-    if args.obs_dir or args.probes:
+    if args.obs_dir or args.probes or args.watchdog_deadline:
         # --probes without --obs-dir still flips the trace-time probe
         # switch (a disabled observer carries no sink) so a probe-overhead
         # bench run measures what it claims to — same contract as the
         # experiment CLIs, which construct their observer unconditionally.
-        _OBS = RunObserver(args.obs_dir, probes=args.probes)
+        # SIGALRM stays bench's alone (--section-timeout budgets); the
+        # watchdog arms SIGTERM only.
+        _OBS = RunObserver(args.obs_dir, probes=args.probes,
+                           watchdog_deadline_s=args.watchdog_deadline,
+                           watchdog_signals=(signal.SIGTERM,))
     prof = start_profile(args.profile_dir)
-    _install_signal_handlers()
 
     # Sparse first: the allocator's peak_bytes_in_use is process-lifetime,
     # so the sparse leg must run before anything else allocates if its
@@ -456,12 +560,16 @@ def main(argv=None):
         sparse = bench_sparse()
     except Exception as e:  # never let the sparse leg kill the primary line
         sparse = {'error': f'{type(e).__name__}: {e}'}
+    pairs_per_sec, dense_stats = None, {}
     with _section('dense_f32'):
         pairs_per_sec, dense_stats = bench_dense()
     try:
         with _section('dense_bf16'):
             bf16_pps, bf16_stats = bench_dense(bf16=True)
-        dense_bf16 = {'pairs_per_sec': round(bf16_pps, 2), **bf16_stats}
+        dense_bf16 = ({'pairs_per_sec': round(bf16_pps, 2), **bf16_stats}
+                      if not _PROGRESS['sections'].get(
+                          'dense_bf16', {}).get('timeout')
+                      else {'error': 'timeout'})
     except Exception as e:
         dense_bf16 = {'error': f'{type(e).__name__}: {e}'}
 
@@ -480,19 +588,24 @@ def main(argv=None):
     baseline = stored.get('value')
     sparse_baseline_ms = stored.get('sparse_step_ms')
     reseed = not stored
-    if baseline is None:
+    if baseline is None and pairs_per_sec is not None:
         baseline = pairs_per_sec
         reseed = True
-    if sparse_baseline_ms is None and 'step_ms' in sparse:
-        # Seed the sparse baseline from the F32 leg: the baseline contract
-        # (module docstring) is an f32-policy number, so a fresh
-        # environment pins the same policy the shipped baseline used —
-        # otherwise the bf16 flagship would seed itself and read 1.0
-        # forever while the f32 extra read as a fake regression.
-        sparse_baseline_ms = sparse.get('f32', {}).get('step_ms',
-                                                       sparse['step_ms'])
+    if sparse_baseline_ms is None and 'f32' in sparse:
+        # Seed the sparse baseline from the F32 leg ONLY: the baseline
+        # contract (module docstring) is an f32-policy number, so a
+        # fresh environment pins the same policy the shipped baseline
+        # used — otherwise the bf16 flagship would seed itself and read
+        # 1.0 forever while the f32 extra read as a fake regression.
+        # No fallback to the bf16 step_ms: with --section-timeout the
+        # f32 leg can now be missing while bf16 completed, and seeding
+        # the f32-policy baseline from a bf16 measurement would fake a
+        # permanent regression on every later full run. Leave the
+        # baseline unseeded; the next run with a complete f32 leg
+        # seeds it.
+        sparse_baseline_ms = sparse['f32']['step_ms']
         reseed = True
-    if reseed:
+    if reseed and baseline is not None:
         with open(BASELINE_FILE, 'w') as f:
             json.dump({'metric': 'train_pairs_per_sec', 'value': baseline,
                        'sparse_step_ms': sparse_baseline_ms,
@@ -504,17 +617,23 @@ def main(argv=None):
         if 'f32' in sparse:
             sparse['f32']['vs_baseline'] = round(
                 sparse_baseline_ms / sparse['f32']['step_ms'], 4)
-    print(json.dumps({
+    rec = {
         'metric': 'train_pairs_per_sec',
-        'value': round(pairs_per_sec, 2),
+        'value': None if pairs_per_sec is None else round(pairs_per_sec, 2),
         'unit': 'pairs/sec',
-        'vs_baseline': round(pairs_per_sec / baseline, 4),
         'device': str(jax.devices()[0].device_kind),
         'dense_perf': dense_stats,
         'dense_bf16': dense_bf16,
         'sparse_dbp15k': sparse,
         'sections': _PROGRESS['sections'],
-    }))
+    }
+    if pairs_per_sec is not None and baseline:
+        rec['vs_baseline'] = round(pairs_per_sec / baseline, 4)
+    if any(s.get('timeout') for s in _PROGRESS['sections'].values()):
+        # Some section blew its --section-timeout budget: the line is
+        # still parseable, with every completed section's numbers.
+        rec['partial'] = True
+    print(json.dumps(rec))
     prof.close()
     if _OBS is not None:
         _OBS.close()

@@ -208,8 +208,8 @@ def main(argv=None):
     # Multi-host bring-up before any backend touch (no-op single-process).
     # jax.devices() then spans every host, so --model_shards can spread the
     # correspondence activations across hosts' chips over DCN/ICI.
-    from dgmc_tpu.parallel import (global_batch, initialize_distributed,
-                                   is_coordinator)
+    from dgmc_tpu.parallel import (global_batch, host_obs_dir,
+                                   initialize_distributed, is_coordinator)
     nproc = initialize_distributed(args.coordinator, args.num_processes,
                                    args.process_id)
     train_batch, test_batch, in_dim = load_batches(args)
@@ -264,8 +264,18 @@ def main(argv=None):
     profile_epoch = min(start_epoch + 1, args.epochs)
 
     logger = MetricLogger(args.metrics_log if is_coordinator() else None)
-    obs = RunObserver(args.obs_dir if is_coordinator() else None,
-                      probes=args.probes)
+    # Per-host obs subdir (obs-dir/host_<k>/ multi-process, the root
+    # solo): every host records — the straggling host is the evidence —
+    # and `python -m dgmc_tpu.obs.aggregate <obs-dir>` merges them.
+    obs = RunObserver(host_obs_dir(args.obs_dir), probes=args.probes,
+                      watchdog_deadline_s=args.watchdog_deadline)
+    # Cost/MFU attribution for both phase programs (one extra trace
+    # each, no extra XLA compile): the refinement step is the headline
+    # 'train_step'; phase 1 keeps its own row.
+    obs.record_cost('phase1_step', phase1, state, train_batch,
+                    jax.random.key(args.seed + 2))
+    obs.record_cost('train_step', phase2, state, train_batch,
+                    jax.random.key(args.seed + 2))
     prof = start_profile(args.profile_dir)
     if start_epoch > 1:
         logger.log(start_epoch - 1, event='resume')
@@ -299,6 +309,9 @@ def main(argv=None):
         if epoch % 10 == 0 or refine:
             key, sub = jax.random.split(key)
             ev = (eval2 if refine else eval1)(state, test_batch, sub)
+            # Per-device completion probe on an epoch that fetches
+            # anyway: the straggler/skew series for obs.aggregate.
+            obs.fence_devices(out['loss'])
             # One batched fetch for loss + all eval metrics. This also
             # drains every epoch queued since the last print, so the
             # reported time is the average over that span.

@@ -66,7 +66,8 @@ def main(argv=None):
     # Multi-host bring-up FIRST (no-op in a plain single-process launch):
     # after this, jax.devices() spans every host and one data mesh drives
     # cross-host gradient collectives (SURVEY.md §2.5's net-new backend).
-    from dgmc_tpu.parallel import (global_batch, initialize_distributed,
+    from dgmc_tpu.parallel import (global_batch, host_obs_dir,
+                                   initialize_distributed,
                                    is_coordinator, local_batch_slice,
                                    make_mesh, make_sharded_eval_step,
                                    make_sharded_train_step)
@@ -166,8 +167,15 @@ def main(argv=None):
     profile_epoch = min(start_epoch + 1, args.epochs)
 
     logger = MetricLogger(args.metrics_log if is_coordinator() else None)
-    obs = RunObserver(args.obs_dir if is_coordinator() else None,
-                      probes=args.probes)
+    # Per-host obs subdir (obs-dir/host_<k>/ multi-process, the root
+    # solo); merge with `python -m dgmc_tpu.obs.aggregate <obs-dir>`.
+    obs = RunObserver(host_obs_dir(args.obs_dir), probes=args.probes,
+                      watchdog_deadline_s=args.watchdog_deadline)
+    # Cost/MFU attribution (one extra trace, no extra XLA compile);
+    # under data parallelism this is the sharded step, so the lowered
+    # account covers the collective-carrying program.
+    obs.record_cost('train_step', step, state, feed(batch0),
+                    jax.random.key(args.seed + 3))
     prof = start_profile(args.profile_dir)
     if start_epoch > 1:
         logger.log(start_epoch - 1, event='resume')
@@ -183,6 +191,9 @@ def main(argv=None):
                 total = total + out['loss']
             if args.profile and epoch == profile_epoch:
                 float(total)  # keep the trace open until execution ends
+        # Per-device completion probe at the epoch boundary (the fetch
+        # below syncs anyway): the straggler series for obs.aggregate.
+        obs.fence_devices(total)
         loss = float(total) / len(train_loader)
         if is_coordinator():
             print(f'Epoch: {epoch:02d}, Loss: {loss:.4f}, '

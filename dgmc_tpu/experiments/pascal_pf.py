@@ -110,7 +110,13 @@ def main(argv=None):
         syn_eval_step = make_eval_step(model)
 
     logger = MetricLogger(args.metrics_log)
-    obs = RunObserver(args.obs_dir, probes=args.probes)
+    from dgmc_tpu.parallel import host_obs_dir
+    obs = RunObserver(host_obs_dir(args.obs_dir), probes=args.probes,
+                      watchdog_deadline_s=args.watchdog_deadline)
+    # One extra trace, no extra XLA compile: the per-stage FLOPs/bytes +
+    # MFU account in <obs-dir>/efficiency.json (obs/cost.py).
+    obs.record_cost('train_step', step, state, batch0,
+                    jax.random.key(args.seed + 2))
     prof = start_profile(args.profile_dir)
     profile_epoch = min(2, args.epochs)
     key = jax.random.key(args.seed + 1)
@@ -134,6 +140,10 @@ def main(argv=None):
                 tot_n += n_b
             if args.profile and epoch == profile_epoch:
                 float(tot_loss)  # keep the trace open until execution ends
+        # Per-device completion probe at the epoch boundary (a host
+        # fetch happens right below anyway): feeds the straggler/skew
+        # series obs.aggregate reports.
+        obs.fence_devices(tot_loss)
         host = jax.device_get({'l': tot_loss, 'c': tot_correct})
         loss = float(host['l']) / len(train_loader)
         acc = float(host['c']) / max(tot_n, 1)

@@ -135,7 +135,13 @@ def main(argv=None):
     # it, so a killed 20-run protocol restarts at the next unfinished run
     # instead of re-pretraining.
     logger = MetricLogger(args.metrics_log)
-    obs = RunObserver(args.obs_dir, probes=args.probes)
+    from dgmc_tpu.parallel import host_obs_dir
+    obs = RunObserver(host_obs_dir(args.obs_dir), probes=args.probes,
+                      watchdog_deadline_s=args.watchdog_deadline)
+    # Cost/MFU attribution in <obs-dir>/efficiency.json (one extra
+    # trace, no extra XLA compile — obs/cost.py).
+    obs.record_cost('train_step', step, state, batch0,
+                    jax.random.key(args.seed + 4))
     prof = start_profile(args.profile_dir)
     ckpt = Checkpointer(args.ckpt_dir) if args.ckpt_dir else None
     runs_path = (os.path.join(args.ckpt_dir, 'runs.json')
@@ -176,6 +182,9 @@ def main(argv=None):
                         need_profile = None
                     first = False
                     total = total + out['loss']
+            # Per-device completion probe at the epoch boundary (the
+            # fetch below syncs anyway): obs.aggregate's skew series.
+            obs.fence_devices(total)
             loss = float(total) / len(pretrain_loader)
             print(f'Epoch: {epoch:02d}, Loss: {loss:.4f}, '
                   f'{time.time() - t0:.1f}s')

@@ -35,7 +35,24 @@ measurements come from:
   probe aggregates and the kernel-dispatch table from those artifacts.
 - :mod:`~dgmc_tpu.obs.diff` — ``python -m dgmc_tpu.obs.diff A B``:
   cross-run regression diff with configurable thresholds and a nonzero
-  exit code — the CI perf gate.
+  exit code — the CI perf gate. A candidate that hung (left a
+  ``hang_report.json``) or whose MFU dropped past threshold fails.
+- :mod:`~dgmc_tpu.obs.watchdog` — run-health watchdog: a heartbeat
+  thread (armed by :class:`RunObserver` via ``--watchdog-deadline``)
+  that dumps ``hang_report.json`` — all-thread tracebacks, the
+  in-flight activity, the last-completed span, pending compile labels,
+  the kernel-dispatch tail — when the run stalls or receives
+  SIGTERM/SIGALRM, so an ``rc: 124`` run is diagnosable.
+- :mod:`~dgmc_tpu.obs.cost` — cost & efficiency attribution:
+  ``cost_analysis`` FLOPs/bytes, per-pipeline-stage attribution via the
+  ``named_scope`` spans in lowered HLO, collective-op accounting in
+  sharded programs, and step-level MFU against a per-backend peak-FLOPs
+  table (CPU fallback included) — the ``efficiency.json`` artifact and
+  the ``python -m dgmc_tpu.obs.cost`` specimen CLI.
+- :mod:`~dgmc_tpu.obs.aggregate` — multi-device/host aggregation:
+  merges per-host obs subdirectories (``obs-dir/host_<k>/``) into a
+  straggler/skew summary (max/median device step-time ratio, per-device
+  memory-peak spread) via ``python -m dgmc_tpu.obs.aggregate``.
 
 Model code carries :func:`jax.named_scope` annotations for the matching
 pipeline's stages (``psi1``, ``initial_corr``, ``topk``,
@@ -48,6 +65,7 @@ from dgmc_tpu.obs.registry import (REGISTRY, CompileWatcher, Registry,
                                    compile_event_count, dispatch_table,
                                    record_dispatch)
 from dgmc_tpu.obs.memory import memory_snapshot
+from dgmc_tpu.obs.watchdog import Watchdog
 from dgmc_tpu.obs.run import RunObserver, add_obs_flag
 from dgmc_tpu.obs.trace import (add_profile_flag, export_chrome_trace,
                                 profile_span, start_profile)
@@ -71,6 +89,7 @@ __all__ = [
     'memory_snapshot',
     'RunObserver',
     'add_obs_flag',
+    'Watchdog',
     'probes',
     'add_profile_flag',
     'export_chrome_trace',

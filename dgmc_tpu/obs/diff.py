@@ -29,6 +29,18 @@ regression thresholds:
 - **probes** — a candidate run that recorded a non-finite stage fails;
   numeric probe aggregates (entropy, consensus delta, grad norm) are
   reported as informational drift rows.
+- **hang reports** — a candidate that left a ``hang_report.json`` the
+  baseline did not have fails unconditionally: a run that hung must
+  never diff as "fewer metrics, pass" (the MULTICHIP rc:124 failure
+  mode). Both-hung compares the rest and notes it; baseline-only-hung
+  is the fix, not a regression.
+- **MFU** — relative decrease of the headline MFU
+  (``efficiency.json``) above ``--max-mfu-regression`` fails, as does
+  an MFU the baseline had but the candidate lost.
+- **skew** — the device step-time skew ratio (``aggregate.json``, see
+  ``obs.aggregate``) growing past ``--max-skew-regression`` fails;
+  runs without aggregation skip the row (the artifact is produced by a
+  separate tool, so absence is not evidence of regression).
 
 Exit codes: 0 = no regression, 1 = regression, 2 = usage/missing input.
 Like the report CLI, this module has **no jax import** — it must gate CI
@@ -49,6 +61,8 @@ DEFAULT_THRESHOLDS = {
     'throughput': 0.25,
     'memory': 0.15,
     'new_compile_events': 5,
+    'mfu': 0.25,
+    'skew': 0.50,
 }
 
 
@@ -110,6 +124,57 @@ def diff_runs(a, b, thresholds=None, allow_kernel_fallback=False):
     timing('step_p95_s', 'step_p95', lambda d: d > thr['step_p95'])
     timing('steps_per_sec', 'throughput',
            lambda d: -d > thr['throughput'])
+
+    # -- hang reports -----------------------------------------------------
+    # Checked before everything else conceptually gates: a hung candidate
+    # must fail even when every surviving metric looks fine (a hang
+    # truncates the run, which usually *improves* the aggregates).
+    ha, hb = a.get('hang_report'), b.get('hang_report')
+    if hb is not None:
+        inf = hb.get('in_flight') or {}
+        status = 'note' if ha is not None else 'REGRESSION'
+        note = (f'candidate hung ({hb.get("reason")}) in '
+                f'{inf.get("phase")}:{inf.get("name")}'
+                + ('; baseline hung too' if ha is not None else ''))
+        rows.append(_row('hang_report', 'absent' if ha is None else
+                         ha.get('reason'), hb.get('reason'), None, None,
+                         status, note))
+    elif ha is not None:
+        rows.append(_row('hang_report', ha.get('reason'), 'absent', None,
+                         None, 'ok', 'baseline hung; candidate did not'))
+
+    # -- MFU --------------------------------------------------------------
+    # Asymmetric like the timings: efficiency the baseline accounted for
+    # but the candidate lost (cost recording broke, run died first) is a
+    # regression, not a skip.
+    mfu_a, mfu_b = a.get('mfu'), b.get('mfu')
+    if mfu_a is not None and mfu_b is None:
+        rows.append(_row('mfu', mfu_a, mfu_b, None, thr['mfu'],
+                         'REGRESSION', 'missing from candidate'))
+    elif mfu_a is None and mfu_b is not None:
+        rows.append(_row('mfu', mfu_a, mfu_b, None, thr['mfu'], 'skipped',
+                         'missing from baseline'))
+    elif mfu_a is not None:
+        d = _rel(mfu_a, mfu_b)
+        if d is None:
+            rows.append(_row('mfu', mfu_a, mfu_b, None, thr['mfu'],
+                             'skipped', 'zero baseline'))
+        else:
+            gate('mfu', mfu_a, mfu_b, round(d, 4), thr['mfu'],
+                 -d > thr['mfu'])
+
+    # -- multi-device skew ------------------------------------------------
+    sk_a = (a.get('skew') or {}).get('step_time_ratio')
+    sk_b = (b.get('skew') or {}).get('step_time_ratio')
+    if sk_a is not None and sk_b is not None:
+        d = _rel(sk_a, sk_b)
+        gate('skew_step_time_ratio', sk_a, sk_b,
+             None if d is None else round(d, 4), thr['skew'],
+             d is not None and d > thr['skew'])
+    elif sk_a is not None or sk_b is not None:
+        rows.append(_row('skew_step_time_ratio', sk_a, sk_b, None,
+                         thr['skew'], 'skipped',
+                         'aggregation missing from one run'))
 
     # -- compiles ---------------------------------------------------------
     ca, cb = a.get('compile_events', 0), b.get('compile_events', 0)
@@ -234,6 +299,17 @@ def main(argv=None):
                         metavar='N',
                         help='allowed extra compile events in the '
                              'candidate (default %(default)s)')
+    parser.add_argument('--max-mfu-regression', type=float,
+                        default=DEFAULT_THRESHOLDS['mfu'],
+                        metavar='FRAC',
+                        help='allowed fractional headline-MFU decrease '
+                             '(efficiency.json; default %(default)s)')
+    parser.add_argument('--max-skew-regression', type=float,
+                        default=DEFAULT_THRESHOLDS['skew'],
+                        metavar='FRAC',
+                        help='allowed fractional increase of the device '
+                             'step-time skew ratio (aggregate.json; '
+                             'default %(default)s)')
     parser.add_argument('--allow-kernel-fallback', action='store_true',
                         help='downgrade pallas->fallback dispatch changes '
                              'from regression to note')
@@ -261,6 +337,8 @@ def main(argv=None):
             'throughput': args.max_throughput_regression,
             'memory': args.max_memory_regression,
             'new_compile_events': args.max_new_compile_events,
+            'mfu': args.max_mfu_regression,
+            'skew': args.max_skew_regression,
         },
         allow_kernel_fallback=args.allow_kernel_fallback)
 

@@ -43,6 +43,27 @@ def trace(log_dir):
         yield
 
 
+def fmt_seconds(v):
+    """``41.2 ms`` / ``3.100 s`` / ``-`` — the one duration formatter
+    shared by the report, aggregate and cost renderers (jax-free)."""
+    if v is None:
+        return '-'
+    if v >= 1.0:
+        return f'{v:.3f} s'
+    return f'{v * 1e3:.2f} ms'
+
+
+def fmt_si(v):
+    """``60.5 M``-style SI scaling (no unit suffix), shared by the
+    report and cost renderers; ``-`` for None."""
+    if v is None:
+        return '-'
+    for unit in ('', ' K', ' M', ' G', ' T', ' P'):
+        if abs(v) < 1000 or unit == ' P':
+            return f'{v:.3g}{unit}'
+        v /= 1000
+
+
 def percentile(sorted_times, q):
     """Linear-interpolated percentile (``q`` in [0, 1]) of an already
     sorted list — numpy's default 'linear' rule, so the p50 of an
@@ -73,12 +94,17 @@ class StepTimer:
         #: timeline view of ``times``, consumed by the Chrome-trace
         #: exporter (:func:`dgmc_tpu.obs.trace.export_chrome_trace`).
         self.spans = []
+        #: ``perf_counter`` of the most recent :meth:`start`, kept after
+        #: :meth:`stop` — the reference point for per-device completion
+        #: probes (``RunObserver.fence_devices``) that run right after
+        #: the timed block.
+        self.last_start = None
         self._t0 = None
         self._wall0 = None
 
     def start(self):
         self._wall0 = time.time()
-        self._t0 = time.perf_counter()
+        self._t0 = self.last_start = time.perf_counter()
 
     def stop(self, fence=None):
         if self._t0 is None:

@@ -48,17 +48,58 @@ def _read_jsonl(path):
 
 
 def load_run(path):
-    """Load one obs dir (or one bare JSONL file) into a run dict."""
+    """Load one obs dir (or one bare JSONL file) into a run dict.
+
+    A multi-host root (no artifacts of its own but ``host_<k>/``
+    subdirectories — see :mod:`dgmc_tpu.obs.aggregate`) loads as its
+    ``host_0`` run, tagged with ``multi_host`` and the root's
+    ``aggregate.json`` so summaries still carry the cross-host skew.
+    """
     if os.path.isdir(path):
-        return {
+        run = {
             'path': path,
             'metrics': _read_jsonl(os.path.join(path, 'metrics.jsonl')),
             'timings': _read_json(os.path.join(path, 'timings.json')),
             'memory': _read_json(os.path.join(path, 'memory.json')),
             'dispatch': _read_json(os.path.join(path, 'dispatch.json')),
+            'efficiency': _read_json(os.path.join(path, 'efficiency.json')),
+            'aggregate': _read_json(os.path.join(path, 'aggregate.json')),
+            'hang': _read_json(os.path.join(path, 'hang_report.json')),
         }
+        if run['timings'] is None and not run['metrics']:
+            hosts = sorted(
+                d for d in os.listdir(path)
+                if d.startswith('host_')
+                and os.path.isdir(os.path.join(path, d)))
+            if hosts:
+                # Root-level artifacts outrank host_0's: aggregate.json
+                # and a specimen-merged efficiency.json are written AT
+                # the root by their tools and must survive the rebind.
+                agg, eff = run['aggregate'], run['efficiency']
+                run = load_run(os.path.join(path, hosts[0]))
+                run['path'] = path
+                run['multi_host'] = len(hosts)
+                run['aggregate'] = agg or run.get('aggregate')
+                run['efficiency'] = eff or run.get('efficiency')
+                # A hang ANYWHERE is the run's hang: the straggling
+                # non-coordinator host is precisely the evidence the
+                # per-host layout exists for, and the diff gate's
+                # "hung candidate always fails" must see it even when
+                # host_0 finished clean.
+                hung = []
+                for h in hosts:
+                    rep = _read_json(os.path.join(path, h,
+                                                  'hang_report.json'))
+                    if rep is not None:
+                        hung.append(h)
+                        if run['hang'] is None:
+                            run['hang'] = dict(rep, host=h)
+                if hung:
+                    run['hung_hosts'] = hung
+        return run
     return {'path': path, 'metrics': _read_jsonl(path), 'timings': None,
-            'memory': None, 'dispatch': None}
+            'memory': None, 'dispatch': None, 'efficiency': None,
+            'aggregate': None, 'hang': None}
 
 
 def peak_memory(memory):
@@ -136,11 +177,48 @@ def summarize(run):
         out['padding_buckets'] = len(buckets)
         out['padding_bucket_rows'] = buckets
 
+    if t.get('device_steps'):
+        out['device_steps'] = t['device_steps']
+
     probes = t.get('probes') or probe_aggregates_from_metrics(run['metrics'])
     if probes:
         out['probes'] = probes
     if t.get('first_nonfinite'):
         out['first_nonfinite'] = t['first_nonfinite']
+
+    eff = run.get('efficiency') or {}
+    if eff:
+        if eff.get('mfu') is not None:
+            out['mfu'] = eff['mfu']
+        out['efficiency'] = {
+            'peak_flops': eff.get('peak_flops'),
+            'peak_flops_ref': eff.get('peak_flops_ref'),
+            'peak_flops_source': eff.get('peak_flops_source'),
+            'programs': eff.get('programs', {}),
+        }
+        ts = eff.get('programs', {}).get('train_step', {})
+        if ts.get('flops'):
+            out['flops_per_step'] = ts['flops']
+
+    hang = run.get('hang')
+    if hang:
+        out['hang_report'] = {
+            'reason': hang.get('reason'),
+            'stalled_for_s': hang.get('stalled_for_s'),
+            'in_flight': hang.get('in_flight'),
+            'last_completed': hang.get('last_completed'),
+        }
+        if hang.get('host'):
+            out['hang_report']['host'] = hang['host']
+    if run.get('hung_hosts'):
+        out['hung_hosts'] = run['hung_hosts']
+
+    agg = run.get('aggregate')
+    if agg and agg.get('skew'):
+        out['skew'] = agg['skew']
+        out['hosts'] = agg.get('hosts')
+    if run.get('multi_host'):
+        out['hosts'] = run['multi_host']
 
     peak, source = peak_memory(run['memory'])
     if peak is not None:
@@ -168,17 +246,27 @@ def _fmt_bytes(n):
 
 
 def _fmt_s(v):
-    if v is None:
-        return '-'
-    if v >= 1.0:
-        return f'{v:.3f} s'
-    return f'{v * 1e3:.2f} ms'
+    from dgmc_tpu.obs.observe import fmt_seconds
+    return fmt_seconds(v)
+
+
+def _fmt_count(n):
+    from dgmc_tpu.obs.observe import fmt_si
+    return fmt_si(n)
 
 
 def render(run):
     """Human-readable report for one loaded run."""
     s = summarize(run)
     lines = [f'== run report: {run["path"]} ==']
+    if s.get('hang_report'):
+        h = s['hang_report']
+        inf = h.get('in_flight') or {}
+        lines.append(f'  ** RUN HUNG: {h.get("reason")} after '
+                     f'{h.get("stalled_for_s")}s in '
+                     f'{inf.get("phase")}:{inf.get("name")} '
+                     f'(last completed: {h.get("last_completed")}) — '
+                     f'see hang_report.json **')
 
     steps = s.get('steps')
     lines.append('-- step timing --')
@@ -215,6 +303,56 @@ def render(run):
                      f'{_fmt_bytes(s["peak_memory_bytes"])}')
     else:
         lines.append('  (no memory snapshots recorded)')
+
+    if s.get('efficiency'):
+        eff = s['efficiency']
+        lines.append('-- cost / efficiency --')
+        lines.append(f'  peak flops       '
+                     f'{_fmt_count(eff.get("peak_flops"))}FLOP/s '
+                     f'[{eff.get("peak_flops_source")}: '
+                     f'{eff.get("peak_flops_ref")}]')
+        if s.get('mfu') is not None:
+            lines.append(f'  MFU              {s["mfu"]:.4%}')
+        for name, p in eff.get('programs', {}).items():
+            if 'error' in p:
+                lines.append(f'  {name}: cost unavailable ({p["error"]})')
+                continue
+            mfu = f'  MFU {p["mfu"]:.4%}' if p.get('mfu') is not None \
+                else ''
+            lines.append(f'  {name}: {_fmt_count(p.get("flops"))}FLOP, '
+                         f'{_fmt_bytes(p.get("bytes"))} accessed'
+                         f'{mfu}')
+            for stage, row in (p.get('stages') or {}).items():
+                lines.append(
+                    f'    {stage:<16} flops '
+                    f'{_fmt_count(row.get("flops")):>9}  bytes '
+                    f'{_fmt_bytes(row.get("bytes_out")):>11}  '
+                    f'ops {row.get("ops", 0)}')
+            coll = (p.get('collectives') or {}).get('ops') or {}
+            for cname, row in coll.items():
+                lines.append(f'    collective {cname:<14} x{row["count"]} '
+                             f'{_fmt_bytes(row["bytes"])}')
+
+    if s.get('device_steps'):
+        lines.append('-- per-device step completion --')
+        lines.append(f'  {"device":>6} {"count":>6} {"mean":>12} '
+                     f'{"p50":>12} {"max":>12}')
+        for dev, a in s['device_steps'].items():
+            lines.append(f'  {dev:>6} {a["count"]:>6} '
+                         f'{_fmt_s(a["mean_s"]):>12} '
+                         f'{_fmt_s(a["p50_s"]):>12} '
+                         f'{_fmt_s(a["max_s"]):>12}')
+
+    if s.get('skew'):
+        sk = s['skew']
+        lines.append('-- multi-device skew --')
+        if s.get('hosts'):
+            lines.append(f'  hosts            {s["hosts"]}')
+        for key, label in (('step_time_ratio', 'step-time max/median'),
+                           ('memory_ratio', 'memory max/median'),
+                           ('wall_ratio', 'wall-clock max/median')):
+            if sk.get(key) is not None:
+                lines.append(f'  {label:<22} {sk[key]:.3f}x')
 
     lines.append('-- kernel dispatch --')
     rows = s.get('dispatch', [])

@@ -12,6 +12,12 @@ single directory:
 - ``dispatch.json`` — the kernel-dispatch outcome table.
 - ``trace.json``    — Chrome-trace/Perfetto timeline of steps, compiles
   and probe series (:mod:`dgmc_tpu.obs.trace`).
+- ``efficiency.json`` — FLOPs/bytes/per-stage attribution and MFU for
+  the programs the run registered via :meth:`RunObserver.record_cost`
+  (:mod:`dgmc_tpu.obs.cost`).
+- ``hang_report.json`` — written only when the run stalls past the
+  watchdog deadline or dies to SIGTERM/SIGALRM
+  (:mod:`dgmc_tpu.obs.watchdog`).
 
 Every method is a no-op when constructed with a falsy directory, so CLIs
 call the observer unconditionally::
@@ -63,6 +69,14 @@ def add_obs_flag(parser):
              'top-k mass, consensus-delta norm, grad norm, non-finite '
              'detection) into the --obs-dir artifacts; off = the lowered '
              'step is byte-identical to a probe-free build')
+    parser.add_argument(
+        '--watchdog-deadline', '--watchdog_deadline',
+        dest='watchdog_deadline', type=float, default=None, metavar='SEC',
+        help='arm the run-health watchdog: if no step/compile/section '
+             'completes for SEC seconds, or the process receives '
+             'SIGTERM/SIGALRM, dump <obs-dir>/hang_report.json '
+             '(all-thread tracebacks, the in-flight activity, the last-'
+             'completed span) so an rc:124 run is diagnosable')
     return parser
 
 
@@ -88,7 +102,8 @@ class RunObserver:
     probe-free step on process 1 would break SPMD lockstep.
     """
 
-    def __init__(self, obs_dir, probes=False):
+    def __init__(self, obs_dir, probes=False, watchdog_deadline_s=None,
+                 watchdog_signals=None):
         self.dir = obs_dir
         self.enabled = bool(obs_dir)
         self.timer = StepTimer()
@@ -97,6 +112,11 @@ class RunObserver:
         self._watcher = None
         self._sections = []
         self._step_index = 0
+        self._costs = {}
+        self._device_times = {}
+        self._fence_records = []
+        self._pending_compiles = []
+        self.watchdog = None
         self._probe_sink = None
         # _probe_lock: _on_probe runs on jax's host-callback thread while
         # the main thread logs/flushes — both touch the records/aggregates
@@ -111,6 +131,13 @@ class RunObserver:
             if self.enabled:
                 self._probe_sink = self._on_probe
             probes_mod.enable(self._probe_sink)
+        if watchdog_deadline_s and not self.enabled:
+            # The hang report needs a directory to land in; accepting
+            # the flag silently would reproduce the evidence-free rc:124
+            # death the watchdog exists to prevent.
+            print('RunObserver: --watchdog-deadline is ignored without '
+                  '--obs-dir (hang_report.json needs an obs directory)',
+                  file=sys.stderr)
         # mode='w': an obs dir describes ONE run — a reused --obs-dir must
         # not append a second run's metrics to artifacts the observer
         # rewrites from scratch.
@@ -125,6 +152,14 @@ class RunObserver:
             self._dispatch_base = self._count_index(dispatch_table())
             self._buckets_base = self._count_index(padding_bucket_table())
             self._watcher = CompileWatcher().__enter__()
+            if watchdog_deadline_s:
+                from dgmc_tpu.obs.watchdog import DEFAULT_SIGNALS, Watchdog
+                self.watchdog = Watchdog(
+                    os.path.join(obs_dir, 'hang_report.json'),
+                    deadline_s=watchdog_deadline_s,
+                    context_fn=self._watchdog_context,
+                    signals=(DEFAULT_SIGNALS if watchdog_signals is None
+                             else watchdog_signals)).start()
             self.snapshot_memory('start')
 
     # -- collection --------------------------------------------------------
@@ -136,6 +171,8 @@ class RunObserver:
         if not self.enabled:
             yield
             return
+        if self.watchdog is not None:
+            self.watchdog.beat('step', self._step_index)
         self.timer.start()
         try:
             yield
@@ -145,6 +182,79 @@ class RunObserver:
             # dispatch the attribution is approximate within the dispatch
             # pipeline depth (see obs/probes.py).
             self._step_index += 1
+            if self.watchdog is not None:
+                self.watchdog.done()
+
+    def fence_devices(self, value):
+        """Per-device step-completion probe for straggler/skew analysis.
+
+        ``value`` is a jax array from the step's outputs (typically the
+        loss — replicated or sharded, its addressable shards cover the
+        participating local devices). Each shard is fetched in device
+        order; the elapsed time from the most recent step start to each
+        fetch completing is that device's cumulative-drain measurement.
+        A straggler device records a visibly larger time; devices
+        fetched after it inherit its wait (the recorded skew is a lower
+        bound — see :mod:`dgmc_tpu.obs.aggregate`). Per-device
+        aggregates land in ``timings.json`` (``device_steps``) and one
+        record per fence in ``metrics.jsonl``.
+
+        Each fetch is a device->host round trip, so call this where the
+        loop already fetches (an epoch/eval boundary), not every step on
+        a tunneled platform.
+        """
+        if not self.enabled:
+            return None
+        import numpy as np
+        t0 = self.timer.last_start
+        if t0 is None:
+            t0 = time.perf_counter()
+        times = {}
+        try:
+            shards = sorted(value.addressable_shards,
+                            key=lambda s: s.device.id)
+        except AttributeError:   # non-jax input: nothing to fence
+            return None
+        for shard in shards:
+            np.asarray(shard.data)   # blocks until this device is done
+            times[str(shard.device.id)] = round(
+                time.perf_counter() - t0, 6)
+        for dev, dt in times.items():
+            self._device_times.setdefault(dev, []).append(dt)
+        self._fence_records.append((time.time(), times))
+        with self._probe_lock:
+            self._metrics.log(self._step_index, device_fence=times)
+        if self.watchdog is not None:
+            self.watchdog.beat('idle')
+        return times
+
+    def record_cost(self, name, target, *args, step_time_s=None):
+        """Register one program's cost account (``efficiency.json``).
+
+        ``target`` is a jitted callable (with its example ``*args`` —
+        lowered once, **not** compiled: one extra trace, no extra XLA
+        compile), a ``Lowered``, or a ``Compiled`` (bench.py's AOT path,
+        which also yields post-GSPMD collective counts). MFU is derived
+        at flush time from ``step_time_s`` when given, else from the
+        run's observed step p50. See :mod:`dgmc_tpu.obs.cost`.
+        """
+        if not self.enabled:
+            return None
+        from dgmc_tpu.obs import cost as cost_mod
+        if self.watchdog is not None:
+            self.watchdog.beat('cost', name)
+        try:
+            summary = cost_mod.cost_summary(target, *args,
+                                            step_time_s=step_time_s)
+        except Exception as e:
+            # A platform that refuses cost analysis must not kill the
+            # run being observed; record the refusal instead.
+            summary = {'error': f'{type(e).__name__}: {e}'}
+        self._costs[name] = summary
+        if self.watchdog is not None:
+            self.watchdog.done()
+        self.flush()
+        return summary
 
     def _on_probe(self, rec):
         """Probe sink (runs on jax's host-callback thread): series ->
@@ -183,6 +293,11 @@ class RunObserver:
         for the ``trace.json`` timeline."""
         if self.enabled:
             self._sections.append((name, start_s, duration_s))
+            if self.watchdog is not None:
+                # A completed section is both a heartbeat and the
+                # last-completed span a hang report should name.
+                self.watchdog.beat('section', name)
+                self.watchdog.done()
 
     def log(self, step, **metrics):
         """Append one record to ``metrics.jsonl`` and refresh the derived
@@ -195,6 +310,11 @@ class RunObserver:
         # logs its epoch record.
         with self._probe_lock:
             self._metrics.log(step, **metrics)
+        if self.watchdog is not None:
+            # Epoch-boundary host work (eval loops, checkpointing) beats
+            # through its log calls, so only genuine stalls trip the
+            # deadline.
+            self.watchdog.beat('idle')
         self.flush()
 
     @contextlib.contextmanager
@@ -204,8 +324,17 @@ class RunObserver:
         if not self.enabled:
             yield
             return
-        with self._watcher.label(name):
-            yield
+        if self.watchdog is not None:
+            self.watchdog.beat('compile', name)
+        self._pending_compiles.append(name)
+        try:
+            with self._watcher.label(name):
+                yield
+        finally:
+            if name in self._pending_compiles:
+                self._pending_compiles.remove(name)
+            if self.watchdog is not None:
+                self.watchdog.done()
 
     def snapshot_memory(self, tag=''):
         """Record a labelled device/host memory snapshot."""
@@ -248,6 +377,44 @@ class RunObserver:
         with self._probe_lock:
             return self._probe_agg.summary()
 
+    def device_step_summary(self):
+        """Per-device completion aggregates from :meth:`fence_devices`:
+        ``{device_id: {count, mean_s, p50_s, max_s, last_s}}``."""
+        from dgmc_tpu.obs.observe import percentile
+        out = {}
+        for dev, times in sorted(self._device_times.items()):
+            ts = sorted(times)
+            out[dev] = {
+                'count': len(ts),
+                'mean_s': round(sum(ts) / len(ts), 6),
+                'p50_s': round(percentile(ts, 0.5), 6),
+                'max_s': round(ts[-1], 6),
+                'last_s': round(times[-1], 6),
+            }
+        return out
+
+    def _watchdog_context(self):
+        """Run-state snapshot for the hang report (called from the
+        watchdog thread; cached there for the lock-free signal path)."""
+        ctx = {
+            'steps_completed': self._step_index,
+            'steps': self.timer.summary(),
+            'pending_compiles': list(self._pending_compiles),
+            'compile_events': (self._watcher.count()
+                               if self._watcher else 0),
+            'dispatch_tail': self._since(dispatch_table(),
+                                         self._dispatch_base)[-8:],
+        }
+        if self.timer.spans:
+            t0, dur = self.timer.spans[-1]
+            ctx['last_step_span'] = {'start': t0,
+                                     'duration_s': round(dur, 6)}
+        if self._sections:
+            ctx['sections'] = [
+                {'name': n, 'start': t0, 'duration_s': round(d, 3)}
+                for n, t0, d in self._sections[-8:]]
+        return ctx
+
     def timings(self):
         out = {
             'wall_s': round(time.time() - self._t_start, 3),
@@ -257,6 +424,8 @@ class RunObserver:
             'padding_buckets': self._since(padding_bucket_table(),
                                            self._buckets_base),
         }
+        if self._device_times:
+            out['device_steps'] = self.device_step_summary()
         if self._probe_agg:
             out['probes'] = self.probe_summary()
         if self.first_nonfinite is not None:
@@ -272,6 +441,11 @@ class RunObserver:
         self._write('memory.json', {'snapshots': self._snapshots})
         self._write('dispatch.json', {'counts': self._since(
             dispatch_table(), self._dispatch_base)})
+        if self._costs:
+            from dgmc_tpu.obs import cost as cost_mod
+            steps = self.timer.summary()
+            self._write('efficiency.json', cost_mod.efficiency_payload(
+                self._costs, fallback_step_time_s=steps.get('p50_s')))
         from dgmc_tpu.obs.trace import export_chrome_trace
         with self._probe_lock:
             # Snapshot: the deque may receive callback-thread appends
@@ -283,6 +457,7 @@ class RunObserver:
             probe_records=probe_records,
             compile_events=self._watcher.events if self._watcher else (),
             sections=self._sections,
+            device_fences=self._fence_records,
             metadata={'argv': sys.argv})
 
     def close(self):
@@ -308,6 +483,9 @@ class RunObserver:
             self._probes_enabled_by_me = False
         if not self.enabled:
             return
+        if self.watchdog is not None:
+            self.watchdog.close()
+            self.watchdog = None
         self.snapshot_memory('end')
         self.flush()
         self._metrics.close()

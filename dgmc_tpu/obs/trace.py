@@ -45,7 +45,7 @@ def _us(t, origin):
 
 
 def chrome_events(step_spans=(), probe_records=(), compile_events=(),
-                  sections=()):
+                  sections=(), device_fences=()):
     """Build the ``traceEvents`` list from host telemetry.
 
     Args:
@@ -59,12 +59,17 @@ def chrome_events(step_spans=(), probe_records=(), compile_events=(),
             ``kind``, ``label``).
         sections: ``(name, epoch_start_s, duration_s)`` triples (e.g.
             bench.py's section ledger).
+        device_fences: ``(epoch_time_s, {device_id: completion_s})``
+            pairs (``RunObserver.fence_devices``) — one counter track
+            per device, so a straggler draws as the visibly-higher
+            line.
     """
     starts = ([t for t, _ in step_spans]
               + [r['time'] for r in probe_records]
               + [e['time'] - e.get('duration_s', 0.0)
                  for e in compile_events]
-              + [t for _, t, _ in sections])
+              + [t for _, t, _ in sections]
+              + [t for t, _ in device_fences])
     if not starts:
         return []
     origin = min(starts)
@@ -99,6 +104,13 @@ def chrome_events(step_spans=(), probe_records=(), compile_events=(),
                        'name': name, 'cat': 'section',
                        'ts': _us(t0, origin), 'dur': round(dur * 1e6, 1)})
 
+    for t, per_device in device_fences:
+        for dev, dt in sorted(per_device.items()):
+            events.append({'ph': 'C', 'pid': _PID,
+                           'name': f'device_step[{dev}]', 'cat': 'fence',
+                           'ts': _us(t, origin),
+                           'args': {'completion_ms': round(dt * 1e3, 3)}})
+
     for r in probe_records:
         name = r.get('probe', '?')
         if name == 'nonfinite':
@@ -124,7 +136,8 @@ def chrome_events(step_spans=(), probe_records=(), compile_events=(),
 
 
 def export_chrome_trace(path, step_spans=(), probe_records=(),
-                        compile_events=(), sections=(), metadata=None):
+                        compile_events=(), sections=(), device_fences=(),
+                        metadata=None):
     """Write a Chrome-trace JSON file; returns the number of events.
 
     Atomic (tmp + rename) so a run killed mid-flush leaves the previous
@@ -133,7 +146,8 @@ def export_chrome_trace(path, step_spans=(), probe_records=(),
     events = chrome_events(step_spans=step_spans,
                            probe_records=probe_records,
                            compile_events=compile_events,
-                           sections=sections)
+                           sections=sections,
+                           device_fences=device_fences)
     payload = {'traceEvents': events, 'displayTimeUnit': 'ms'}
     if metadata:
         payload['otherData'] = metadata

@@ -1,0 +1,289 @@
+"""Run-health watchdog: turn a silent hang into a ``hang_report.json``.
+
+Every multichip benchmark attempt so far (``MULTICHIP_r01-r05.json``,
+``BENCH_r05.json``) ended ``rc: 124`` with an empty tail: the run hung,
+the external ``timeout(1)`` killed it, and the post-hoc telemetry said
+nothing about *where*. The watchdog closes that gap with a heartbeat
+thread armed by :class:`~dgmc_tpu.obs.run.RunObserver`:
+
+- Call sites **beat** (:meth:`Watchdog.beat`) when an activity starts —
+  a training step, a labelled compile region, a bench section — and
+  **complete** (:meth:`Watchdog.done`) when it finishes.
+- The daemon thread watches staleness. When no beat/complete lands for
+  ``deadline_s`` seconds, it dumps ``hang_report.json``: all-thread
+  Python tracebacks (``sys._current_frames``), the in-flight activity,
+  the last-completed one, and whatever run context the owner supplies
+  (step count, pending compile labels, the kernel-dispatch tail).
+- Optionally it also arms **signal handlers** (SIGTERM/SIGALRM — what
+  ``timeout(1)`` sends) that write the same report before chaining to
+  the previously-installed handler, so an externally-killed run leaves
+  evidence even when it was *not* stale yet.
+
+Why a thread and not just signals: a process hung inside one XLA call
+never returns to the Python interpreter, so a Python-level signal
+handler never runs — but a separate thread still gets scheduled and
+``sys._current_frames()`` still shows where every thread (including the
+stuck one) is. The signal path complements it for responsive processes.
+
+Lock discipline: the *thread* path may take ordinary locks (the main
+thread is hung in C, not suspended mid-critical-section). The *signal*
+path runs with the main thread interrupted at an arbitrary bytecode, so
+it must not acquire any lock the main thread could hold — it therefore
+uses only the context snapshot the thread cached on its last poll, plus
+``sys._current_frames()`` (no Python locks) and a direct file write.
+
+This module deliberately has **no jax import**: arming a watchdog must
+work in any process, and the report must be writable while jax is wedged.
+"""
+
+import json
+import os
+import signal
+import sys
+import threading
+import time
+import traceback
+
+__all__ = ['Watchdog', 'DEFAULT_SIGNALS', 'thread_stacks']
+
+#: Signals the watchdog arms by default: what ``timeout(1)`` (SIGTERM)
+#: and ``timeout -s ALRM`` / alarm-based harnesses deliver. Callers that
+#: use SIGALRM themselves (bench.py's per-section budgets) pass an
+#: explicit subset.
+DEFAULT_SIGNALS = (signal.SIGTERM, signal.SIGALRM)
+
+
+def thread_stacks(names=None):
+    """All-thread Python tracebacks, JSON-ready.
+
+    ``sys._current_frames`` is a C-level snapshot needing no Python
+    locks, but resolving thread NAMES via ``threading.enumerate()``
+    takes threading's internal registry lock — which the interrupted
+    main thread may hold (e.g. inside ``Thread.start()``). Signal-path
+    callers therefore pass a pre-cached ``{ident: (name, daemon)}``
+    mapping (see :class:`Watchdog`); only thread-context callers let
+    this default to a live ``enumerate()``.
+    """
+    if names is None:
+        names = {t.ident: (t.name, bool(t.daemon))
+                 for t in threading.enumerate()}
+    out = []
+    for ident, frame in sorted(sys._current_frames().items()):
+        name, daemon = names.get(ident, ('?', None))
+        out.append({
+            'ident': ident,
+            'name': name,
+            'daemon': daemon,
+            'stack': [ln.rstrip('\n') for ln in
+                      traceback.format_stack(frame)],
+        })
+    return out
+
+
+class Watchdog:
+    """Heartbeat-armed hang reporter writing ``report_path`` on stall.
+
+    Args:
+        report_path: where ``hang_report.json`` goes (written atomically;
+            a re-dump replaces it).
+        deadline_s: staleness budget — seconds without a :meth:`beat` /
+            :meth:`done` before the thread dumps. ``None``/``0`` disables
+            the deadline (signal dumps still work).
+        context_fn: 0-arg callable returning a JSON-able dict of run
+            state (steps completed, sections, pending compiles, dispatch
+            tail). Called from the watchdog thread under normal locking
+            rules; its latest result is cached for the lock-free signal
+            path.
+        signals: iterable of signal numbers to arm (empty = none). The
+            previous handler of each is chained after the dump and
+            restored by :meth:`close`.
+        poll_s: thread poll interval (default: ``deadline_s / 4`` clamped
+            to [0.05, 1.0]).
+    """
+
+    def __init__(self, report_path, deadline_s=None, context_fn=None,
+                 signals=(), poll_s=None):
+        self.report_path = report_path
+        self.deadline_s = deadline_s or None
+        self._context_fn = context_fn
+        self._signals = tuple(signals)
+        if poll_s is None:
+            poll_s = min(1.0, max(0.05, (deadline_s or 4.0) / 4.0))
+        self._poll_s = poll_s
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread = None
+        self._prev_handlers = {}
+        t = time.time()
+        self._in_flight = {'phase': 'startup', 'name': None, 'since': t}
+        self._last_completed = None
+        self._last_event = t
+        self._dumped_this_stall = False
+        self._cached_context = {}
+        self._cached_thread_names = {}
+        self.dump_count = 0
+
+    # -- heartbeat ---------------------------------------------------------
+
+    def beat(self, phase, name=None):
+        """Record the start of an activity (a step, a compile label, a
+        bench section). Resets the staleness clock and re-arms the
+        once-per-stall dump."""
+        now = time.time()
+        with self._lock:
+            self._in_flight = {'phase': phase, 'name': name, 'since': now}
+            self._last_event = now
+            self._dumped_this_stall = False
+
+    def done(self):
+        """Record completion of the in-flight activity. A completion of
+        the idle phase (nested beat/done pairs unwind through it) is a
+        heartbeat only — it must not overwrite the last-completed span a
+        hang report names."""
+        now = time.time()
+        with self._lock:
+            fin = self._in_flight
+            if fin['phase'] != 'idle':
+                self._last_completed = {
+                    'phase': fin['phase'], 'name': fin['name'],
+                    'duration_s': round(now - fin['since'], 3)}
+            self._in_flight = {'phase': 'idle', 'name': None, 'since': now}
+            self._last_event = now
+            self._dumped_this_stall = False
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self):
+        """Arm: install signal handlers (main thread only; skipped
+        silently elsewhere) and start the heartbeat thread."""
+        # Seed the name cache here (safe context) so a signal arriving
+        # before the first poll still labels the threads it can.
+        self._refresh_thread_names()
+        for sig in self._signals:
+            try:
+                self._prev_handlers[sig] = signal.signal(
+                    sig, self._on_signal)
+            except ValueError:  # not the main thread
+                break
+        if self.deadline_s:
+            self._thread = threading.Thread(
+                target=self._watch, name='dgmc-watchdog', daemon=True)
+            self._thread.start()
+        return self
+
+    def close(self):
+        """Disarm: stop the thread and restore the signal handlers."""
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=self._poll_s * 4 + 1.0)
+            self._thread = None
+        for sig, prev in self._prev_handlers.items():
+            try:
+                signal.signal(sig, prev)
+            except ValueError:
+                break
+        self._prev_handlers.clear()
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.close()
+
+    # -- dumping -----------------------------------------------------------
+
+    def _refresh_thread_names(self):
+        try:
+            self._cached_thread_names = {
+                t.ident: (t.name, bool(t.daemon))
+                for t in threading.enumerate()}
+        except Exception:
+            pass
+
+    def _watch(self):
+        while not self._stop.wait(self._poll_s):
+            # Refresh the context + thread-name caches for the lock-free
+            # signal path while everything is healthy (ordinary locks
+            # are fine here).
+            self._refresh_thread_names()
+            if self._context_fn is not None:
+                try:
+                    self._cached_context = self._context_fn()
+                except Exception:
+                    pass
+            if not self.deadline_s:
+                continue
+            with self._lock:
+                stale = time.time() - self._last_event
+                should = (stale > self.deadline_s
+                          and not self._dumped_this_stall)
+                if should:
+                    self._dumped_this_stall = True
+            if should:
+                self.dump('deadline', use_locks=True)
+
+    def _on_signal(self, signum, frame):
+        try:
+            name = signal.Signals(signum).name
+        except ValueError:
+            name = str(signum)
+        # Cached context only: the main thread is interrupted at an
+        # arbitrary bytecode and may hold any lock (see module docstring).
+        self.dump(f'signal:{name}', use_locks=False)
+        prev = self._prev_handlers.get(signum)
+        if callable(prev):
+            prev(signum, frame)
+        elif prev == signal.SIG_DFL:
+            # Re-deliver with the default disposition so the exit status
+            # says "killed by signal", as it would have without us.
+            signal.signal(signum, signal.SIG_DFL)
+            os.kill(os.getpid(), signum)
+
+    def dump(self, reason, extra=None, use_locks=True):
+        """Write ``hang_report.json`` now; returns the path (or ``None``
+        if even the write failed — a watchdog must never raise into the
+        run it observes)."""
+        now = time.time()
+        if use_locks:
+            with self._lock:
+                in_flight = dict(self._in_flight)
+                last_completed = self._last_completed
+                last_event = self._last_event
+            context = self._cached_context
+            if self._context_fn is not None:
+                try:
+                    context = self._context_fn()
+                except Exception:
+                    pass
+        else:
+            in_flight = dict(self._in_flight)      # dict reads are atomic
+            last_completed = self._last_completed  # enough for a dump
+            last_event = self._last_event
+            context = self._cached_context
+        in_flight['since_s'] = round(now - in_flight.pop('since'), 3)
+        # Signal path: cached thread names only — threading.enumerate()
+        # takes the registry lock the interrupted main thread may hold.
+        names = None if use_locks else dict(self._cached_thread_names)
+        report = {
+            'reason': reason,
+            'time': now,
+            'pid': os.getpid(),
+            'argv': sys.argv,
+            'deadline_s': self.deadline_s,
+            'stalled_for_s': round(now - last_event, 3),
+            'in_flight': in_flight,
+            'last_completed': last_completed,
+            'context': context or {},
+            'threads': thread_stacks(names),
+        }
+        if extra:
+            report.update(extra)
+        try:
+            tmp = f'{self.report_path}.tmp.{os.getpid()}'
+            with open(tmp, 'w') as f:
+                json.dump(report, f, indent=1, default=str)
+            os.replace(tmp, self.report_path)
+        except Exception:
+            return None
+        self.dump_count += 1
+        return self.report_path

@@ -4,7 +4,7 @@ from dgmc_tpu.parallel.sharding import (replicate, shard_batch,
                                         make_sharded_train_step,
                                         make_sharded_eval_step)
 from dgmc_tpu.parallel.topk import sharded_topk_rows, sharded_topk_cols
-from dgmc_tpu.parallel.distributed import (global_batch,
+from dgmc_tpu.parallel.distributed import (global_batch, host_obs_dir,
                                            initialize_distributed,
                                            is_coordinator,
                                            local_batch_slice)
@@ -12,6 +12,7 @@ from dgmc_tpu.parallel.distributed import (global_batch,
 __all__ = [
     'initialize_distributed',
     'is_coordinator',
+    'host_obs_dir',
     'global_batch',
     'local_batch_slice',
     'DATA_AXIS',

@@ -101,6 +101,24 @@ def is_coordinator() -> bool:
     return jax.process_index() == 0
 
 
+def host_obs_dir(obs_dir):
+    """Per-host obs directory for this process.
+
+    Single-process runs keep ``obs_dir`` unchanged (artifacts land at
+    the root, as ever). Multi-process runs get
+    ``obs_dir/host_<process_index>/`` so EVERY host records telemetry —
+    a straggling or hanging non-coordinator host is precisely the one
+    whose evidence matters — and
+    ``python -m dgmc_tpu.obs.aggregate <obs_dir>`` merges the
+    subdirectories into the straggler/skew summary. Falsy ``obs_dir``
+    passes through (the observer stays disabled).
+    """
+    if not obs_dir or jax.process_count() == 1:
+        return obs_dir
+    import os
+    return os.path.join(obs_dir, f'host_{jax.process_index()}')
+
+
 def local_batch_slice(batch):
     """This process's rows of a host-side batch whose leading axis will be
     sharded over the data axis: with a contiguous ``P('data')`` layout,

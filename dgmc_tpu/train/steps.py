@@ -51,9 +51,15 @@ def make_train_step(model, loss_on_s0=False, num_steps=None, detach=None,
                       'dropout': k_drop},
                 mutable=mutable)
             (S_0, S_L), new_vars = out if mutable else (out, {})
-            loss = metrics.nll_loss(S_L, batch.y, batch.y_mask)
-            if loss_on_s0:
-                loss = loss + metrics.nll_loss(S_0, batch.y, batch.y_mask)
+            # Stage scope for the obs/cost attribution (obs/cost.py): the
+            # model stages (psi1, consensus_iter, ...) come annotated
+            # from models/dgmc.py; 'loss' and 'optimizer' below complete
+            # the train step's pipeline account.
+            with jax.named_scope('loss'):
+                loss = metrics.nll_loss(S_L, batch.y, batch.y_mask)
+                if loss_on_s0:
+                    loss = loss + metrics.nll_loss(S_0, batch.y,
+                                                   batch.y_mask)
             return loss, (new_vars, S_L)
 
         (loss, (new_vars, S_L)), grads = jax.value_and_grad(
@@ -68,7 +74,8 @@ def make_train_step(model, loss_on_s0=False, num_steps=None, detach=None,
             # backward) — first-nonfinite attribution sorts on it.
             _probes.check_finite('loss', loss, order=1000)
             _probes.check_finite('grad', gnorm, order=1001)
-        state = state.apply_gradients(grads=grads)
+        with jax.named_scope('optimizer'):
+            state = state.apply_gradients(grads=grads)
         if state.batch_stats:
             state = state.replace(batch_stats=new_vars['batch_stats'])
 

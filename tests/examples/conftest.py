@@ -1,0 +1,37 @@
+"""De-flake fixture: the example-CLI smoke tests must not read the
+persistent XLA compilation cache.
+
+Same bug family tests/parallel/conftest.py root-caused on this
+container's jax 0.4.37: executables with donated inputs round-trip
+through the persistent compilation cache with broken input-output
+aliasing. Here the trigger is the dbp15k resume test — three
+``dbp15k.main`` invocations compile the SAME donating train step, so
+from the second one on every compile is a persistent-cache HIT; the
+deserialized executable releases the donated state buffers and then
+reads them, which segfaults the whole pytest process (observed
+deterministically with a warm ``tests/.jax_compile_cache``; a cold
+cache run passes and then poisons the next). A fresh in-process compile
+of the same program is always correct.
+
+Scoped to this package like the tests/parallel fixture: these tests run
+full CLI mains whose train steps donate. ``is_cache_used`` latches
+process-wide on first use, so the fixture resets the cache on both
+transitions — flipping the config flag alone is silently ignored.
+"""
+
+import jax
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def _no_persistent_compile_cache():
+    from jax._src import compilation_cache
+
+    prev = jax.config.jax_enable_compilation_cache
+    jax.config.update('jax_enable_compilation_cache', False)
+    compilation_cache.reset_cache()  # un-latch is_cache_used
+    try:
+        yield
+    finally:
+        jax.config.update('jax_enable_compilation_cache', prev)
+        compilation_cache.reset_cache()

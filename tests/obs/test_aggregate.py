@@ -1,0 +1,195 @@
+"""Multi-device/host aggregation contract (obs/aggregate.py).
+
+Synthesized host subdirectories pin the skew arithmetic (a 2x straggler
+device must read as ratio 2.0 against the median); a real 8-virtual-
+device fenced run pins the device-series plumbing end to end; the
+report/diff layers must consume the artifact.
+"""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dgmc_tpu.obs import aggregate as agg_mod
+from dgmc_tpu.obs import report
+
+
+def _host(root, name, device_means=(), p50=0.1, wall=10.0,
+          dev_peaks=(), host_peak=None, steps=8, hang=None):
+    d = os.path.join(str(root), name) if name else str(root)
+    os.makedirs(d, exist_ok=True)
+    timings = {
+        'wall_s': wall,
+        'steps': {'steps': steps, 'mean_s': p50, 'p50_s': p50,
+                  'p95_s': p50 * 1.2, 'max_s': p50 * 2,
+                  'total_s': p50 * steps},
+        'compile': {'events': 1, 'compile_s': 1.0},
+    }
+    if device_means:
+        timings['device_steps'] = {
+            str(i): {'count': steps, 'mean_s': m, 'p50_s': m,
+                     'max_s': m * 1.1, 'last_s': m}
+            for i, m in enumerate(device_means)}
+    with open(os.path.join(d, 'timings.json'), 'w') as f:
+        json.dump(timings, f)
+    devices = [{'id': i, 'peak_bytes_in_use': p}
+               for i, p in enumerate(dev_peaks)]
+    host = {'peak_rss_bytes': host_peak} if host_peak else {}
+    with open(os.path.join(d, 'memory.json'), 'w') as f:
+        json.dump({'snapshots': [{'tag': 'end', 'devices': devices,
+                                  'host': host}]}, f)
+    with open(os.path.join(d, 'metrics.jsonl'), 'w') as f:
+        f.write(json.dumps({'step': 1, 'loss': 1.0}) + '\n')
+    if hang:
+        with open(os.path.join(d, 'hang_report.json'), 'w') as f:
+            json.dump(hang, f)
+    return d
+
+
+def test_single_dir_acts_as_host0(tmp_path):
+    _host(tmp_path, None, device_means=(0.1, 0.1, 0.2, 0.1))
+    s = agg_mod.aggregate(str(tmp_path))
+    assert s['hosts'] == 1
+    assert list(s['per_host']) == ['host_0']
+    # devices 0,1,3 at 100ms, device 2 at 200ms: median 100ms, max 200ms.
+    assert s['skew']['step_time_ratio'] == pytest.approx(2.0)
+    assert s['step_time']['worst'] == {'host': 'host_0', 'device': '2'}
+    assert s['step_time']['source'] == 'device_series'
+
+
+def test_multi_host_merge_and_memory_spread(tmp_path):
+    _host(tmp_path, 'host_0', device_means=(0.1, 0.1),
+          dev_peaks=(1 << 30, 1 << 30), wall=10.0)
+    _host(tmp_path, 'host_1', device_means=(0.1, 0.3),
+          dev_peaks=(1 << 30, 3 << 30), wall=14.0)
+    s = agg_mod.aggregate(str(tmp_path))
+    assert s['hosts'] == 2
+    assert len(s['devices']) == 4
+    assert s['skew']['step_time_ratio'] == pytest.approx(3.0)
+    assert s['step_time']['worst'] == {'host': 'host_1', 'device': '1'}
+    assert s['skew']['memory_ratio'] == pytest.approx(3.0)
+    assert s['memory']['source'] == 'device'
+    assert s['skew']['wall_ratio'] == pytest.approx(14.0 / 12.0,
+                                                    abs=1e-3)
+
+
+def test_host_p50_fallback_when_no_device_series(tmp_path):
+    _host(tmp_path, 'host_0', p50=0.1)
+    _host(tmp_path, 'host_1', p50=0.4)
+    s = agg_mod.aggregate(str(tmp_path))
+    assert s['step_time']['source'] == 'host_p50'
+    assert s['skew']['step_time_ratio'] == pytest.approx(0.4 / 0.25)
+
+
+def test_hung_host_is_flagged(tmp_path):
+    _host(tmp_path, 'host_0')
+    _host(tmp_path, 'host_1',
+          hang={'reason': 'deadline', 'stalled_for_s': 99.0,
+                'in_flight': {'phase': 'step', 'name': 7}})
+    s = agg_mod.aggregate(str(tmp_path))
+    assert s['hung_hosts'] == ['host_1']
+    assert 'hang_report' in s['per_host']['host_1']
+
+
+def test_non_coordinator_hang_reaches_root_summary_and_diff(tmp_path):
+    """A hang on host_2 with a clean host_0 must surface as the ROOT
+    run's hang (and therefore fail the diff's hung-candidate gate) —
+    the straggling non-coordinator host is the whole point of per-host
+    obs dirs."""
+    from dgmc_tpu.obs import diff as diff_mod
+    clean = str(tmp_path / 'clean')
+    _host(clean, 'host_0')
+    _host(clean, 'host_1')
+    hung = str(tmp_path / 'hung')
+    _host(hung, 'host_0')
+    _host(hung, 'host_1',
+          hang={'reason': 'deadline', 'stalled_for_s': 77.0,
+                'in_flight': {'phase': 'step', 'name': 9}})
+    s = report.summarize(report.load_run(hung))
+    assert s['hang_report']['reason'] == 'deadline'
+    assert s['hang_report']['host'] == 'host_1'
+    assert s['hung_hosts'] == ['host_1']
+    assert diff_mod.main([clean, hung]) == 1
+
+
+def test_empty_root_returns_none_and_cli_errors(tmp_path):
+    assert agg_mod.aggregate(str(tmp_path)) is None
+    assert agg_mod.main([str(tmp_path)]) == 2
+
+
+def test_cli_writes_aggregate_json_and_renders(tmp_path, capsys):
+    _host(tmp_path, 'host_0', device_means=(0.1, 0.2))
+    assert agg_mod.main([str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert 'step-time skew' in out and 'host_0' in out
+    on_disk = json.load(open(tmp_path / 'aggregate.json'))
+    assert on_disk['skew']['step_time_ratio'] == pytest.approx(
+        0.2 / 0.15, abs=1e-3)
+
+
+def test_report_consumes_multi_host_root(tmp_path, capsys):
+    """A multi-host root (no artifacts of its own) reports as host_0
+    plus the aggregate skew block."""
+    _host(tmp_path, 'host_0', device_means=(0.1, 0.1))
+    _host(tmp_path, 'host_1', device_means=(0.1, 0.2))
+    assert agg_mod.main([str(tmp_path)]) == 0
+    capsys.readouterr()
+    assert report.main([str(tmp_path), '--json']) == 0
+    s = json.loads(capsys.readouterr().out)
+    assert s['hosts'] == 2
+    assert s['skew']['step_time_ratio'] == pytest.approx(2.0)
+    assert s['steps'] == 8                      # host_0's run summary
+    # Root-level efficiency.json (e.g. obs.cost --obs-dir <root>) must
+    # survive the host_0 rebind into the root summary.
+    with open(tmp_path / 'efficiency.json', 'w') as f:
+        json.dump({'mfu': 0.25, 'programs': {}}, f)
+    s = report.summarize(report.load_run(str(tmp_path)))
+    assert s['mfu'] == 0.25
+
+
+def test_fence_devices_series_feeds_aggregate(tmp_path):
+    """End to end on the real 8-virtual-device platform: a fenced run's
+    per-device series lands in timings.json and aggregates to a skew
+    row per device."""
+    from dgmc_tpu.obs import RunObserver
+    d = str(tmp_path / 'obs')
+    n_dev = len(jax.devices())
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    mesh = Mesh(np.array(jax.devices()), ('data',))
+    f = jax.jit(lambda x: jnp.sum(x * 2.0))
+    x = jax.device_put(
+        np.random.randn(n_dev * 2, 4).astype(np.float32),
+        NamedSharding(mesh, P('data')))
+    with RunObserver(d) as obs:
+        for _ in range(3):
+            with obs.step():
+                out = f(x)
+            times = obs.fence_devices(out)
+        assert sorted(times) == sorted(str(dev.id)
+                                       for dev in jax.devices())
+        obs.log(1, loss=1.0)
+    t = json.load(open(os.path.join(d, 'timings.json')))
+    assert len(t['device_steps']) == n_dev
+    for a in t['device_steps'].values():
+        assert a['count'] == 3 and a['mean_s'] > 0
+    s = agg_mod.aggregate(d)
+    assert len(s['devices']) == n_dev
+    assert s['skew']['step_time_ratio'] >= 1.0
+    # The fences also render as per-device Perfetto counter tracks.
+    trace = json.load(open(os.path.join(d, 'trace.json')))
+    fence_tracks = {e['name'] for e in trace['traceEvents']
+                    if e.get('cat') == 'fence'}
+    assert len(fence_tracks) == n_dev
+    assert f'device_step[{jax.devices()[0].id}]' in fence_tracks
+
+
+def test_fence_devices_noops(tmp_path):
+    from dgmc_tpu.obs import RunObserver
+    disabled = RunObserver(None)
+    assert disabled.fence_devices(jnp.ones(())) is None
+    with RunObserver(str(tmp_path / 'obs')) as obs:
+        assert obs.fence_devices(3.5) is None       # non-jax input

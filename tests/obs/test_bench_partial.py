@@ -14,11 +14,14 @@ import pytest
 def bench_mod():
     import bench
     saved = dict(bench._PROGRESS)
+    saved_timeout = dict(bench._SECTION_TIMEOUT)
     bench._PROGRESS.update(sections={}, current=None, current_t0=None,
-                           start=time.time())
+                           in_body=False, start=time.time())
+    bench._SECTION_TIMEOUT['seconds'] = 0.0
     yield bench
     bench._PROGRESS.clear()
     bench._PROGRESS.update(saved)
+    bench._SECTION_TIMEOUT.update(saved_timeout)
 
 
 def test_sections_record_success_and_failure(bench_mod):
@@ -51,6 +54,82 @@ def test_partial_line_on_sigterm(bench_mod, monkeypatch, capsys):
     assert rec['sections']['sparse_f32']['ok'] is True
     assert rec['current']['name'] == 'dense_f32'
     assert rec['current']['elapsed_s'] >= 0
+
+
+def test_section_timeout_swallowed_and_recorded(bench_mod, monkeypatch):
+    """A section exceeding its --section-timeout budget is recorded as
+    timed out and the run MOVES ON (SectionTimeout swallowed); leg
+    variables keep their pre-section None, later sections still run."""
+    import signal
+    monkeypatch.setattr(os, '_exit',
+                        lambda code: (_ for _ in ()).throw(
+                            SystemExit(code)))
+    prev_alrm = signal.getsignal(signal.SIGALRM)
+    prev_term = signal.getsignal(signal.SIGTERM)
+    bench_mod._install_signal_handlers()
+    bench_mod._SECTION_TIMEOUT['seconds'] = 0.2
+    try:
+        result = None
+        with bench_mod._section('stuck'):
+            time.sleep(5)               # SIGALRM interrupts this sleep
+            result = 'completed'        # never reached
+        assert result is None
+        rec = bench_mod._PROGRESS['sections']['stuck']
+        assert rec['ok'] is False and rec['timeout'] is True
+        assert 'section-timeout' in rec['error'] or 'timeout' in \
+            rec['error']
+        # The run proceeds: the next section completes normally.
+        with bench_mod._section('next'):
+            pass
+        assert bench_mod._PROGRESS['sections']['next']['ok'] is True
+    finally:
+        signal.setitimer(signal.ITIMER_REAL, 0)
+        signal.signal(signal.SIGALRM, prev_alrm)
+        signal.signal(signal.SIGTERM, prev_term)
+
+
+def test_external_alarm_mid_body_before_budget_kills_with_partial(
+        bench_mod, monkeypatch, capsys):
+    """An EXTERNAL SIGALRM (timeout -s ALRM) landing inside a budgeted
+    section body BEFORE the budget elapsed must not be swallowed as a
+    fake section timeout — it is the kill, with evidence."""
+    import signal
+    exit_codes = []
+    monkeypatch.setattr(os, '_exit', lambda code: exit_codes.append(code))
+    bench_mod._SECTION_TIMEOUT['seconds'] = 600.0
+    bench_mod._PROGRESS['current'] = 'sparse_f32'
+    bench_mod._PROGRESS['current_t0'] = time.perf_counter()  # just began
+    bench_mod._PROGRESS['in_body'] = True
+    bench_mod._on_signal(signal.SIGALRM, None)
+    assert exit_codes == [124]
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rec['partial'] is True and rec['signal'] == 'SIGALRM'
+
+
+def test_alarm_outside_section_still_emits_partial(bench_mod,
+                                                   monkeypatch, capsys):
+    """--section-timeout must not hijack an EXTERNAL SIGALRM landing
+    between sections: that is still the kill-with-evidence path."""
+    import signal
+    exit_codes = []
+    monkeypatch.setattr(os, '_exit', lambda code: exit_codes.append(code))
+    bench_mod._SECTION_TIMEOUT['seconds'] = 30.0
+    with bench_mod._section('done_one'):
+        pass
+    bench_mod._on_signal(signal.SIGALRM, None)
+    assert exit_codes == [124]
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rec['partial'] is True and rec['signal'] == 'SIGALRM'
+    assert rec['sections']['done_one']['ok'] is True
+
+
+def test_section_emits_stderr_progress_line(bench_mod, capsys):
+    with bench_mod._section('leg'):
+        pass
+    err = capsys.readouterr().err
+    rec = json.loads([ln for ln in err.splitlines()
+                      if ln.startswith('{')][-1])
+    assert rec['section'] == 'leg' and rec['ok'] is True
 
 
 def test_obs_section_logging(bench_mod, tmp_path, monkeypatch):

@@ -26,7 +26,8 @@ BASE_DISPATCH = {'counts': [
      'count': 1}]}
 
 
-def write_run(root, name, timings=None, memory=None, dispatch=None):
+def write_run(root, name, timings=None, memory=None, dispatch=None,
+              efficiency=None, hang=None, aggregate=None):
     d = os.path.join(str(root), name)
     os.makedirs(d, exist_ok=True)
     with open(os.path.join(d, 'timings.json'), 'w') as f:
@@ -37,6 +38,12 @@ def write_run(root, name, timings=None, memory=None, dispatch=None):
         json.dump(dispatch or BASE_DISPATCH, f)
     with open(os.path.join(d, 'metrics.jsonl'), 'w') as f:
         f.write(json.dumps({'step': 1, 'loss': 1.0}) + '\n')
+    for fname, payload in (('efficiency.json', efficiency),
+                           ('hang_report.json', hang),
+                           ('aggregate.json', aggregate)):
+        if payload is not None:
+            with open(os.path.join(d, fname), 'w') as f:
+                json.dump(payload, f)
     return d
 
 
@@ -151,6 +158,62 @@ def test_empty_dir_is_usage_error(tmp_path):
     empty = tmp_path / 'empty'
     empty.mkdir()
     assert diff_mod.main([a, str(empty)]) == 2
+
+
+def test_hung_candidate_is_regression(tmp_path, capsys):
+    """Satellite pin: a candidate that left a hang_report.json must NOT
+    diff as 'fewer metrics, pass' — a hang truncates the run, which
+    usually improves every surviving aggregate."""
+    a = write_run(tmp_path, 'a')
+    b = write_run(tmp_path, 'b',
+                  hang={'reason': 'deadline', 'stalled_for_s': 120.0,
+                        'in_flight': {'phase': 'step', 'name': 7}})
+    assert diff_mod.main([a, b]) == 1
+    out = capsys.readouterr().out
+    assert 'hang_report' in out and 'candidate hung' in out
+    # The fix direction (baseline hung, candidate clean) passes.
+    assert diff_mod.main([b, a]) == 0
+    # Both hung: noted, and the remaining metrics still gate (equal
+    # here, so rc 0).
+    assert diff_mod.main([b, b]) == 0
+    assert 'baseline hung too' in capsys.readouterr().out
+
+
+EFF = {'mfu': 0.5, 'peak_flops': 1e12, 'peak_flops_source': 'table',
+       'programs': {'train_step': {'flops': 1e9, 'mfu': 0.5}}}
+
+
+def test_mfu_regression_gates(tmp_path, capsys):
+    a = write_run(tmp_path, 'a', efficiency=EFF)
+    dropped = dict(EFF, mfu=0.3)
+    b = write_run(tmp_path, 'b', efficiency=dropped)
+    assert diff_mod.main([a, b]) == 1          # -40% > default 25%
+    assert 'mfu' in capsys.readouterr().out
+    assert diff_mod.main([a, b, '--max-mfu-regression', '0.5']) == 0
+    # Improvement direction passes by default.
+    assert diff_mod.main([b, a]) == 0
+
+
+def test_mfu_missing_from_candidate_is_regression(tmp_path, capsys):
+    a = write_run(tmp_path, 'a', efficiency=EFF)
+    b = write_run(tmp_path, 'b')
+    assert diff_mod.main([a, b]) == 1
+    assert 'missing from candidate' in capsys.readouterr().out
+    # Baseline never had it: skip, not fail.
+    assert diff_mod.main([b, a]) == 0
+
+
+def test_skew_regression_gates(tmp_path, capsys):
+    agg = {'skew': {'step_time_ratio': 1.1}}
+    worse = {'skew': {'step_time_ratio': 2.2}}
+    a = write_run(tmp_path, 'a', aggregate=agg)
+    b = write_run(tmp_path, 'b', aggregate=worse)
+    assert diff_mod.main([a, b]) == 1          # 2x growth > default 50%
+    assert 'skew_step_time_ratio' in capsys.readouterr().out
+    assert diff_mod.main([a, b, '--max-skew-regression', '1.5']) == 0
+    # Aggregation absent from one side: skipped, not a regression.
+    c = write_run(tmp_path, 'c')
+    assert diff_mod.main([a, c]) == 0
 
 
 @pytest.mark.parametrize('probe_fallback', [True, False])

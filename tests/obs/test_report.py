@@ -102,6 +102,71 @@ def test_obs_dir_reuse_holds_one_run(tmp_path):
     assert s['steps'] == first['steps']
 
 
+def test_report_renders_efficiency_and_hang(tmp_path, capsys):
+    d = _make_run(tmp_path)
+    with open(os.path.join(d, 'efficiency.json'), 'w') as f:
+        json.dump({'mfu': 0.42, 'peak_flops': 1e12,
+                   'peak_flops_ref': 'TPU vX bf16',
+                   'peak_flops_source': 'table',
+                   'programs': {'train_step': {
+                       'flops': 1e9, 'bytes': 2e8, 'mfu': 0.42,
+                       'stages': {'psi1': {'flops': 5e8,
+                                           'bytes_out': 1e8,
+                                           'ops': 10}}}}}, f)
+    with open(os.path.join(d, 'hang_report.json'), 'w') as f:
+        json.dump({'reason': 'deadline', 'stalled_for_s': 60.0,
+                   'in_flight': {'phase': 'step', 'name': 3},
+                   'last_completed': {'phase': 'step', 'name': 2}}, f)
+    s = report.summarize(report.load_run(d))
+    assert s['mfu'] == 0.42
+    assert s['flops_per_step'] == 1e9
+    assert s['hang_report']['reason'] == 'deadline'
+    assert report.main([d]) == 0
+    out = capsys.readouterr().out
+    assert 'MFU' in out and '42' in out
+    assert 'psi1' in out and 'cost / efficiency' in out
+    assert 'RUN HUNG' in out
+
+
+def test_probe_rebuild_matches_live_aggregates(tmp_path):
+    """Satellite pin: aggregates recomputed from the raw metrics.jsonl
+    series (the probe_aggregates_from_metrics fallback path) must match
+    the timings.json aggregates the live sink wrote — same accumulator,
+    same numbers. ('nonfinite' is exempt by construction: only FIRING
+    checks reach metrics.jsonl, so the rebuild sees a different
+    population than the live all-checks statistics.)"""
+    import jax
+    from dgmc_tpu.obs import probes
+
+    d = str(tmp_path / 'obs')
+    obs = RunObserver(d, probes=True)
+    try:
+
+        def f(x):
+            probes.emit('corr_entropy', jnp.sum(x), stage='S0')
+            probes.emit('consensus_delta', jnp.mean(x), iteration=0)
+            probes.check_finite('psi1', x, order=1)
+            return x * 2.0
+
+        jf = jax.jit(f)
+        for i in range(5):
+            with obs.step():
+                jax.block_until_ready(jf(jnp.ones((4,)) * i))
+        jax.effects_barrier()
+        obs.log(1, loss=1.0)
+    finally:
+        obs.close()
+
+    timings = json.load(open(os.path.join(d, 'timings.json')))
+    live = timings['probes']
+    rebuilt = report.probe_aggregates_from_metrics(
+        report.load_run(d)['metrics'])
+    assert set(rebuilt) == set(live) - {'nonfinite'}
+    for name in rebuilt:
+        assert rebuilt[name] == live[name], name
+    assert live['corr_entropy']['count'] == 5
+
+
 def test_artifacts_survive_midrun(tmp_path):
     """Artifacts are rewritten on every log/snapshot, so a killed run
     still leaves analyzable telemetry (the BENCH_r05 failure mode)."""

@@ -1,0 +1,202 @@
+"""Run-health watchdog contract (obs/watchdog.py).
+
+The acceptance pin for the injected-stall scenario: a train loop with a
+deliberately blocked step, run under the watchdog with a short deadline,
+must produce a valid ``hang_report.json`` naming the last-completed
+stage span — the diagnosability the rc:124 MULTICHIP/BENCH rounds
+lacked. Plus the signal path (dump + chain to the previous handler) and
+the disarm-on-close hygiene.
+"""
+
+import json
+import os
+import signal
+import time
+
+import pytest
+
+from dgmc_tpu.obs.watchdog import Watchdog, thread_stacks
+
+
+def _read(path):
+    with open(path) as f:
+        return json.load(f)
+
+
+def test_thread_stacks_cover_current_thread():
+    stacks = thread_stacks()
+    assert any('test_thread_stacks_cover_current_thread' in ln
+               for t in stacks for ln in t['stack'])
+    assert all('name' in t and 'stack' in t for t in stacks)
+
+
+def test_deadline_dump_on_blocked_step(tmp_path):
+    """The injected-stall scenario: beats for fast steps, then a blocked
+    one; the dump must name the stall and the last-completed span."""
+    path = str(tmp_path / 'hang_report.json')
+    wd = Watchdog(path, deadline_s=0.25, poll_s=0.05).start()
+    try:
+        for i in range(3):
+            wd.beat('step', i)
+            time.sleep(0.01)
+            wd.done()
+        wd.beat('step', 3)          # the deliberately blocked step
+        time.sleep(0.9)             # > deadline: the thread dumps
+        assert os.path.exists(path)
+        rep = _read(path)
+        assert rep['reason'] == 'deadline'
+        assert rep['in_flight']['phase'] == 'step'
+        assert rep['in_flight']['name'] == 3
+        assert rep['in_flight']['since_s'] >= 0.25
+        assert rep['stalled_for_s'] >= 0.25
+        assert rep['last_completed']['phase'] == 'step'
+        assert rep['last_completed']['name'] == 2
+        # All-thread tracebacks include this (stalled) main thread.
+        assert any('time.sleep' in ln or 'sleep' in ln
+                   for t in rep['threads'] for ln in t['stack'])
+        assert rep['deadline_s'] == 0.25
+    finally:
+        wd.close()
+
+
+def test_no_dump_while_beaten(tmp_path):
+    path = str(tmp_path / 'hang_report.json')
+    wd = Watchdog(path, deadline_s=0.3, poll_s=0.05).start()
+    try:
+        for i in range(10):
+            wd.beat('step', i)
+            time.sleep(0.05)
+            wd.done()
+    finally:
+        wd.close()
+    assert not os.path.exists(path)
+
+
+def test_dump_once_per_stall_then_rearms(tmp_path):
+    path = str(tmp_path / 'hang_report.json')
+    wd = Watchdog(path, deadline_s=0.15, poll_s=0.03).start()
+    try:
+        wd.beat('step', 0)
+        time.sleep(0.5)
+        assert wd.dump_count == 1      # one stall, one dump — no spam
+        wd.beat('step', 1)             # recovery re-arms
+        time.sleep(0.5)
+        assert wd.dump_count == 2
+    finally:
+        wd.close()
+
+
+def test_context_fn_lands_in_report(tmp_path):
+    path = str(tmp_path / 'hang_report.json')
+    wd = Watchdog(path, deadline_s=0.15, poll_s=0.03,
+                  context_fn=lambda: {'steps_completed': 41}).start()
+    try:
+        wd.beat('step', 41)
+        time.sleep(0.5)
+        assert _read(path)['context']['steps_completed'] == 41
+    finally:
+        wd.close()
+
+
+def test_signal_dump_chains_to_previous_handler(tmp_path):
+    """A SIGTERM dump must not swallow the previously-installed handler
+    (bench.py's partial-line emitter chains after the report)."""
+    seen = []
+    prev = signal.signal(signal.SIGTERM, lambda s, f: seen.append(s))
+    path = str(tmp_path / 'hang_report.json')
+    wd = Watchdog(path, deadline_s=None,
+                  signals=(signal.SIGTERM,)).start()
+    try:
+        os.kill(os.getpid(), signal.SIGTERM)
+        # Delivery is synchronous for a self-signal on the main thread.
+        assert seen == [signal.SIGTERM]
+        rep = _read(path)
+        assert rep['reason'] == 'signal:SIGTERM'
+    finally:
+        wd.close()
+        signal.signal(signal.SIGTERM, prev)
+
+
+def test_close_restores_signal_handlers(tmp_path):
+    marker = lambda s, f: None                                # noqa: E731
+    prev = signal.signal(signal.SIGTERM, marker)
+    try:
+        wd = Watchdog(str(tmp_path / 'h.json'),
+                      signals=(signal.SIGTERM,)).start()
+        assert signal.getsignal(signal.SIGTERM) is not marker
+        wd.close()
+        assert signal.getsignal(signal.SIGTERM) is marker
+    finally:
+        signal.signal(signal.SIGTERM, prev)
+
+
+def test_dump_never_raises_on_unwritable_path():
+    wd = Watchdog('/nonexistent-dir/sub/hang.json', deadline_s=None)
+    assert wd.dump('deadline') is None      # returns None, no raise
+
+
+# ---------------------------------------------------------------------------
+# RunObserver integration (the --watchdog-deadline wiring)
+# ---------------------------------------------------------------------------
+
+
+def test_runobserver_blocked_step_produces_hang_report(tmp_path):
+    from dgmc_tpu.obs import RunObserver
+    d = str(tmp_path / 'obs')
+    obs = RunObserver(d, watchdog_deadline_s=0.25,
+                      watchdog_signals=())
+    try:
+        for _ in range(2):
+            with obs.step():
+                time.sleep(0.01)
+        obs.log(1, loss=1.0)
+        with obs.step():               # the deliberately blocked step
+            time.sleep(0.9)
+    finally:
+        obs.close()
+    rep = _read(os.path.join(d, 'hang_report.json'))
+    assert rep['reason'] == 'deadline'
+    assert rep['in_flight']['phase'] == 'step'
+    assert rep['in_flight']['name'] == 2
+    ctx = rep['context']
+    assert ctx['steps_completed'] == 2
+    assert 'last_step_span' in ctx
+    assert ctx['steps']['steps'] == 2
+
+
+def test_runobserver_healthy_run_leaves_no_report(tmp_path):
+    from dgmc_tpu.obs import RunObserver
+    d = str(tmp_path / 'obs')
+    with RunObserver(d, watchdog_deadline_s=30.0,
+                     watchdog_signals=()) as obs:
+        with obs.step():
+            pass
+        obs.log(1, loss=0.5)
+    assert not os.path.exists(os.path.join(d, 'hang_report.json'))
+    assert obs.watchdog is None            # close() disarmed it
+
+
+def test_runobserver_without_deadline_has_no_watchdog(tmp_path):
+    from dgmc_tpu.obs import RunObserver
+    with RunObserver(str(tmp_path / 'obs')) as obs:
+        assert obs.watchdog is None
+
+
+@pytest.mark.parametrize('deadline', [0.25])
+def test_runobserver_pending_compile_label_in_report(tmp_path, deadline):
+    """A stall inside a labelled compile region names that label as
+    pending — the 'which compile wedged' question MULTICHIP_r0x left
+    open."""
+    from dgmc_tpu.obs import RunObserver
+    d = str(tmp_path / 'obs')
+    obs = RunObserver(d, watchdog_deadline_s=deadline,
+                      watchdog_signals=())
+    try:
+        with obs.compile_label('phase2'):
+            time.sleep(0.9)
+    finally:
+        obs.close()
+    rep = _read(os.path.join(d, 'hang_report.json'))
+    assert rep['in_flight'] == {'phase': 'compile', 'name': 'phase2',
+                                'since_s': rep['in_flight']['since_s']}
+    assert rep['context']['pending_compiles'] == ['phase2']
