@@ -10,6 +10,18 @@ per-phase train steps instead of module-attribute mutation (reference
 Optionally shards the correspondence activations over all available chips
 (``--model_shards N``) — the scale-out axis the reference lacks.
 
+Training defaults to the bf16-compute / f32-accumulation precision
+policy (``--f32`` opts out; ``dgmc_tpu/models/precision.py``), and
+``--pairs-per-step N`` batches N replicas of the pair per step, each
+drawing independent per-pair indicator noise / negative samples — the
+MXU sees a real batch axis instead of B=1 and one step averages N
+independent gradient samples. The per-pair RNG streams (noise,
+negatives) are fold_in-exact against independent B=1 steps
+(``tests/models/test_pairs_per_step.py``); ψ₁'s dropout masks are the
+one batch-drawn coupler, so this CLI's batched losses are equivalent in
+distribution, not bitwise (phase 2 trains with ψ₁ detached but its
+dropout still active, as the reference does).
+
 Run: ``python examples/dbp15k.py --category zh_en``
 (optionally ``--data_root ../data/DBP15K``)
 """
@@ -62,11 +74,18 @@ def parse_args(argv=None):
     parser.add_argument('--syn_seed_frac', type=float, default=0.3,
                         help='seed-alignment fraction (the reference '
                              'protocol trains on 30%%)')
-    parser.add_argument('--bf16', action='store_true',
-                        help='bf16 compute policy: backbone matmuls, '
-                             'similarity GEMMs, consensus MLP and blocked '
-                             'message gathers in bfloat16; parameters, '
-                             'logits and loss stay float32')
+    from dgmc_tpu.models.precision import add_precision_args
+    add_precision_args(parser)
+    parser.add_argument('--pairs-per-step', '--pairs_per_step',
+                        dest='pairs_per_step', type=int, default=1,
+                        metavar='N',
+                        help='batch N replicas of the training pair per '
+                             'step, each drawing independent per-pair '
+                             'indicator noise and negative samples '
+                             '(fold_in per batch element) — one step '
+                             'averages N independent gradient samples '
+                             'while the MXU sees a real batch axis '
+                             'instead of B=1')
     parser.add_argument('--dim', type=int, default=256)
     parser.add_argument('--rnd_dim', type=int, default=32)
     parser.add_argument('--num_layers', type=int, default=3)
@@ -148,22 +167,32 @@ def synthetic_batches(args):
     snd_t = np.concatenate([snd_t, rng.randint(0, n_t, extra)])
     rcv_t = np.concatenate([rcv_t, rng.randint(0, n_t, extra)])
 
+    from dgmc_tpu.models.precision import from_args
+    from dgmc_tpu.ops.blocked import repeat_graph
+    prec = from_args(args)
+
     def side(x, s, r, n):
         g = GraphBatch(x=x[None], senders=s[None].astype(np.int32),
                        receivers=r[None].astype(np.int32),
                        node_mask=np.ones((1, n), bool),
                        edge_mask=np.ones((1, s.shape[0]), bool),
                        edge_attr=None)
-        return attach_blocks(
-            g, gather_dtype='bfloat16' if args.bf16 else None)
+        return attach_blocks(g, gather_dtype=prec)
 
-    g_s, g_t = side(x_s, snd, rcv, n_s), side(x_t, snd_t, rcv_t, n_t)
+    # Train batch at B = pairs_per_step (replicas of the one pair, each
+    # drawing its own per-pair indicator noise / negatives on device;
+    # blocked ONCE at B=1, replicas tiled); eval keeps B=1 — replicated
+    # metrics would just repeat themselves.
+    reps = max(1, args.pairs_per_step)
+    e_s1, e_t1 = side(x_s, snd, rcv, n_s), side(x_t, snd_t, rcv_t, n_t)
+    g_s, g_t = repeat_graph(e_s1, reps), repeat_graph(e_t1, reps)
     train_mask = np.zeros(n_s, bool)
     train_mask[:int(args.syn_seed_frac * n_s)] = True
-    y_train = np.where(train_mask, perm, -1).astype(np.int32)[None]
+    y_train = np.repeat(
+        np.where(train_mask, perm, -1).astype(np.int32)[None], reps, 0)
     y_test = np.where(~train_mask, perm, -1).astype(np.int32)[None]
     return (PairBatch(s=g_s, t=g_t, y=y_train, y_mask=y_train >= 0),
-            PairBatch(s=g_s, t=g_t, y=y_test, y_mask=y_test >= 0),
+            PairBatch(s=e_s1, t=e_t1, y=y_test, y_mask=y_test >= 0),
             c)
 
 
@@ -183,23 +212,32 @@ def load_batches(args):
     y_test = np.full(n1, -1, np.int64)
     y_test[data.test_y[0]] = data.test_y[1]
 
-    from dgmc_tpu.ops.blocked import attach_blocks
+    from dgmc_tpu.models.precision import from_args
+    from dgmc_tpu.ops.blocked import attach_blocks, repeat_graph
     from dgmc_tpu.utils.data import PairBatch
+
+    prec = from_args(args)
 
     def batch(y_col):
         return pad_pair_batch([GraphPair(s=g1, t=g2, y_col=y_col)],
                               num_nodes_s=n1, num_edges_s=g1.num_edges,
                               num_nodes_t=n2, num_edges_t=g2.num_edges)
 
+    reps = max(1, args.pairs_per_step)
     train_b, test_b = batch(y_train), batch(y_test)
     # Scatter-free MXU aggregation (ops/blocked.py) cuts the training step
     # ~22% at this scale (bench.py sparse leg). The graph sides are
-    # identical in both batches — block them once and share.
-    gd = 'bfloat16' if args.bf16 else None
-    s_b = attach_blocks(train_b.s, gather_dtype=gd)
-    t_b = attach_blocks(train_b.t, gather_dtype=gd)
-    return (PairBatch(s=s_b, t=t_b, y=train_b.y, y_mask=train_b.y_mask),
-            PairBatch(s=s_b, t=t_b, y=test_b.y, y_mask=test_b.y_mask),
+    # identical in both batches — block them ONCE at B=1 and share; the
+    # pairs-per-step train batch tiles the blocked sides (repeat_graph)
+    # instead of re-running the host-side blocking per replica. Eval
+    # stays B=1.
+    e_s = attach_blocks(train_b.s, gather_dtype=prec)
+    e_t = attach_blocks(train_b.t, gather_dtype=prec)
+    s_b, t_b = repeat_graph(e_s, reps), repeat_graph(e_t, reps)
+    y_tr = np.repeat(train_b.y, reps, axis=0)
+    m_tr = np.repeat(train_b.y_mask, reps, axis=0)
+    return (PairBatch(s=s_b, t=t_b, y=y_tr, y_mask=m_tr),
+            PairBatch(s=e_s, t=e_t, y=test_b.y, y_mask=test_b.y_mask),
             g1.x.shape[1])
 
 
@@ -231,15 +269,15 @@ def main(argv=None):
         train_batch = global_batch(train_batch, mesh, replicate=True)
         test_batch = global_batch(test_batch, mesh, replicate=True)
 
-    import jax.numpy as jnp
-    dt = jnp.bfloat16 if args.bf16 else None
+    from dgmc_tpu.models.precision import from_args
+    prec = from_args(args)
     psi_1 = RelCNN(in_dim, args.dim, args.num_layers, batch_norm=False,
-                   cat=True, lin=True, dropout=0.5, dtype=dt)
+                   cat=True, lin=True, dropout=0.5, dtype=prec)
     psi_2 = RelCNN(args.rnd_dim, args.rnd_dim, args.num_layers,
                    batch_norm=False, cat=True, lin=True, dropout=0.0,
-                   dtype=dt)
+                   dtype=prec)
     model = DGMC(psi_1, psi_2, num_steps=args.num_steps, k=args.k,
-                 corr_sharding=corr_sharding, dtype=dt)
+                 corr_sharding=corr_sharding, dtype=prec)
 
     state = create_train_state(model, jax.random.key(args.seed), train_batch,
                                learning_rate=args.lr)

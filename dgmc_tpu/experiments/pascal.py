@@ -56,6 +56,8 @@ def parse_args(argv=None):
                              'explicitly elsewhere)')
     parser.add_argument('--num_processes', type=int, default=None)
     parser.add_argument('--process_id', type=int, default=None)
+    from dgmc_tpu.models.precision import add_precision_args
+    add_precision_args(parser)
     add_obs_flag(parser)
     add_profile_flag(parser)
     return parser.parse_args(argv)
@@ -103,11 +105,13 @@ def main(argv=None):
                               shuffle=True, seed=args.seed,
                               num_nodes=num_nodes, num_edges=num_edges)
 
+    from dgmc_tpu.models.precision import from_args
+    prec = from_args(args)  # bf16 compute / f32 accum unless --f32
     psi_1 = SplineCNN(in_dim, args.dim, edge_dim, args.num_layers,
-                      cat=False, dropout=0.5)
+                      cat=False, dropout=0.5, dtype=prec)
     psi_2 = SplineCNN(args.rnd_dim, args.rnd_dim, edge_dim, args.num_layers,
-                      cat=True, dropout=0.0)
-    model = DGMC(psi_1, psi_2, num_steps=args.num_steps)
+                      cat=True, dropout=0.0, dtype=prec)
+    model = DGMC(psi_1, psi_2, num_steps=args.num_steps, dtype=prec)
 
     batch0 = next(iter(train_loader))
     state = create_train_state(model, jax.random.key(args.seed), batch0,

@@ -53,6 +53,8 @@ def parse_args(argv=None):
                              'epoch into this directory')
     parser.add_argument('--metrics_log', type=str, default=None,
                         help='append per-epoch metrics to this JSONL file')
+    from dgmc_tpu.models.precision import add_precision_args
+    add_precision_args(parser)
     add_obs_flag(parser)
     add_profile_flag(parser)
     return parser.parse_args(argv)
@@ -65,11 +67,13 @@ def build(args):
     train_loader = PairLoader(train_dataset, args.batch_size, shuffle=True,
                               seed=args.seed, num_nodes=80, num_edges=640)
 
+    from dgmc_tpu.models.precision import from_args
+    prec = from_args(args)  # bf16 compute / f32 accum unless --f32
     psi_1 = SplineCNN(1, args.dim, 2, args.num_layers, cat=False,
-                      dropout=0.0)
+                      dropout=0.0, dtype=prec)
     psi_2 = SplineCNN(args.rnd_dim, args.rnd_dim, 2, args.num_layers,
-                      cat=True, dropout=0.0)
-    model = DGMC(psi_1, psi_2, num_steps=args.num_steps)
+                      cat=True, dropout=0.0, dtype=prec)
+    model = DGMC(psi_1, psi_2, num_steps=args.num_steps, dtype=prec)
     return model, train_loader, transform
 
 

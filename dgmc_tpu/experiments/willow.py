@@ -71,6 +71,8 @@ def parse_args(argv=None):
     parser.add_argument('--metrics_log', type=str, default=None,
                         help='append per-epoch/per-run metrics to this '
                              'JSONL file')
+    from dgmc_tpu.models.precision import add_precision_args
+    add_precision_args(parser)
     add_obs_flag(parser)
     add_profile_flag(parser)
     return parser.parse_args(argv)
@@ -117,11 +119,13 @@ def main(argv=None):
                                 features=features)
               for c in WILLOW_CATEGORIES]
 
+    from dgmc_tpu.models.precision import from_args
+    prec = from_args(args)  # bf16 compute / f32 accum unless --f32
     psi_1 = SplineCNN(in_dim, args.dim, edge_dim, args.num_layers,
-                      cat=False, dropout=0.5)
+                      cat=False, dropout=0.5, dtype=prec)
     psi_2 = SplineCNN(args.rnd_dim, args.rnd_dim, edge_dim, args.num_layers,
-                      cat=True, dropout=0.0)
-    model = DGMC(psi_1, psi_2, num_steps=args.num_steps)
+                      cat=True, dropout=0.0, dtype=prec)
+    model = DGMC(psi_1, psi_2, num_steps=args.num_steps, dtype=prec)
 
     batch0 = next(iter(pretrain_loader))
     state = create_train_state(model, jax.random.key(args.seed), batch0,

@@ -1,3 +1,4 @@
+from dgmc_tpu.models import precision
 from dgmc_tpu.models.mlp import MLP
 from dgmc_tpu.models.norm import MaskedBatchNorm
 from dgmc_tpu.models.gin import GIN, GINConv
@@ -16,4 +17,5 @@ __all__ = [
     'SplineConv',
     'DGMC',
     'Correspondence',
+    'precision',
 ]

@@ -137,13 +137,14 @@ class DGMC(nn.Module):
     # so a single huge pair (DBP15K-scale) spreads its activation state
     # across chips. GSPMD propagates the layout through the consensus loop.
     corr_sharding: Optional[object] = None
-    # Mixed-precision compute dtype for the matching stage itself (the
-    # similarity GEMMs, candidate search operands and consensus MLP):
+    # Mixed-precision compute dtype — a raw dtype or a
+    # models/precision.Precision policy — for the matching stage itself
+    # (the similarity GEMMs, candidate search operands and consensus MLP):
     # psi outputs are cast to it, matmuls run on the bf16 MXU, and the
     # correspondence logits S_hat accumulate in float32
     # (preferred_element_type) so softmax/loss numerics stay f32.
     # Parameters always stay float32. None = float32 throughout. Set the
-    # same dtype on the backbones for end-to-end mixed precision.
+    # same dtype/policy on the backbones for end-to-end mixed precision.
     dtype: Optional[Any] = None
     # Pallas kernel for the dense consensus update: bounds the
     # [B, N_s, N_t, R] difference tensor to one VMEM tile and rematerializes
@@ -160,25 +161,26 @@ class DGMC(nn.Module):
     # segment-sum and the candidate gathers' scatter-add VJPs) through a
     # once-per-step blocked sort of S_idx (ops/corr_route.py) — matmuls
     # only, reused by every consensus iteration and the backward.
-    # Default OFF: measured at DBP15K scale (15000x20000, k=10+10+GT) the
-    # routed step is ~16% SLOWER than the segment-sum form (433.5 vs
-    # 373.8 ms full step; 35.9 vs 30.9 ms/iteration + ~10 ms of route
-    # build in the base) — the per-candidate row gather of ~395k padded
-    # 128-byte rows runs at the chip's ~10-31 GB/s random-gather rate,
-    # costing more than the ~1.2 ms scatter it replaces. Kept as an
-    # explicit option: it is matmul/gather-only (no scatter anywhere), so
-    # it remains valid under corr_sharding / shard_map where scatter
-    # performance or partitioning rules differ.
+    # Default OFF per the measured dispatch-defaults table
+    # (benchmarks/DISPATCH_DEFAULTS.md, `corr_route` row): the routed
+    # form's padded-row gathers cost more than the scatters they remove
+    # at DBP15K scale. Kept as an explicit option: it is
+    # matmul/gather-only (no scatter anywhere), so it remains valid
+    # under corr_sharding / shard_map where scatter performance or
+    # partitioning rules differ.
     route_sparse: Optional[bool] = None
-    # Fused Pallas kernel for the sparse consensus delta
-    # (ops/pallas/sparse_consensus.py). Default OFF: measured at DBP15K
-    # scale it is ~4 ms/iteration SLOWER than XLA's own fusion of the
-    # unfused form (device-time profile: fwd+bwd "other" 82 -> 122
-    # ms/step with the kernel; benchmarks/README.md) — the per-tile
-    # one-hot expansion matmuls and 128-row tiles lose to XLA fusing the
-    # broadcast-subtract into two full-size GEMMs. Kept as an explicit
-    # option (shard_map-compatible via vma) for platforms where the HBM
-    # round-trips it avoids dominate.
+    # Fused Pallas path for the sparse consensus delta
+    # (ops/pallas/sparse_consensus.py). ``True`` enables the WIDENED
+    # fusion boundary (`fused_candidate_delta`): the candidate gather
+    # joins the kernel's custom_vjp — residuals shrink from the
+    # [B, N_s, K, R] candidate tensor to the [B, N_t, R] ψ₂ output, the
+    # backward rematerializes the gather tile-style, and d_o_t reduces
+    # through one fused f32 segment-sum per iteration. Default is the
+    # auto decision recorded in benchmarks/DISPATCH_DEFAULTS.md
+    # (`sparse_consensus` row — the narrow delta-only kernel measured
+    # slower than XLA's fusion against the stream-packed
+    # `prefetch_source` baseline; the widened boundary is the
+    # re-measure candidate). Kept shard_map-compatible via vma.
     fused_sparse_consensus: Optional[bool] = None
     # Run a backbone ONCE per application point on the node-axis
     # disjoint union of the (source, target) pair instead of twice (once
@@ -208,7 +210,7 @@ class DGMC(nn.Module):
 
     @nn.compact
     def __call__(self, graph_s, graph_t, y=None, y_mask=None, train=False,
-                 num_steps=None, detach=None):
+                 num_steps=None, detach=None, pair_offset=0):
         """Compute initial and refined correspondences ``(S_0, S_L)``.
 
         Args:
@@ -221,6 +223,15 @@ class DGMC(nn.Module):
             num_steps / detach: per-call overrides of the module defaults —
                 the explicit-phase replacement for the reference's
                 attribute-mutation schedule.
+            pair_offset: static global index of this batch's FIRST pair in
+                the per-pair RNG stream: pair ``b`` draws its indicator
+                noise / negative samples from
+                ``fold_in(stream_key, pair_offset + b)``, so a batched
+                step over pairs ``[i, i+N)`` is element-wise
+                RNG-identical to ``N`` independent ``B=1`` calls at
+                offsets ``i..i+N-1`` with the same stream keys — the
+                ``--pairs-per-step`` equivalence contract
+                (tests/models/test_pairs_per_step.py).
         """
         num_steps = self.num_steps if num_steps is None else num_steps
         detach = self.detach if detach is None else detach
@@ -319,8 +330,10 @@ class DGMC(nn.Module):
         probe = _probes.enabled() and train
         if probe:
             _probes.check_finite('psi1', h_s, h_t, order=0)
-        if self.dtype is not None:
-            h_s, h_t = h_s.astype(self.dtype), h_t.astype(self.dtype)
+        from dgmc_tpu.models.precision import compute_dtype_of
+        dtype = compute_dtype_of(self.dtype)
+        if dtype is not None:
+            h_s, h_t = h_s.astype(dtype), h_t.astype(dtype)
         if detach:
             h_s = jax.lax.stop_gradient(h_s)
             h_t = jax.lax.stop_gradient(h_t)
@@ -364,9 +377,17 @@ class DGMC(nn.Module):
                 preferred_element_type=jnp.float32)
             return out[..., 0] + mlp_b2[0]
 
+        def pair_keys(key):
+            # One independent key per PAIR, folded from the stream key at
+            # the pair's global index: batching pairs is then RNG-exact
+            # against the equivalent run of B=1 steps (see `pair_offset`).
+            return jax.vmap(jax.random.fold_in, in_axes=(None, 0))(
+                key, pair_offset + jnp.arange(B))
+
         def noise(step):
-            key = self.make_rng('noise')
-            return jax.random.normal(key, (B, N_s, R_in), h_s.dtype)
+            keys = pair_keys(self.make_rng('noise'))
+            return jax.vmap(
+                lambda k: jax.random.normal(k, (N_s, R_in), h_s.dtype))(keys)
 
         def prefetch_source(num_steps):
             """Batch the source side of ψ₂ across ALL consensus iterations.
@@ -544,8 +565,9 @@ class DGMC(nn.Module):
                 y_mask = jnp.ones(y.shape, bool)
             num_rnd = min(self.k, N_t - self.k)
             if num_rnd > 0:
-                u = jax.random.uniform(self.make_rng('negatives'),
-                                       (B, N_s, num_rnd))
+                keys = pair_keys(self.make_rng('negatives'))
+                u = jax.vmap(
+                    lambda k: jax.random.uniform(k, (N_s, num_rnd)))(keys)
                 n_valid = n_valid_t.astype(u.dtype)                 # [B]
                 rnd = jnp.floor(u * n_valid[:, None, None]).astype(jnp.int32)
                 S_idx = jnp.concatenate([S_idx, rnd], axis=-1)
@@ -606,18 +628,21 @@ class DGMC(nn.Module):
             _probes.check_finite('initial_corr', S_hat, order=1)
             _probe_corr_stage(S_0, s_mask, 'S0')
 
-        # Fused consensus-delta kernel (ops/pallas/sparse_consensus.py):
+        # Fused consensus-delta path (ops/pallas/sparse_consensus.py):
         # forms the [TILE, K, R] difference block and MLP activations in
-        # VMEM only, with a tile-recompute backward — instead of XLA
-        # round-tripping the [B, N_s, K, R] difference tensor (+ saved
-        # activations) through HBM ten times per step. GSPMD programs
+        # VMEM only, with a tile-recompute backward — and, via the
+        # widened `fused_candidate_delta` boundary, keeps the candidate
+        # gather inside the custom_vjp so the [B, N_s, K, R] tensor is
+        # never saved across the fwd/bwd boundary (rematerialized;
+        # d_o_t lands through one fused f32 segment-sum). GSPMD programs
         # keep the jnp form (no partitioning rule); shard_map is fine
         # (the kernel declares its vma).
         # Explicit True is honored (interpret mode off-TPU, like the
         # dense fused_consensus kernel); only an auto decision would
         # consult the trace-time contextvar — and the auto decision is
-        # "off" (the recorded negative result above). corr_sharding was
-        # rejected loudly earlier; an unsatisfiable width is too.
+        # the recorded dispatch default (benchmarks/DISPATCH_DEFAULTS.md).
+        # corr_sharding was rejected loudly earlier; an unsatisfiable
+        # width is too.
         use_sc = self.fused_sparse_consensus is True
         if num_steps > 0:
             from dgmc_tpu.ops.pallas.dispatch import record_dispatch
@@ -643,17 +668,31 @@ class DGMC(nn.Module):
                         o_t = run_psi(self.psi_2, r_t, graph_t, train=train)
                     else:
                         o_s, o_t = run_pair(self.psi_2, r_s, r_t, merge_2)
-                o_t_cand = cand_rows(o_t)
-                if use_sc:
+                if use_sc and not use_route:
+                    # Widened fusion boundary: the candidate gather rides
+                    # inside the kernel's custom_vjp (rematerialized in
+                    # the backward) instead of materializing + saving
+                    # [B, N_s, K, R] per iteration.
+                    from dgmc_tpu.ops.pallas.sparse_consensus import (
+                        fused_candidate_delta)
+                    cast = lambda a: a.astype(o_s.dtype)  # noqa: E731
+                    delta = fused_candidate_delta(
+                        o_s, o_t.astype(o_s.dtype), S_idx, cast(mlp_w1),
+                        cast(mlp_b1), cast(mlp_w2), cast(mlp_b2),
+                        jax.default_backend() != 'tpu')
+                elif use_sc:
+                    # route_sparse composes with the narrow kernel: the
+                    # routed gather owns the backward, the kernel the MLP.
                     from dgmc_tpu.ops.pallas.sparse_consensus import (
                         sparse_consensus_delta)
                     cast = lambda a: a.astype(o_s.dtype)  # noqa: E731
                     delta = sparse_consensus_delta(
-                        o_s, o_t_cand, cast(mlp_w1), cast(mlp_b1),
+                        o_s, cand_rows(o_t), cast(mlp_w1), cast(mlp_b1),
                         cast(mlp_w2), cast(mlp_b2),
                         jax.default_backend() != 'tpu')
                 else:
-                    delta = consensus_mlp(o_s[:, :, None, :] - o_t_cand)
+                    delta = consensus_mlp(
+                        o_s[:, :, None, :] - cand_rows(o_t))
                 S_hat_next = self._constrain(S_hat + delta)
                 if probe:
                     S_next = (masked_softmax(S_hat_next, entry_mask)

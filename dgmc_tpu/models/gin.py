@@ -21,6 +21,7 @@ import jax.numpy as jnp
 from flax import linen as nn
 
 from dgmc_tpu.models.mlp import MLP
+from dgmc_tpu.models.precision import compute_dtype_of
 from dgmc_tpu.ops.graph import gather_nodes, scatter_to_nodes
 
 
@@ -45,8 +46,9 @@ class GIN(nn.Module):
     batch_norm: bool = False
     cat: bool = True
     lin: bool = True
-    # Mixed-precision compute dtype for the per-layer MLPs and final Dense;
-    # parameters stay float32. None = float32.
+    # Mixed-precision compute dtype (or a precision.Precision policy)
+    # for the per-layer MLPs and final Dense; parameters stay float32.
+    # None = float32.
     dtype: Optional[Any] = None
 
     @property
@@ -61,11 +63,12 @@ class GIN(nn.Module):
     def __call__(self, x, graph, train=False):
         import jax
 
+        dtype = compute_dtype_of(self.dtype)
         xs = [x]
         in_ch = self.in_channels
         for i in range(self.num_layers):
             mlp = MLP(in_ch, self.channels, 2, self.batch_norm, dropout=0.0,
-                      dtype=self.dtype, name=f'mlp_{i}')
+                      dtype=dtype, name=f'mlp_{i}')
             # Named layer scopes for profiler-trace attribution.
             with jax.named_scope(f'gin_conv_{i}'):
                 xs.append(GINConv(mlp, name=f'conv_{i}')(xs[-1], graph,
@@ -74,7 +77,7 @@ class GIN(nn.Module):
         out = jnp.concatenate(xs, axis=-1) if self.cat else xs[-1]
         if self.lin:
             out = nn.Dense(self.channels, name='final',
-                           dtype=self.dtype)(out)
+                           dtype=dtype)(out)
         return out
 
     def __repr__(self):

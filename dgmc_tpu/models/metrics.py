@@ -42,13 +42,24 @@ def _gt_val(S, y):
 
 
 def nll_loss(S, y, y_mask=None, reduction='mean'):
-    """Negative log-likelihood of the ground-truth correspondences."""
+    """Negative log-likelihood of the ground-truth correspondences.
+
+    ``reduction``: ``'mean'`` (over every valid correspondence in the
+    batch), ``'sum'``, ``'none'`` (elementwise ``[B, N_s]``), or
+    ``'per_pair'`` — a ``[B]`` masked mean per batch element, the
+    quantity the ``--pairs-per-step`` equivalence contract pins (pair
+    ``b`` of a batched step reports the same loss as its own ``B=1``
+    step).
+    """
     y, y_mask = _prep(y, y_mask)
     val, found = _gt_val(S, y)
     m = y_mask & found
     nll = -jnp.log(val + EPS) * m
     if reduction == 'none':
         return nll
+    if reduction == 'per_pair':
+        axes = tuple(range(1, nll.ndim))
+        return nll.sum(axes) / jnp.maximum(m.sum(axes), 1)
     total = nll.sum()
     if reduction == 'sum':
         return total

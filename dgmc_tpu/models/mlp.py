@@ -11,6 +11,7 @@ from typing import Any, Optional
 from flax import linen as nn
 
 from dgmc_tpu.models.norm import MaskedBatchNorm
+from dgmc_tpu.models.precision import compute_dtype_of
 
 
 class MLP(nn.Module):
@@ -19,19 +20,21 @@ class MLP(nn.Module):
     num_layers: int
     batch_norm: bool = False
     dropout: float = 0.0
-    # Mixed-precision compute dtype (e.g. jnp.bfloat16): matmuls run on the
-    # bf16 MXU while parameters stay float32 (flax promotes per-op). BN
-    # statistics are always float32 (see MaskedBatchNorm). None = float32.
+    # Mixed-precision compute dtype (e.g. jnp.bfloat16) or a
+    # models/precision.Precision policy: matmuls run on the bf16 MXU while
+    # parameters stay float32 (flax promotes per-op). BN statistics are
+    # always float32 (see MaskedBatchNorm). None = float32.
     dtype: Optional[Any] = None
 
     @nn.compact
     def __call__(self, x, node_mask=None, train=False):
+        dtype = compute_dtype_of(self.dtype)
         for i in range(self.num_layers):
             last = i == self.num_layers - 1
             if last:
                 x = nn.Dropout(self.dropout, deterministic=not train)(x)
             x = nn.Dense(self.out_channels, name=f'dense_{i}',
-                         dtype=self.dtype)(x)
+                         dtype=dtype)(x)
             if not last:
                 x = nn.relu(x)
                 if self.batch_norm:

@@ -1,0 +1,168 @@
+"""Precision policy: bf16 compute / f32 accumulation, ON by default.
+
+One object owns the repo's mixed-precision contract instead of a
+``--bf16`` flag re-implemented per CLI:
+
+- **compute dtype** — what the backbone/consensus matmuls run in on the
+  MXU (``bfloat16`` under the default policy; ``None`` = float32).
+- **accumulation contract** — correspondence logits, losses, segment /
+  blocked reductions and the fused Pallas kernels' running sums stay
+  float32 regardless of the compute dtype (``preferred_element_type`` on
+  every contraction that feeds a logit; pinned by
+  ``tests/models/test_precision.py``). A bf16 running sum stops
+  absorbing contributions once it is ~256x any addend, so accumulation
+  precision is a *correctness* contract, not a knob.
+- **parameters / optimizer state** — always float32 (flax promotes
+  per-op; the policy never touches storage dtypes).
+- **gather dtype** — the blocked-aggregation message tables
+  (``ops/blocked.py``) move as bf16 where the rows stay >= 512 bytes
+  (the narrow-row guard in ``_routed`` keeps sub-cache-line tables f32
+  by design).
+
+The default policy is **bf16**: it measured 1.22x on the dense flagship
+and 1.14x on the sparse DBP15K step at lower peak HBM
+(``BENCH_r04.json``) with full-scale quality evidence committed
+(``runs/dbp15k_syn_bf16.jsonl``; EXPERIMENTS.md). Every experiment CLI
+exposes ``--f32`` as the explicit opt-out (``--precision f32``), and
+``--bf16`` remains as a compatible no-op alias of the default.
+
+Models consume the policy through :func:`compute_dtype_of`, so their
+``dtype`` fields accept either a raw jnp dtype (back-compat) or a
+:class:`Precision` object.
+"""
+
+import dataclasses
+from typing import Any, Optional
+
+__all__ = ['Precision', 'BF16', 'F32', 'get', 'compute_dtype_of',
+           'gather_dtype_of', 'add_precision_args', 'from_args']
+
+
+@dataclasses.dataclass(frozen=True)
+class Precision:
+    """An immutable mixed-precision policy (see module docstring).
+
+    ``compute_dtype`` is ``None`` for pure-f32 compute (the flax
+    convention for "no cast"); ``gather_dtype`` is the string dtype the
+    blocked message tables travel as (``None`` = float32 traffic).
+    Accumulation is float32 under every policy — there is deliberately
+    no field for it.
+    """
+    name: str
+    compute_dtype: Optional[Any]
+    gather_dtype: Optional[str]
+
+    @property
+    def is_mixed(self):
+        return self.compute_dtype is not None
+
+    def __repr__(self):
+        return f'Precision({self.name!r})'
+
+
+def _bf16_dtype():
+    import jax.numpy as jnp
+    return jnp.bfloat16
+
+
+# The two shipped policies. BF16 is the library default for training
+# CLIs; benchmarks pin their per-leg policy explicitly so recorded
+# numbers never depend on a library default. BF16 is materialized
+# lazily through the module __getattr__ below (importing this module
+# must not pull jax) — `precision.BF16` / `from ... import BF16` always
+# yield the real policy object, never a placeholder.
+F32 = Precision('f32', None, None)
+_BF16 = None
+
+
+def _bf16():
+    global _BF16
+    if _BF16 is None:
+        _BF16 = Precision('bf16', _bf16_dtype(), 'bfloat16')
+    return _BF16
+
+
+def __getattr__(name):
+    if name == 'BF16':
+        return _bf16()
+    raise AttributeError(f'module {__name__!r} has no attribute {name!r}')
+
+
+def get(spec):
+    """Normalize ``spec`` to a :class:`Precision`.
+
+    Accepts a policy (returned as-is), ``'bf16'``/``'f32'`` names,
+    ``None`` (→ f32), or a raw dtype (→ the matching policy; any
+    non-f32 dtype maps to the bf16 policy's structure with that compute
+    dtype).
+    """
+    if isinstance(spec, Precision):
+        return spec
+    if spec is None:
+        return F32
+    if isinstance(spec, str):
+        name = spec.lower()
+        if name in ('bf16', 'bfloat16'):
+            return _bf16()
+        if name in ('f32', 'fp32', 'float32'):
+            return F32
+        raise ValueError(f'unknown precision policy {spec!r} '
+                         f"(expected 'bf16' or 'f32')")
+    import jax.numpy as jnp
+    dt = jnp.dtype(spec)
+    if dt == jnp.float32:
+        return F32
+    if dt == jnp.bfloat16:
+        return _bf16()
+    return Precision(str(dt), spec, None)
+
+
+def compute_dtype_of(spec):
+    """The compute dtype a model should cast activations/matmuls to:
+    ``None`` for float32. Accepts everything :func:`get` accepts, so a
+    module's ``dtype`` field may hold a raw dtype OR a policy."""
+    if spec is None:
+        return None
+    if isinstance(spec, (Precision, str)):
+        return get(spec).compute_dtype
+    return spec  # raw dtype: back-compat fast path
+
+
+def gather_dtype_of(spec):
+    """The blocked-aggregation gather dtype for ``spec`` (a policy,
+    name, dtype, or an explicit gather-dtype string like
+    ``'bfloat16'``)."""
+    if spec is None:
+        return None
+    if isinstance(spec, Precision):
+        return spec.gather_dtype
+    if isinstance(spec, str) and spec not in ('bf16', 'f32', 'fp32',
+                                              'float32'):
+        return spec  # already a dtype string ('bfloat16')
+    return get(spec).gather_dtype
+
+
+def add_precision_args(parser):
+    """Attach the shared precision flags to an ``argparse`` parser:
+    ``--precision {bf16,f32}`` (default **bf16**), ``--f32`` as the
+    explicit opt-out shorthand, and ``--bf16`` as the legacy alias of
+    the default."""
+    group = parser.add_argument_group('precision policy')
+    group.add_argument('--precision', choices=['bf16', 'f32'],
+                       default='bf16',
+                       help='compute policy: bf16 matmuls with f32 '
+                            'accumulation (default) or full f32')
+    group.add_argument('--f32', dest='precision', action='store_const',
+                       const='f32',
+                       help='opt out of the bf16 default '
+                            '(= --precision f32)')
+    group.add_argument('--bf16', dest='precision', action='store_const',
+                       const='bf16',
+                       help='legacy alias of the bf16 default')
+    return parser
+
+
+def from_args(args):
+    """The :class:`Precision` selected by :func:`add_precision_args`
+    flags."""
+    return get(getattr(args, 'precision', None) or 'f32')

@@ -19,6 +19,7 @@ import jax.numpy as jnp
 from flax import linen as nn
 
 from dgmc_tpu.models.norm import MaskedBatchNorm
+from dgmc_tpu.models.precision import compute_dtype_of
 from dgmc_tpu.ops.graph import gather_nodes, scatter_to_nodes
 
 
@@ -40,6 +41,7 @@ class RelConv(nn.Module):
         iteration batching (``models/dgmc.py prefetch_source``).
         """
         B, N = x.shape[0], x.shape[1]
+        dtype = compute_dtype_of(self.dtype)
 
         def grouped(dense, v):
             if streams == 1:
@@ -48,9 +50,9 @@ class RelConv(nn.Module):
             return g.reshape(B, N, -1)
 
         h1 = grouped(nn.Dense(self.out_features, use_bias=False,
-                              name='lin1', dtype=self.dtype), x)
+                              name='lin1', dtype=dtype), x)
         h2 = grouped(nn.Dense(self.out_features, use_bias=False,
-                              name='lin2', dtype=self.dtype), x)
+                              name='lin2', dtype=dtype), x)
         if graph.blocks_in is not None:
             # Scatter-free MXU path: blocked one-hot contractions with a
             # matmul (never scatter-add) backward via the transposed
@@ -72,7 +74,7 @@ class RelConv(nn.Module):
             a_out = scatter_to_nodes(m_out, graph.senders, graph.edge_mask,
                                      x.shape[1], aggr='mean')
         root = grouped(nn.Dense(self.out_features, name='root',
-                                dtype=self.dtype), x)
+                                dtype=dtype), x)
         return root + (a_in + a_out).astype(root.dtype)
 
 
@@ -88,8 +90,9 @@ class RelCNN(nn.Module):
     cat: bool = True
     lin: bool = True
     dropout: float = 0.0
-    # Mixed-precision compute dtype for every Dense / aggregation matmul;
-    # parameters and BN statistics stay float32. None = float32.
+    # Mixed-precision compute dtype (or a precision.Precision policy)
+    # for every Dense / aggregation matmul; parameters and BN statistics
+    # stay float32. None = float32.
     dtype: Optional[Any] = None
 
     @property
@@ -118,11 +121,12 @@ class RelCNN(nn.Module):
         import jax
 
         B, N = x.shape[0], x.shape[1]
+        dtype = compute_dtype_of(self.dtype)
         xs = [x]
         for i in range(self.num_layers):
             # Named layer scopes for profiler-trace attribution.
             with jax.named_scope(f'rel_conv_{i}'):
-                h = RelConv(self.channels, dtype=self.dtype,
+                h = RelConv(self.channels, dtype=dtype,
                             name=f'conv_{i}')(xs[-1], graph, train=train,
                                               streams=streams)
             h = nn.relu(h)
@@ -135,7 +139,7 @@ class RelCNN(nn.Module):
             out = jnp.concatenate(xs, axis=-1) if self.cat else xs[-1]
             if self.lin:
                 out = nn.Dense(self.channels, name='final',
-                               dtype=self.dtype)(out)
+                               dtype=dtype)(out)
             return out
         # Grouped jumping-knowledge concat + final Dense: per group.
         if self.cat:
@@ -145,7 +149,7 @@ class RelCNN(nn.Module):
             out = xs[-1].reshape(B, N, streams, -1)
         if self.lin:
             out = nn.Dense(self.channels, name='final',
-                           dtype=self.dtype)(out)
+                           dtype=dtype)(out)
         return out.reshape(B, N, -1)
 
     def __repr__(self):

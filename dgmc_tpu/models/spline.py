@@ -22,6 +22,7 @@ from typing import Any, Optional
 import jax.numpy as jnp
 from flax import linen as nn
 
+from dgmc_tpu.models.precision import compute_dtype_of
 from dgmc_tpu.ops.graph import scatter_to_nodes
 from dgmc_tpu.ops.spline import open_spline_basis
 
@@ -47,6 +48,7 @@ class SplineConv(nn.Module):
         import jax
 
         B, N, C_in = x.shape
+        dtype = compute_dtype_of(self.dtype)
         KD = self.kernel_size ** self.dim
         weight = self.param(
             'weight',
@@ -57,9 +59,9 @@ class SplineConv(nn.Module):
 
         # [B, N, KD * C_out]: every node through every kernel matrix — one
         # MXU GEMM (in the compute dtype when the bf16 policy is on).
-        if self.dtype is not None:
-            x = x.astype(self.dtype)
-            weight = weight.astype(self.dtype)
+        if dtype is not None:
+            x = x.astype(dtype)
+            weight = weight.astype(dtype)
         t = x @ weight.transpose(1, 0, 2).reshape(C_in, KD * self.out_features)
         t = t.reshape(B, N * KD, self.out_features)
 
@@ -94,7 +96,7 @@ class SplineConv(nn.Module):
             agg = scatter_to_nodes(msgs, graph.receivers, graph.edge_mask,
                                    N, aggr='mean')
         root = nn.Dense(self.out_features, use_bias=False, name='root',
-                        dtype=self.dtype)(x)
+                        dtype=dtype)(x)
         bias = self.param('bias', nn.initializers.zeros, (self.out_features,))
         return agg.astype(root.dtype) + root + bias.astype(root.dtype)
 
@@ -111,7 +113,8 @@ class SplineCNN(nn.Module):
     # TPU at fitting sizes); set False inside GSPMD-partitioned programs —
     # pallas_call has no partitioning rule (see DGMC.corr_sharding).
     fused: Optional[bool] = None
-    # Mixed-precision compute dtype; parameters stay float32.
+    # Mixed-precision compute dtype (or a precision.Precision policy);
+    # parameters stay float32.
     dtype: Optional[Any] = None
 
     @property
@@ -126,20 +129,21 @@ class SplineCNN(nn.Module):
     def __call__(self, x, graph, train=False):
         import jax
 
+        dtype = compute_dtype_of(self.dtype)
         xs = [x]
         for i in range(self.num_layers):
             # Named layer scopes so profiler traces attribute time to the
             # conv stack instead of anonymous fused XLA ops.
             with jax.named_scope(f'spline_conv_{i}'):
                 h = SplineConv(self.channels, self.dim, fused=self.fused,
-                               dtype=self.dtype,
+                               dtype=dtype,
                                name=f'conv_{i}')(xs[-1], graph, train=train)
             xs.append(nn.relu(h))
         out = jnp.concatenate(xs, axis=-1) if self.cat else xs[-1]
         out = nn.Dropout(self.dropout, deterministic=not train)(out)
         if self.lin:
             out = nn.Dense(self.channels, name='final',
-                           dtype=self.dtype)(out)
+                           dtype=dtype)(out)
         return out
 
     def __repr__(self):

@@ -37,6 +37,11 @@ regression thresholds:
 - **MFU** — relative decrease of the headline MFU
   (``efficiency.json``) above ``--max-mfu-regression`` fails, as does
   an MFU the baseline had but the candidate lost.
+- **arithmetic intensity** — relative decrease of the headline achieved
+  FLOPs/byte (``efficiency.json``) above
+  ``--max-intensity-regression`` fails (a program that got
+  byte-heavier per FLOP slid down the roofline even if wall-clock
+  noise hides it); lost-from-candidate fails like MFU.
 - **skew** — the device step-time skew ratio (``aggregate.json``, see
   ``obs.aggregate``) growing past ``--max-skew-regression`` fails;
   runs without aggregation skip the row (the artifact is produced by a
@@ -62,6 +67,7 @@ DEFAULT_THRESHOLDS = {
     'memory': 0.15,
     'new_compile_events': 5,
     'mfu': 0.25,
+    'intensity': 0.40,
     'skew': 0.50,
 }
 
@@ -162,6 +168,27 @@ def diff_runs(a, b, thresholds=None, allow_kernel_fallback=False):
         else:
             gate('mfu', mfu_a, mfu_b, round(d, 4), thr['mfu'],
                  -d > thr['mfu'])
+
+    # -- achieved arithmetic intensity ------------------------------------
+    # Same asymmetry as MFU: an intensity account the baseline had but
+    # the candidate lost is a broken gate input, not a skip.
+    ai_a, ai_b = a.get('arith_intensity'), b.get('arith_intensity')
+    if ai_a is not None and ai_b is None:
+        rows.append(_row('arith_intensity', ai_a, ai_b, None,
+                         thr['intensity'], 'REGRESSION',
+                         'missing from candidate'))
+    elif ai_a is None and ai_b is not None:
+        rows.append(_row('arith_intensity', ai_a, ai_b, None,
+                         thr['intensity'], 'skipped',
+                         'missing from baseline'))
+    elif ai_a is not None:
+        d = _rel(ai_a, ai_b)
+        if d is None:
+            rows.append(_row('arith_intensity', ai_a, ai_b, None,
+                             thr['intensity'], 'skipped', 'zero baseline'))
+        else:
+            gate('arith_intensity', ai_a, ai_b, round(d, 4),
+                 thr['intensity'], -d > thr['intensity'])
 
     # -- multi-device skew ------------------------------------------------
     sk_a = (a.get('skew') or {}).get('step_time_ratio')
@@ -304,6 +331,12 @@ def main(argv=None):
                         metavar='FRAC',
                         help='allowed fractional headline-MFU decrease '
                              '(efficiency.json; default %(default)s)')
+    parser.add_argument('--max-intensity-regression', type=float,
+                        default=DEFAULT_THRESHOLDS['intensity'],
+                        metavar='FRAC',
+                        help='allowed fractional decrease of the headline '
+                             'achieved arithmetic intensity (FLOPs/byte, '
+                             'efficiency.json; default %(default)s)')
     parser.add_argument('--max-skew-regression', type=float,
                         default=DEFAULT_THRESHOLDS['skew'],
                         metavar='FRAC',
@@ -338,6 +371,7 @@ def main(argv=None):
             'memory': args.max_memory_regression,
             'new_compile_events': args.max_new_compile_events,
             'mfu': args.max_mfu_regression,
+            'intensity': args.max_intensity_regression,
             'skew': args.max_skew_regression,
         },
         allow_kernel_fallback=args.allow_kernel_fallback)

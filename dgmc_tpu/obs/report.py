@@ -199,6 +199,18 @@ def summarize(run):
         ts = eff.get('programs', {}).get('train_step', {})
         if ts.get('flops'):
             out['flops_per_step'] = ts['flops']
+        # Headline achieved arithmetic intensity (FLOPs/byte): the
+        # train_step program's when present, else the first program
+        # carrying one — mirrors the headline-MFU convention so
+        # obs.diff can gate roofline position alongside utilization.
+        ai = ts.get('arith_intensity')
+        if ai is None:
+            for p in eff.get('programs', {}).values():
+                if p.get('arith_intensity') is not None:
+                    ai = p['arith_intensity']
+                    break
+        if ai is not None:
+            out['arith_intensity'] = ai
 
     hang = run.get('hang')
     if hang:

@@ -176,46 +176,46 @@ def _routed(h, src, loc, msk, rid, rows, num_ranges, out_rows, gather_dtype,
                                                      jnp.floating):
         h = jax.lax.optimization_barrier(h.astype(jnp.float32))
 
-    def one(hb, src_b, loc_b, msk_b, rid_b, scale_b):
-        # mode='clip': block indices are host-built and always in-bounds
-        # (padding points at row 0 under mask=False, zeroed by the one-hot
-        # contraction), so jnp.take's default out-of-bounds 'fill' would
-        # only add a full-width select_n pass over every gathered row —
-        # profiled at ~0.56 ms per gather at DBP15K scale, ~40 ms/step
-        # across ψ₁/ψ₂ before this was pinned.
-        g = jnp.take(hb, src_b.reshape(-1), axis=0,
-                     mode='clip')                          # [NB*E_b, C]
-        g = g.reshape(src_b.shape + (C,))                  # [NB, E_b, C]
-        if scale_b is not None:
-            g = g * scale_b[..., None].astype(g.dtype)
-        # Edge-structure-only routing tensor: CSE'd across every layer and
-        # consensus iteration that aggregates over this graph.
-        onehot = (loc_b[..., None] == jnp.arange(rows)) & msk_b[..., None]
-        # HIGHEST precision for f32 operands: these contractions are tiny
-        # (a few GFLOP) but route f32 values, and the default single-pass
-        # bf16 MXU mode would silently round every message. bf16 operands
-        # (gather_dtype) are exact in one pass. (A single-pass bf16
-        # contraction of the exactly-bf16-representable upcast tables was
-        # tried in r5 and LOST ~30 ms/step — narrow bf16 operands pay
-        # (2,1)-packing relayouts that dwarf the saved MXU passes.)
-        prec = (None if gather_dtype is not None
-                else jax.lax.Precision.HIGHEST)
-        per_block = jnp.einsum('ber,bec->brc', onehot.astype(g.dtype), g,
-                               precision=prec,
-                               preferred_element_type=acc)  # [NB, R, C]
-        combine = (rid_b[None, :] == jnp.arange(num_ranges)[:, None])
-        # Combine is tiny; keep it HIGHEST so f32 partial sums are never
-        # re-rounded regardless of gather dtype.
-        out = jnp.einsum('nb,brc->nrc', combine.astype(acc), per_block,
-                         precision=jax.lax.Precision.HIGHEST,
-                         preferred_element_type=acc)
-        return out.reshape(num_ranges * rows, C)[:out_rows]
-
-    if scale is None:
-        return jax.vmap(
-            lambda hb, s, l, m, r: one(hb, s, l, m, r, None))(
-                h, src, loc, msk, rid).astype(acc)
-    return jax.vmap(one)(h, src, loc, msk, rid, scale).astype(acc)
+    # Batch-FLATTENED row gather: one [B*M, C] table with globally
+    # offset indices instead of a per-element vmapped gather. A batched
+    # leading dim is the TPU gather/scatter slow path (see the batch_pair
+    # notes in models/dgmc.py), and under --pairs-per-step batching the
+    # per-element form would pay that tax B times per aggregation.
+    # mode='clip': block indices are host-built and always in-bounds
+    # (padding points at row 0 under mask=False, zeroed by the one-hot
+    # contraction), so jnp.take's default out-of-bounds 'fill' would
+    # only add a full-width select_n pass over every gathered row —
+    # profiled at ~0.56 ms per gather at DBP15K scale, ~40 ms/step
+    # across ψ₁/ψ₂ before this was pinned.
+    B, M = h.shape[0], h.shape[1]
+    gidx = src + (jnp.arange(B, dtype=src.dtype) * M)[:, None, None]
+    g = jnp.take(h.reshape(B * M, C), gidx.reshape(-1), axis=0,
+                 mode='clip')
+    g = g.reshape(src.shape + (C,))                        # [B, NB, E_b, C]
+    if scale is not None:
+        g = g * scale[..., None].astype(g.dtype)
+    # Edge-structure-only routing tensor: CSE'd across every layer and
+    # consensus iteration that aggregates over this graph.
+    onehot = (loc[..., None] == jnp.arange(rows)) & msk[..., None]
+    # HIGHEST precision for f32 operands: these contractions are tiny
+    # (a few GFLOP) but route f32 values, and the default single-pass
+    # bf16 MXU mode would silently round every message. bf16 operands
+    # (gather_dtype) are exact in one pass. (A single-pass bf16
+    # contraction of the exactly-bf16-representable upcast tables was
+    # tried in r5 and LOST ~30 ms/step — narrow bf16 operands pay
+    # (2,1)-packing relayouts that dwarf the saved MXU passes.)
+    prec = (None if gather_dtype is not None
+            else jax.lax.Precision.HIGHEST)
+    per_block = jnp.einsum('aber,abec->abrc', onehot.astype(g.dtype), g,
+                           precision=prec,
+                           preferred_element_type=acc)  # [B, NB, R, C]
+    combine = (rid[:, None, :] == jnp.arange(num_ranges)[None, :, None])
+    # Combine is tiny; keep it HIGHEST so f32 partial sums are never
+    # re-rounded regardless of gather dtype.
+    out = jnp.einsum('anb,abrc->anrc', combine.astype(acc), per_block,
+                     precision=jax.lax.Precision.HIGHEST,
+                     preferred_element_type=acc)
+    return out.reshape(B, num_ranges * rows, C)[:, :out_rows].astype(acc)
 
 
 def _routed_sum(h, blocks):
@@ -332,6 +332,37 @@ class UnionPair:
         return out[:, :self.n_s], out[:, self.n_s + self.pad:]
 
 
+def repeat_graph(graph, reps):
+    """Tile a :class:`GraphBatch` — including any attached
+    :class:`EdgeBlocks` — ``reps``× along the batch axis.
+
+    The ``--pairs-per-step`` replication path: replicas are
+    byte-identical, so the host-side blocking runs ONCE on the B=1 graph
+    and the resulting index tensors are repeated, instead of
+    ``build_edge_blocks`` re-sorting the same 100k+-edge lists per
+    replica (x2 directions x2 sides) at startup.
+    """
+    if reps <= 1:
+        return graph
+
+    def t(a):
+        return None if a is None else jnp.repeat(jnp.asarray(a), reps,
+                                                 axis=0)
+
+    def tb(b):
+        if b is None:
+            return None
+        return b.replace(src=t(b.src), dst_local=t(b.dst_local),
+                         mask=t(b.mask), range_id=t(b.range_id),
+                         inv_degree=t(b.inv_degree))
+
+    return graph.replace(
+        x=t(graph.x), senders=t(graph.senders),
+        receivers=t(graph.receivers), node_mask=t(graph.node_mask),
+        edge_mask=t(graph.edge_mask), edge_attr=t(graph.edge_attr),
+        blocks_in=tb(graph.blocks_in), blocks_out=tb(graph.blocks_out))
+
+
 def attach_blocks(graph, rows=128, block_edges=512, min_nodes=1024,
                   gather_dtype=None) -> 'object':
     """Return ``graph`` with blocked-adjacency structure attached.
@@ -356,6 +387,11 @@ def attach_blocks(graph, rows=128, block_edges=512, min_nodes=1024,
     """
     if graph.num_nodes < min_nodes or graph.blocks_in is not None:
         return graph
+    if gather_dtype is not None and not isinstance(gather_dtype, str):
+        # Accept a models/precision.Precision policy (or raw dtype) in
+        # place of the dtype string — the CLIs pass their policy through.
+        from dgmc_tpu.models.precision import gather_dtype_of
+        gather_dtype = gather_dtype_of(gather_dtype)
     inc, outg = build_edge_blocks(graph.senders, graph.receivers,
                                   graph.edge_mask, graph.num_nodes,
                                   rows=rows, block_edges=block_edges)

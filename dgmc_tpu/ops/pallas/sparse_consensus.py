@@ -15,6 +15,17 @@ weight gradients in a float32 VMEM accumulator across the grid sweep —
 TPU grids are sequential, so revisiting the same output block is a safe
 accumulation.
 
+Two fusion boundaries are exposed:
+
+- :func:`sparse_consensus_delta` — the narrow form: takes pre-gathered
+  candidates ``[B, N_s, K, R]`` (saved as residuals for the backward).
+- :func:`fused_candidate_delta` — the WIDENED round-trip form: takes the
+  full ψ₂ output table ``[B, N_t, R]`` plus ``S_idx`` and folds the
+  candidate gather into the custom_vjp. Residuals shrink to the table
+  itself, the backward rematerializes the gather, and ``d_o_t`` reduces
+  through one fused float32 segment-sum per iteration — the candidate
+  tensor stops round-tripping HBM between forward and backward.
+
 Mosaic layout note: the kernel never reshapes across the sublane axis
 (``[TILE, K, R] -> [TILE*K, R]`` is an unsupported relayout). Instead the
 candidate tensor arrives pre-flattened from XLA (``[B, N_s*K, R]``, a
@@ -170,9 +181,8 @@ def _fwd(o_s, cand, w1, b1, w2, b2, interpret=False):
     return out, (o_s, cand, w1, b1, w2, b2)
 
 
-def _bwd(interpret, res, g):
+def _backward(o_s, cand, w1, b1, w2, b2, g, interpret):
     from dgmc_tpu.ops.pallas.dispatch import promote_vma, vma_union
-    o_s, cand, w1, b1, w2, b2 = res
     B, N_s, R = o_s.shape
     K = cand.shape[2]
     vma = vma_union(o_s, cand, w1, b1, w2, g)
@@ -229,4 +239,83 @@ def _bwd(interpret, res, g):
             d_w2.astype(w2.dtype), d_b2[0].astype(b2.dtype))
 
 
+def _bwd(interpret, res, g):
+    return _backward(*res, g, interpret)
+
+
 sparse_consensus_delta.defvjp(_fwd, _bwd)
+
+
+# ---------------------------------------------------------------------------
+# Widened fusion boundary: candidate gather folded into the kernel's VJP
+# ---------------------------------------------------------------------------
+
+
+def _gather_rows(o_t, S_idx):
+    """``o_t[b, S_idx[b, s, k], :]`` → ``[B, N_s, K, R]`` (mode='clip':
+    candidate ids come from top-k / negatives / GT injection, in-bounds by
+    construction)."""
+    B, N_s, K = S_idx.shape
+    flat = jnp.take_along_axis(o_t, S_idx.reshape(B, N_s * K, 1), axis=1,
+                               mode='clip')
+    return flat.reshape(B, N_s, K, o_t.shape[-1])
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(7,))
+def fused_candidate_delta(o_s, o_t, S_idx, w1, b1, w2, b2, interpret=False):
+    """Consensus delta with the candidate GATHER inside the fusion
+    boundary: ``mlp(o_s[:, :, None] - o_t[S_idx])`` → ``[B, N_s, K]`` f32.
+
+    Versus :func:`sparse_consensus_delta` (which receives pre-gathered
+    candidates), this widens the differentiable unit across the
+    gather→ψ₂-output round-trip:
+
+    - the forward feeds the gathered rows straight into the tile kernel
+      and saves ``(o_s, o_t, S_idx, weights)`` as residuals —
+      ``O(B·N_t·R)`` instead of the ``O(B·N_s·K·R)`` candidate tensor
+      the narrow kernel (and XLA's gather VJP) keeps live in HBM per
+      iteration;
+    - the backward REMATERIALIZES the gather (flash-attention-style, like
+      the tile recompute inside the kernel) and reduces ``d_cand`` to
+      ``d_o_t`` with one flat f32 segment-sum per iteration — exactly
+      the scatter XLA's ``take_along_axis`` VJP would emit, but with the
+      candidate tensor never saved across the forward/backward boundary.
+
+    The f32-accumulation contract holds throughout: the kernel's logits
+    and the ``d_o_t`` reduction accumulate in float32 regardless of the
+    compute dtype (pinned by tests/models/test_precision.py).
+    """
+    return _forward(o_s, _gather_rows(o_t, S_idx), w1, b1, w2, b2,
+                    interpret)
+
+
+def fused_candidate_delta_reference(o_s, o_t, S_idx, w1, b1, w2, b2):
+    """Unfused jnp semantics (tests / non-TPU paths)."""
+    return sparse_consensus_delta_reference(o_s, _gather_rows(o_t, S_idx),
+                                            w1, b1, w2, b2)
+
+
+def _rt_fwd(o_s, o_t, S_idx, w1, b1, w2, b2, interpret=False):
+    out = _forward(o_s, _gather_rows(o_t, S_idx), w1, b1, w2, b2, interpret)
+    return out, (o_s, o_t, S_idx, w1, b1, w2, b2)
+
+
+def _rt_bwd(interpret, res, g):
+    o_s, o_t, S_idx, w1, b1, w2, b2 = res
+    cand = _gather_rows(o_t, S_idx)                        # remat
+    d_os, d_cand, d_w1, d_b1, d_w2, d_b2 = _backward(
+        o_s, cand, w1, b1, w2, b2, g, interpret)
+    B, N_s, K = S_idx.shape
+    N_t = o_t.shape[1]
+    acc = jnp.promote_types(o_t.dtype, jnp.float32)
+    flat = d_cand.reshape(B, N_s * K, -1).astype(acc)
+
+    def scat(c, idx):
+        return jax.ops.segment_sum(c, idx, num_segments=N_t)
+
+    d_o_t = jax.vmap(scat)(flat, S_idx.reshape(B, N_s * K)).astype(
+        o_t.dtype)
+    return d_os, d_o_t, None, d_w1, d_b1, d_w2, d_b2
+
+
+fused_candidate_delta.defvjp(_rt_fwd, _rt_bwd)

@@ -25,7 +25,7 @@ def _variables(state):
 
 
 def make_train_step(model, loss_on_s0=False, num_steps=None, detach=None,
-                    hits_ks=(), jit=True):
+                    hits_ks=(), jit=True, pair_offset=0):
     """Build a jitted ``(state, batch, key) -> (state, metrics)`` step.
 
     Args:
@@ -36,6 +36,16 @@ def make_train_step(model, loss_on_s0=False, num_steps=None, detach=None,
             the refined loss only (reference ``examples/dbp15k.py:43-46``).
         num_steps / detach: phase overrides (static).
         hits_ks: extra Hits@k metrics to report per step.
+        pair_offset: static per-pair RNG stream offset (see
+            :meth:`DGMC.__call__`) — the handle the ``--pairs-per-step``
+            equivalence test uses to make ``B=1`` reference steps draw
+            the exact noise of batched element ``pair_offset``.
+
+    The metrics dict carries ``loss`` (the scalar trained on — a masked
+    mean over every valid correspondence in the batch) and
+    ``loss_per_pair`` (``[B]``, each pair's own masked-mean NLL; for a
+    ``--pairs-per-step`` batch these match the losses of independent
+    ``B=1`` steps).
     """
 
     def train_step(state, batch, key):
@@ -47,6 +57,7 @@ def make_train_step(model, loss_on_s0=False, num_steps=None, detach=None,
             out = model.apply(
                 variables, batch.s, batch.t, y=batch.y, y_mask=batch.y_mask,
                 train=True, num_steps=num_steps, detach=detach,
+                pair_offset=pair_offset,
                 rngs={'noise': k_noise, 'negatives': k_neg,
                       'dropout': k_drop},
                 mutable=mutable)
@@ -80,6 +91,8 @@ def make_train_step(model, loss_on_s0=False, num_steps=None, detach=None,
             state = state.replace(batch_stats=new_vars['batch_stats'])
 
         out = {'loss': loss,
+               'loss_per_pair': metrics.nll_loss(S_L, batch.y, batch.y_mask,
+                                                 reduction='per_pair'),
                'acc': metrics.acc(S_L, batch.y, batch.y_mask)}
         for k in hits_ks:
             out[f'hits@{k}'] = metrics.hits_at_k(k, S_L, batch.y,
@@ -92,7 +105,7 @@ def make_train_step(model, loss_on_s0=False, num_steps=None, detach=None,
 
 
 def make_eval_step(model, hits_ks=(1,), num_steps=None, detach=None,
-                   jit=True):
+                   jit=True, pair_offset=0):
     """Build a jitted ``(state, batch, key) -> metrics`` evaluation step.
 
     Metrics come back as *sums* plus the valid-correspondence count so
@@ -105,7 +118,8 @@ def make_eval_step(model, hits_ks=(1,), num_steps=None, detach=None,
     def eval_step(state, batch, key):
         S_0, S_L = model.apply(
             _variables(state), batch.s, batch.t, train=False,
-            num_steps=num_steps, detach=detach, rngs={'noise': key})
+            num_steps=num_steps, detach=detach, pair_offset=pair_offset,
+            rngs={'noise': key})
         out = {'count': jnp.sum(batch.y_mask),
                'correct': metrics.acc(S_L, batch.y, batch.y_mask,
                                       reduction='sum')}

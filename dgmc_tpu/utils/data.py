@@ -233,8 +233,22 @@ jax.tree_util.register_pytree_node(
 
 
 def pad_pair_batch(pairs: List[GraphPair], num_nodes_s, num_edges_s,
-                   num_nodes_t=None, num_edges_t=None, native: str = 'auto'):
-    """Collate :class:`GraphPair` lists into a :class:`PairBatch`."""
+                   num_nodes_t=None, num_edges_t=None, native: str = 'auto',
+                   pairs_per_step: int = 1):
+    """Collate :class:`GraphPair` lists into a :class:`PairBatch`.
+
+    ``pairs_per_step > 1`` tiles the pair list that many times along the
+    batch axis (``B = len(pairs) * pairs_per_step``) — the collation
+    half of the ``--pairs-per-step`` batched hot loop. For single-pair
+    workloads (DBP15K trains ONE huge pair) the replicas are the same
+    graphs but draw independent per-pair indicator noise and negative
+    samples on device (``DGMC`` folds its RNG streams per batch
+    element), so one step averages ``pairs_per_step`` independent
+    gradient samples while the MXU sees a real batch axis instead of
+    B=1.
+    """
+    if pairs_per_step > 1:
+        pairs = list(pairs) * pairs_per_step
     num_nodes_t = num_nodes_t or num_nodes_s
     num_edges_t = num_edges_t or num_edges_s
     # Telemetry: every distinct padding bucket is a distinct XLA program

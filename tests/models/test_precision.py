@@ -1,18 +1,30 @@
-"""Mixed-precision (bf16) policy contract tests.
+"""Mixed-precision policy contract tests.
 
-The ``dtype=jnp.bfloat16`` policy (VERDICT r3 item 3) must keep the
-matching semantics: dense and sparse(k=N) still agree (to bf16
-tolerance), correspondence logits/probabilities and parameters stay
-float32, and a training step produces finite f32 grads/params. The
-end-to-end quality evidence lives in the two-phase gate's bf16 variant
-(tests/models/test_two_phase_quality.py).
+bf16 compute / f32 accumulation is the DEFAULT policy
+(``dgmc_tpu/models/precision.py``); these tests pin its three contracts:
+
+1. **Semantics** — dense and sparse(k=N) still agree (to bf16
+   tolerance), correspondence logits/probabilities and parameters stay
+   float32, a training step produces finite f32 grads/params, and the
+   policy object routes through every consumer (models, blocked
+   aggregation, CLI flags).
+2. **f32 accumulation** — the reductions that feed logits/grads
+   (segment sums, blocked one-hot contractions, the fused kernels'
+   ``d_o_t`` reduction) accumulate in float32 even with bf16 operands.
+   The tests are built so a bf16 RUNNING SUM cannot represent the true
+   total (addends below the bf16 spacing at the accumulated magnitude):
+   an accumulation-dtype regression fails a test here, not a bench.
+3. **Tolerance** — bf16-default forward/backward matches f32 within the
+   documented bounds on dense and sparse paths. The end-to-end quality
+   evidence lives in the two-phase gate's bf16 variant
+   (tests/models/test_two_phase_quality.py).
 """
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from dgmc_tpu.models import DGMC, GIN, RelCNN
+from dgmc_tpu.models import DGMC, GIN, RelCNN, precision
 from dgmc_tpu.train import create_train_state, make_train_step
 from dgmc_tpu.utils.data import PairBatch
 from dgmc_tpu.ops.graph import GraphBatch
@@ -69,6 +81,131 @@ def test_bf16_close_to_f32():
     g = path_graph(n=N, c=C)
     (A_0, A_L), variables = run(build(dtype=None), g, g)
     (B_0, B_L), _ = run(build(dtype=BF16), g, g, variables=variables)
+    agree = np.mean(np.argmax(A_L.val, -1) == np.argmax(B_L.val, -1))
+    assert agree == 1.0, agree
+
+
+def test_policy_object():
+    bf16 = precision.get('bf16')
+    assert bf16.compute_dtype == jnp.bfloat16
+    assert bf16.gather_dtype == 'bfloat16'
+    assert bf16.is_mixed
+    f32 = precision.get('f32')
+    assert f32.compute_dtype is None and f32.gather_dtype is None
+    assert precision.get(None) is precision.F32
+    assert precision.get(bf16) is bf16
+    assert precision.get(jnp.bfloat16).name == 'bf16'
+    # Models accept a policy wherever they accept a dtype.
+    assert precision.compute_dtype_of(bf16) == jnp.bfloat16
+    assert precision.compute_dtype_of(jnp.bfloat16) == jnp.bfloat16
+    assert precision.compute_dtype_of(None) is None
+    assert precision.gather_dtype_of(bf16) == 'bfloat16'
+    assert precision.gather_dtype_of('bfloat16') == 'bfloat16'
+    assert precision.gather_dtype_of(None) is None
+
+
+def test_policy_cli_flags():
+    """bf16 is the default on the shared CLI flags; --f32 is the opt-out
+    and --bf16 the legacy alias."""
+    import argparse
+    for argv, want in (([], 'bf16'), (['--f32'], 'f32'),
+                       (['--bf16'], 'bf16'),
+                       (['--precision', 'f32'], 'f32')):
+        parser = argparse.ArgumentParser()
+        precision.add_precision_args(parser)
+        args = parser.parse_args(argv)
+        assert precision.from_args(args).name == want, (argv, want)
+
+
+def test_policy_accepted_by_models():
+    """A Precision object in a module's dtype field behaves exactly like
+    the raw compute dtype."""
+    g = path_graph(n=N, c=C)
+    pol = precision.get('bf16')
+    (A_0, A_L), variables = run(build(dtype=BF16), g, g)
+    (B_0, B_L), _ = run(build(dtype=pol), g, g, variables=variables)
+    np.testing.assert_array_equal(np.asarray(A_L.val), np.asarray(B_L.val))
+
+
+def _one_hot_sum_graph(e=1024, n=4, c=8):
+    """All ``e`` edges point at node 0 with message 0.5: the true sum is
+    e/2, unreachable by a bf16 running sum (0.5 is below the bf16
+    spacing of 2.0 once the accumulator passes 256)."""
+    return GraphBatch(
+        x=np.full((1, n, c), 0.5, np.float32),
+        senders=np.zeros((1, e), np.int32),
+        receivers=np.zeros((1, e), np.int32),
+        node_mask=np.ones((1, n), bool),
+        edge_mask=np.ones((1, e), bool), edge_attr=None)
+
+
+def test_segment_sum_accumulates_f32_under_bf16():
+    """scatter_to_nodes with bf16 messages must reach the exact total —
+    a bf16 running sum stalls at 256 and fails this."""
+    from dgmc_tpu.ops.graph import scatter_to_nodes
+    g = _one_hot_sum_graph()
+    msgs = jnp.full((1, 1024, 8), 0.5, BF16)
+    out = scatter_to_nodes(msgs, g.receivers, g.edge_mask, 4, aggr='sum')
+    np.testing.assert_array_equal(np.asarray(out[0, 0], np.float32),
+                                  np.full(8, 512.0, np.float32))
+
+
+def test_blocked_aggregation_accumulates_f32_under_bf16():
+    """The blocked one-hot contraction (ops/blocked.py) under
+    gather_dtype='bfloat16' keeps the f32-accumulation contract: wide
+    bf16 rows (>= 512 B, so the narrow-row guard does NOT upcast) summed
+    past the bf16 stall point."""
+    from dgmc_tpu.ops.blocked import adj_matmul, attach_blocks
+    c = 256  # 512-byte bf16 rows: stays bf16 through the gather
+    e, n = 2048, 1500  # >= min_nodes so attach_blocks engages
+    g = GraphBatch(
+        x=np.full((1, n, c), 0.5, np.float32),
+        senders=np.zeros((1, e), np.int32),
+        receivers=np.zeros((1, e), np.int32),
+        node_mask=np.ones((1, n), bool),
+        edge_mask=np.ones((1, e), bool), edge_attr=None)
+    g = attach_blocks(g, gather_dtype=precision.get('bf16'))
+    assert g.blocks_in is not None
+    assert g.blocks_in.gather_dtype == 'bfloat16'
+    out = adj_matmul(jnp.asarray(g.x), g.blocks_in, g.blocks_out)
+    assert out.dtype == jnp.float32
+    np.testing.assert_array_equal(np.asarray(out[0, 0]),
+                                  np.full(c, 1024.0, np.float32))
+
+
+def test_fused_kernel_d_o_t_accumulates_f32():
+    """The widened round-trip kernel's backward reduces 2048 candidate
+    cotangents of 0.5 into one target row: exactly -1024 under the f32
+    contract; a bf16 running sum would stall at -256."""
+    from dgmc_tpu.ops.pallas.sparse_consensus import fused_candidate_delta
+    R, N_s = 8, 2048
+    o_s = jnp.zeros((1, N_s, R), BF16)
+    o_t = jnp.zeros((1, 4, R), BF16)
+    S_idx = jnp.zeros((1, N_s, 1), jnp.int32)
+    w1 = jnp.eye(R, dtype=BF16)
+    b1 = jnp.ones((R,), BF16)          # pre-activation 1 > 0 everywhere
+    w2 = jnp.ones((R, 1), BF16)
+    b2 = jnp.zeros((1,), BF16)
+
+    d_o_t = jax.grad(
+        lambda t: 0.5 * jnp.sum(fused_candidate_delta(
+            o_s, t, S_idx, w1, b1, w2, b2, True)))(o_t)
+    # d_cand per entry = -(g * w2ᵀ) @ w1ᵀ = -0.5 per channel; 2048 of
+    # them land on target row 0.
+    np.testing.assert_array_equal(np.asarray(d_o_t[0, 0], np.float32),
+                                  np.full(R, -1024.0, np.float32))
+    np.testing.assert_array_equal(np.asarray(d_o_t[0, 1], np.float32),
+                                  np.zeros(R, np.float32))
+
+
+def test_bf16_sparse_close_to_f32():
+    """Sparse-path bf16 predictions agree with f32 on the hard
+    assignment (the dense-path twin of test_bf16_close_to_f32)."""
+    g = path_graph(n=N, c=C)
+    y = jnp.arange(N)[None]
+    (A_0, A_L), variables = run(build(k=N, dtype=None), g, g, y=y)
+    (B_0, B_L), _ = run(build(k=N, dtype=BF16), g, g, variables=variables,
+                        y=y)
     agree = np.mean(np.argmax(A_L.val, -1) == np.argmax(B_L.val, -1))
     assert agree == 1.0, agree
 

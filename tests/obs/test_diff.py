@@ -203,6 +203,36 @@ def test_mfu_missing_from_candidate_is_regression(tmp_path, capsys):
     assert diff_mod.main([b, a]) == 0
 
 
+AI_EFF = {'mfu': 0.5, 'peak_flops': 1e12, 'peak_flops_source': 'table',
+          'programs': {'train_step': {'flops': 1e9, 'bytes': 1e8,
+                                      'arith_intensity': 10.0,
+                                      'mfu': 0.5}}}
+
+
+def test_intensity_regression_gates(tmp_path, capsys):
+    a = write_run(tmp_path, 'a', efficiency=AI_EFF)
+    slid = dict(AI_EFF)
+    slid['programs'] = {'train_step': dict(AI_EFF['programs']['train_step'],
+                                           arith_intensity=4.0)}
+    b = write_run(tmp_path, 'b', efficiency=slid)
+    assert diff_mod.main([a, b]) == 1          # -60% > default 40%
+    assert 'arith_intensity' in capsys.readouterr().out
+    assert diff_mod.main([a, b, '--max-intensity-regression', '0.7']) == 0
+    # Improvement direction passes by default.
+    assert diff_mod.main([b, a]) == 0
+
+
+def test_intensity_missing_from_candidate_is_regression(tmp_path, capsys):
+    a = write_run(tmp_path, 'a', efficiency=AI_EFF)
+    no_ai = dict(AI_EFF)
+    no_ai['programs'] = {'train_step': {'flops': 1e9, 'mfu': 0.5}}
+    b = write_run(tmp_path, 'b', efficiency=no_ai)
+    assert diff_mod.main([a, b]) == 1
+    assert 'missing from candidate' in capsys.readouterr().out
+    # Baseline never had it: skip, not fail.
+    assert diff_mod.main([b, a]) == 0
+
+
 def test_skew_regression_gates(tmp_path, capsys):
     agg = {'skew': {'step_time_ratio': 1.1}}
     worse = {'skew': {'step_time_ratio': 2.2}}
