@@ -578,7 +578,19 @@ def main(argv=None):
         help='per-section deadline budget: a section exceeding SEC '
              'seconds is recorded as timed out and the run moves on, so '
              'one stuck section cannot consume the whole run (0 = off)')
+    from dgmc_tpu.resilience import add_supervisor_args
+    add_supervisor_args(parser)
     args = parser.parse_args(argv)
+    if args.supervise:
+        # Crash/hang recovery loop (dgmc_tpu/resilience/supervisor.py):
+        # the bench re-runs whole (no checkpoint), so a wedged or killed
+        # attempt is retried with backoff; repeated same-point failures
+        # degrade to the XLA fallbacks via DGMC_TPU_DISABLE_FUSED. The
+        # child is this script, not a -m module.
+        from dgmc_tpu.resilience.supervisor import supervise_cli
+        sys.exit(supervise_cli(
+            None, args, argv, ladder=('disable-fused',),
+            cmd=[sys.executable, os.path.abspath(__file__)]))
     _SECTION_TIMEOUT['seconds'] = max(0.0, args.section_timeout)
     # Bench's own handlers FIRST, then the observer: the watchdog chains
     # to whatever was installed before it, so a SIGTERM dumps

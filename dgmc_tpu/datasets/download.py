@@ -13,7 +13,10 @@ offline instructions intact.
 
 import os
 import shutil
+import sys
 import tarfile
+import time
+import urllib.error
 import urllib.request
 import zipfile
 
@@ -31,22 +34,63 @@ URLS = {
 }
 
 
-def fetch(url, dest_path, progress=True):
-    """Stream ``url`` to ``dest_path`` (atomic via .part rename)."""
+def _permanent(e):
+    """True for failures a retry cannot fix: client errors (4xx other
+    than the rate/timeout pair) and local path problems. Everything else
+    — connection resets, 5xx, DNS hiccups, timeouts — is transient."""
+    if isinstance(e, urllib.error.HTTPError):
+        return 400 <= e.code < 500 and e.code not in (408, 429)
+    if isinstance(e, urllib.error.URLError):
+        return isinstance(e.reason, (FileNotFoundError, IsADirectoryError,
+                                     NotADirectoryError, PermissionError))
+    return isinstance(e, (ValueError, FileNotFoundError))
+
+
+def fetch(url, dest_path, progress=True, retries=4, backoff_s=1.0,
+          backoff_max_s=30.0):
+    """Stream ``url`` to ``dest_path`` (atomic via .part rename).
+
+    Transient failures (resets, 5xx, timeouts) are retried up to
+    ``retries`` times with exponential backoff plus jitter
+    (``backoff_s * 2**attempt``, capped at ``backoff_max_s``, stretched
+    up to 25% — the jitter keeps a fleet of workers from re-stampeding a
+    recovering server in lockstep). Permanent failures (4xx, bad local
+    paths) and an exhausted budget raise a terminal ``RuntimeError``
+    with the manual-placement instructions. The deterministic
+    ``download-fail`` fault (``dgmc_tpu/resilience/faults.py``)
+    exercises the retry path in tests."""
+    from dgmc_tpu.resilience import faults
     os.makedirs(os.path.dirname(os.path.abspath(dest_path)), exist_ok=True)
     part = dest_path + '.part'
-    try:
-        with urllib.request.urlopen(url, timeout=60) as r, \
-                open(part, 'wb') as f:
-            shutil.copyfileobj(r, f)
-    except Exception as e:
-        if os.path.exists(part):
-            os.remove(part)
-        raise RuntimeError(
-            f'download failed for {url}: {e}; fetch it manually and place '
-            f'it per the loader instructions') from e
-    os.replace(part, dest_path)
-    return dest_path
+    attempts = max(1, retries + 1)
+    for attempt in range(attempts):
+        try:
+            if faults.consume_download_fault():
+                raise ConnectionResetError(
+                    'injected transient download failure '
+                    '(dgmc_tpu.resilience.faults)')
+            with urllib.request.urlopen(url, timeout=60) as r, \
+                    open(part, 'wb') as f:
+                shutil.copyfileobj(r, f)
+        except Exception as e:
+            if os.path.exists(part):
+                os.remove(part)
+            last_attempt = attempt == attempts - 1
+            if last_attempt or _permanent(e):
+                tried = attempt + 1
+                raise RuntimeError(
+                    f'download failed for {url} after {tried} '
+                    f'attempt(s): {e}; fetch it manually and place '
+                    f'it per the loader instructions') from e
+            delay = faults.transient_jitter(
+                min(backoff_max_s, backoff_s * (2 ** attempt)))
+            print(f'download: transient failure for {url} '
+                  f'(attempt {attempt + 1}/{attempts}: {e}); '
+                  f'retrying in {delay:.1f}s', file=sys.stderr)
+            time.sleep(delay)
+            continue
+        os.replace(part, dest_path)
+        return dest_path
 
 
 def _check_member_path(name, dest_dir):

@@ -114,6 +114,18 @@ def parse_args(argv=None):
                              '(auto-detected on TPU pods / SLURM)')
     parser.add_argument('--num_processes', type=int, default=None)
     parser.add_argument('--process_id', type=int, default=None)
+    parser.add_argument('--guard-bad-steps', '--guard_bad_steps',
+                        dest='guard_bad_steps', type=int, default=0,
+                        metavar='M',
+                        help='in-graph non-finite guardrail: a step with '
+                             'a non-finite loss/grad keeps the old '
+                             'params (skip counted); M consecutive bad '
+                             'steps roll back to the last good snapshot '
+                             'with a fresh optimizer (0 = off). See '
+                             'dgmc_tpu/resilience/guard.py')
+    from dgmc_tpu.resilience import add_fault_args, add_supervisor_args
+    add_supervisor_args(parser)
+    add_fault_args(parser)
     add_obs_flag(parser)
     add_profile_flag(parser)
     return parser.parse_args(argv)
@@ -243,6 +255,17 @@ def load_batches(args):
 
 def main(argv=None):
     args = parse_args(argv)
+    if args.supervise:
+        # Detection -> recovery loop (resilience/supervisor.py): this
+        # process becomes the jax-free monitor; the actual run executes
+        # in child processes that auto-resume via --ckpt_dir.
+        from dgmc_tpu.resilience.supervisor import supervise_cli
+        raise SystemExit(supervise_cli(
+            'dgmc_tpu.experiments.dbp15k', args, argv))
+    from dgmc_tpu.resilience import FaultPlan, RollbackGuard
+    from dgmc_tpu.resilience.faults import ledger_dir
+    plan = FaultPlan.from_args(
+        args, state_dir=ledger_dir(args.ckpt_dir, args.obs_dir))
     # Multi-host bring-up before any backend touch (no-op single-process).
     # jax.devices() then spans every host, so --model_shards can spread the
     # correspondence activations across hosts' chips over DCN/ICI.
@@ -281,10 +304,19 @@ def main(argv=None):
 
     state = create_train_state(model, jax.random.key(args.seed), train_batch,
                                learning_rate=args.lr)
+    guard = args.guard_bad_steps > 0
+    if guard:
+        # Counters ride the state pytree (and its checkpoints), so the
+        # skip ledger survives supervised restarts.
+        from dgmc_tpu.train import with_guard_counters
+        state = with_guard_counters(state)
     # Phase 1: feature matching only. Phase 2: refinement with psi_1 frozen
     # by stop_gradient — the reference's detach=True (dbp15k.py:67-68).
-    phase1 = make_train_step(model, num_steps=0)
-    phase2 = make_train_step(model, num_steps=args.num_steps, detach=True)
+    phase1 = make_train_step(model, num_steps=0, guard=guard,
+                             fault_nan_step=plan.nan_grads_step)
+    phase2 = make_train_step(model, num_steps=args.num_steps, detach=True,
+                             guard=guard,
+                             fault_nan_step=plan.nan_grads_step)
     eval1 = make_eval_step(model, hits_ks=(10,), num_steps=0)
     eval2 = make_eval_step(model, hits_ks=(10,), num_steps=args.num_steps)
 
@@ -307,6 +339,8 @@ def main(argv=None):
     # and `python -m dgmc_tpu.obs.aggregate <obs-dir>` merges them.
     obs = RunObserver(host_obs_dir(args.obs_dir), probes=args.probes,
                       watchdog_deadline_s=args.watchdog_deadline)
+    guard_mon = RollbackGuard(args.guard_bad_steps, obs=obs) \
+        if guard else None
     # Cost/MFU attribution for both phase programs (one extra trace
     # each, no extra XLA compile): the refinement step is the headline
     # 'train_step'; phase 1 keeps its own row.
@@ -332,6 +366,10 @@ def main(argv=None):
             continue
         if epoch == args.phase1_epochs + 1 and is_coordinator():
             print('Refine correspondence matrix...')
+        # Armed host-side faults (raise/sigterm/sigkill/stall) fire here
+        # — on EXECUTED epochs only, and once across supervised restarts
+        # (the ledger in ckpt/obs dir survives the kill).
+        plan.before_step(epoch)
         step = phase2 if refine else phase1
         with trace(args.profile if epoch == profile_epoch else None), \
                 obs.compile_label(f'phase{2 if refine else 1}'):
@@ -353,7 +391,11 @@ def main(argv=None):
             # One batched fetch for loss + all eval metrics. This also
             # drains every epoch queued since the last print, so the
             # reported time is the average over that span.
-            host = jax.device_get({'loss': out['loss'], **ev})
+            fetch = {'loss': out['loss'], **ev}
+            if guard_mon is not None:
+                fetch['skip_count'] = out['skip_count']
+                fetch['consec_bad'] = out['consec_bad']
+            host = jax.device_get(fetch)
             span = epoch - last_print_epoch
             per_epoch = (time.time() - t_span) / max(span, 1)
             last_print_epoch, t_span = epoch, time.time()
@@ -361,19 +403,35 @@ def main(argv=None):
             n = max(float(host['count']), 1.0)
             hits1 = float(host['correct']) / n
             hits10 = float(host['hits@10']) / n
+            guard_metrics = {}
+            if guard_mon is not None:
+                guard_metrics = {
+                    'skipped_steps': int(host['skip_count']),
+                    'consec_bad': int(host['consec_bad'])}
+                if int(host['consec_bad']) == 0 and np.isfinite(loss):
+                    guard_mon.note_good(state, step=epoch)
+                else:
+                    state, rolled = guard_mon.maybe_rollback(
+                        state, host['consec_bad'], step=epoch)
+                    if rolled and is_coordinator():
+                        logger.log(epoch, event='rollback',
+                                   rollbacks=guard_mon.rollbacks)
             if is_coordinator():
                 print(f'{epoch:03d}: Loss: {loss:.4f}, '
                       f'Hits@1: {hits1:.4f}, '
                       f'Hits@10: {hits10:.4f} '
                       f'({per_epoch:.1f}s/epoch)')
             logger.log(epoch, loss=loss, hits1=hits1, hits10=hits10,
-                       phase=2 if refine else 1)
+                       phase=2 if refine else 1, **guard_metrics)
             obs.log(epoch, loss=loss, hits1=hits1, hits10=hits10,
                     phase=2 if refine else 1,
-                    epoch_s=round(per_epoch, 3))
+                    epoch_s=round(per_epoch, 3), **guard_metrics)
             obs.snapshot_memory(f'epoch{epoch}')
         if ckpt and (epoch % args.ckpt_every == 0 or epoch == args.epochs):
             ckpt.save(epoch, state)
+            # Armed ckpt-truncate/ckpt-corrupt faults damage the step
+            # that was just committed (waits out the async save).
+            plan.after_checkpoint(ckpt, epoch)
     if ckpt:
         ckpt.close()
     prof.close()

@@ -58,6 +58,8 @@ def parse_args(argv=None):
     parser.add_argument('--process_id', type=int, default=None)
     from dgmc_tpu.models.precision import add_precision_args
     add_precision_args(parser)
+    from dgmc_tpu.resilience import add_supervisor_args
+    add_supervisor_args(parser)
     add_obs_flag(parser)
     add_profile_flag(parser)
     return parser.parse_args(argv)
@@ -65,6 +67,14 @@ def parse_args(argv=None):
 
 def main(argv=None):
     args = parse_args(argv)
+    if args.supervise:
+        # Crash/hang/preemption recovery loop (resilience/supervisor.py):
+        # restarts auto-resume via --ckpt_dir. No --model_shards here, so
+        # the ladder stops at the f32 rung.
+        from dgmc_tpu.resilience.supervisor import supervise_cli
+        raise SystemExit(supervise_cli(
+            'dgmc_tpu.experiments.pascal', args, argv,
+            ladder=('disable-fused', 'f32')))
     # Multi-host bring-up FIRST (no-op in a plain single-process launch):
     # after this, jax.devices() spans every host and one data mesh drives
     # cross-host gradient collectives (SURVEY.md §2.5's net-new backend).

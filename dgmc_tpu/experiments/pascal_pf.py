@@ -55,6 +55,8 @@ def parse_args(argv=None):
                         help='append per-epoch metrics to this JSONL file')
     from dgmc_tpu.models.precision import add_precision_args
     add_precision_args(parser)
+    from dgmc_tpu.resilience import add_supervisor_args
+    add_supervisor_args(parser)
     add_obs_flag(parser)
     add_profile_flag(parser)
     return parser.parse_args(argv)
@@ -79,6 +81,14 @@ def build(args):
 
 def main(argv=None):
     args = parse_args(argv)
+    if args.supervise:
+        # Crash/hang recovery loop (resilience/supervisor.py). This CLI
+        # has no --ckpt_dir, so a restart re-runs from scratch (the
+        # supervisor warns about it).
+        from dgmc_tpu.resilience.supervisor import supervise_cli
+        raise SystemExit(supervise_cli(
+            'dgmc_tpu.experiments.pascal_pf', args, argv,
+            ladder=('disable-fused', 'f32')))
     model, train_loader, transform = build(args)
 
     batch0 = next(iter(train_loader))

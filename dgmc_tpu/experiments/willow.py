@@ -73,6 +73,8 @@ def parse_args(argv=None):
                              'JSONL file')
     from dgmc_tpu.models.precision import add_precision_args
     add_precision_args(parser)
+    from dgmc_tpu.resilience import add_supervisor_args
+    add_supervisor_args(parser)
     add_obs_flag(parser)
     add_profile_flag(parser)
     return parser.parse_args(argv)
@@ -80,6 +82,13 @@ def parse_args(argv=None):
 
 def main(argv=None):
     args = parse_args(argv)
+    if args.supervise:
+        # Crash/hang/preemption recovery loop (resilience/supervisor.py):
+        # restarts resume at the next unfinished run via --ckpt_dir.
+        from dgmc_tpu.resilience.supervisor import supervise_cli
+        raise SystemExit(supervise_cli(
+            'dgmc_tpu.experiments.willow', args, argv,
+            ladder=('disable-fused', 'f32')))
     from dgmc_tpu.datasets import (PascalVOCKeypoints, VGG16Features,
                                    WILLOWObjectClass)
     from dgmc_tpu.datasets.pascal_voc import CATEGORIES as VOC_CATEGORIES

@@ -34,6 +34,11 @@ regression thresholds:
   never diff as "fewer metrics, pass" (the MULTICHIP rc:124 failure
   mode). Both-hung compares the rest and notes it; baseline-only-hung
   is the fix, not a regression.
+- **restarts** — a supervised candidate (``recovery.json``, see
+  ``dgmc_tpu.resilience.supervisor``) that needed more restarts than the
+  baseline plus ``--max-restarts-regression`` fails — a newly flaky path
+  is a regression even when the final attempt's metrics look fine — and
+  a candidate whose supervisor **gave up** fails unconditionally.
 - **MFU** — relative decrease of the headline MFU
   (``efficiency.json``) above ``--max-mfu-regression`` fails, as does
   an MFU the baseline had but the candidate lost.
@@ -69,6 +74,7 @@ DEFAULT_THRESHOLDS = {
     'mfu': 0.25,
     'intensity': 0.40,
     'skew': 0.50,
+    'restarts': 0,
 }
 
 
@@ -148,6 +154,33 @@ def diff_runs(a, b, thresholds=None, allow_kernel_fallback=False):
     elif ha is not None:
         rows.append(_row('hang_report', ha.get('reason'), 'absent', None,
                          None, 'ok', 'baseline hung; candidate did not'))
+
+    # -- supervised-run recovery ------------------------------------------
+    # A candidate that needed MORE restarts than the baseline (plus the
+    # allowed slack) is a newly flaky path even when its final attempt's
+    # metrics look fine; a candidate whose supervisor gave up failed
+    # outright, whatever the surviving artifacts say. An unsupervised
+    # baseline counts as 0 restarts; an unsupervised candidate skips the
+    # row (supervision is opt-in — absence is not evidence).
+    ra = a.get('recovery') or {}
+    rb = b.get('recovery')
+    if rb is not None:
+        if rb.get('outcome') == 'gave-up':
+            rows.append(_row('recovery', ra.get('outcome') or 'absent',
+                             'gave-up', None, None, 'REGRESSION',
+                             'candidate supervisor exhausted its '
+                             'restart budget'))
+        base_r = ra.get('restarts', 0)
+        cand_r = rb.get('restarts', 0)
+        extra = cand_r - base_r
+        gate('restarts', base_r, cand_r, extra, thr['restarts'],
+             extra > thr['restarts'],
+             ('degraded: ' + ','.join(rb['degradations'])
+              if rb.get('degradations') else ''))
+    elif ra:
+        rows.append(_row('restarts', ra.get('restarts', 0), None, None,
+                         thr['restarts'], 'skipped',
+                         'candidate unsupervised'))
 
     # -- MFU --------------------------------------------------------------
     # Asymmetric like the timings: efficiency the baseline accounted for
@@ -343,6 +376,14 @@ def main(argv=None):
                         help='allowed fractional increase of the device '
                              'step-time skew ratio (aggregate.json; '
                              'default %(default)s)')
+    parser.add_argument('--max-restarts-regression', type=int,
+                        default=DEFAULT_THRESHOLDS['restarts'],
+                        metavar='N',
+                        help='allowed extra supervisor restarts in the '
+                             'candidate over the baseline '
+                             '(recovery.json; a candidate whose '
+                             'supervisor gave up fails unconditionally; '
+                             'default %(default)s)')
     parser.add_argument('--allow-kernel-fallback', action='store_true',
                         help='downgrade pallas->fallback dispatch changes '
                              'from regression to note')
@@ -373,6 +414,7 @@ def main(argv=None):
             'mfu': args.max_mfu_regression,
             'intensity': args.max_intensity_regression,
             'skew': args.max_skew_regression,
+            'restarts': args.max_restarts_regression,
         },
         allow_kernel_fallback=args.allow_kernel_fallback)
 

@@ -47,6 +47,23 @@ def _read_jsonl(path):
     return recs
 
 
+#: Artifacts written AT a root dir by their tools (specimen-merged
+#: efficiency.json, aggregate.json, recovery.json) that must outrank the
+#: subdir's copies when a root loads as one of its subruns.
+_ROOT_ARTIFACTS = ('recovery', 'aggregate', 'efficiency')
+
+
+def _load_as_subrun(run, root_path, subdir):
+    """Load ``subdir`` as the run while keeping ``root_path`` as its
+    identity and the root-level :data:`_ROOT_ARTIFACTS` on top."""
+    root = {k: run[k] for k in _ROOT_ARTIFACTS}
+    sub = load_run(os.path.join(root_path, subdir))
+    sub['path'] = root_path
+    for k in _ROOT_ARTIFACTS:
+        sub[k] = root[k] or sub.get(k)
+    return sub
+
+
 def load_run(path):
     """Load one obs dir (or one bare JSONL file) into a run dict.
 
@@ -54,6 +71,14 @@ def load_run(path):
     subdirectories — see :mod:`dgmc_tpu.obs.aggregate`) loads as its
     ``host_0`` run, tagged with ``multi_host`` and the root's
     ``aggregate.json`` so summaries still carry the cross-host skew.
+
+    A supervised root (``recovery.json`` + ``attempt_<k>/`` subdirs —
+    see :mod:`dgmc_tpu.resilience.supervisor`) loads as its LAST
+    attempt's run, tagged with ``recovery``/``attempts``: the final
+    attempt is the run's outcome, and earlier attempts' telemetry
+    (including their hang reports) is recovery *history* the timeline
+    renders, not the final state — a supervised run whose last attempt
+    completed clean must not diff as hung.
     """
     if os.path.isdir(path):
         run = {
@@ -65,22 +90,27 @@ def load_run(path):
             'efficiency': _read_json(os.path.join(path, 'efficiency.json')),
             'aggregate': _read_json(os.path.join(path, 'aggregate.json')),
             'hang': _read_json(os.path.join(path, 'hang_report.json')),
+            'recovery': _read_json(os.path.join(path, 'recovery.json')),
         }
         if run['timings'] is None and not run['metrics']:
+            from dgmc_tpu.resilience.supervisor import (ATTEMPT_PREFIX,
+                                                        is_attempt_dirname)
+            attempts = sorted(
+                (d for d in os.listdir(path)
+                 if is_attempt_dirname(d)
+                 and os.path.isdir(os.path.join(path, d))),
+                key=lambda d: int(d[len(ATTEMPT_PREFIX):]))
+            if attempts:
+                run = _load_as_subrun(run, path, attempts[-1])
+                run['attempts'] = len(attempts)
+                return run
             hosts = sorted(
                 d for d in os.listdir(path)
                 if d.startswith('host_')
                 and os.path.isdir(os.path.join(path, d)))
             if hosts:
-                # Root-level artifacts outrank host_0's: aggregate.json
-                # and a specimen-merged efficiency.json are written AT
-                # the root by their tools and must survive the rebind.
-                agg, eff = run['aggregate'], run['efficiency']
-                run = load_run(os.path.join(path, hosts[0]))
-                run['path'] = path
+                run = _load_as_subrun(run, path, hosts[0])
                 run['multi_host'] = len(hosts)
-                run['aggregate'] = agg or run.get('aggregate')
-                run['efficiency'] = eff or run.get('efficiency')
                 # A hang ANYWHERE is the run's hang: the straggling
                 # non-coordinator host is precisely the evidence the
                 # per-host layout exists for, and the diff gate's
@@ -99,7 +129,7 @@ def load_run(path):
         return run
     return {'path': path, 'metrics': _read_jsonl(path), 'timings': None,
             'memory': None, 'dispatch': None, 'efficiency': None,
-            'aggregate': None, 'hang': None}
+            'aggregate': None, 'hang': None, 'recovery': None}
 
 
 def peak_memory(memory):
@@ -225,6 +255,25 @@ def summarize(run):
     if run.get('hung_hosts'):
         out['hung_hosts'] = run['hung_hosts']
 
+    rec = run.get('recovery')
+    if rec:
+        out['recovery'] = {
+            'outcome': rec.get('outcome'),
+            'restarts': rec.get('restarts', 0),
+            'degradations': [d.get('rung')
+                             for d in rec.get('degradations', [])],
+            'attempts': [
+                {'attempt': at.get('attempt'),
+                 'reason': at.get('reason'),
+                 'rc': at.get('rc'),
+                 'steps_completed': at.get('steps_completed'),
+                 'duration_s': (
+                     round(at['end_time'] - at['start_time'], 1)
+                     if at.get('end_time') and at.get('start_time')
+                     else None)}
+                for at in rec.get('attempts', [])],
+        }
+
     agg = run.get('aggregate')
     if agg and agg.get('skew'):
         out['skew'] = agg['skew']
@@ -279,6 +328,24 @@ def render(run):
                      f'{inf.get("phase")}:{inf.get("name")} '
                      f'(last completed: {h.get("last_completed")}) — '
                      f'see hang_report.json **')
+
+    if s.get('recovery'):
+        rec = s['recovery']
+        lines.append('-- recovery timeline (supervised run) --')
+        lines.append(f'  outcome          {rec.get("outcome")}   '
+                     f'restarts: {rec.get("restarts", 0)}')
+        if rec.get('degradations'):
+            lines.append('  degradations     '
+                         + ' -> '.join(rec['degradations']))
+        for at in rec.get('attempts', []):
+            dur = at.get('duration_s')
+            steps_done = at.get('steps_completed')
+            lines.append(
+                f'  attempt {at.get("attempt")}: '
+                f'{at.get("reason", "?")}'
+                + (f' after {steps_done} step(s)'
+                   if steps_done is not None else '')
+                + (f' ({dur}s)' if dur is not None else ''))
 
     steps = s.get('steps')
     lines.append('-- step timing --')

@@ -159,7 +159,11 @@ class RunObserver:
                     deadline_s=watchdog_deadline_s,
                     context_fn=self._watchdog_context,
                     signals=(DEFAULT_SIGNALS if watchdog_signals is None
-                             else watchdog_signals)).start()
+                             else watchdog_signals),
+                    # Liveness file for the out-of-process run
+                    # supervisor (resilience/supervisor.py).
+                    heartbeat_path=os.path.join(
+                        obs_dir, 'heartbeat.json')).start()
             self.snapshot_memory('start')
 
     # -- collection --------------------------------------------------------

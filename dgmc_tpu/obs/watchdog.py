@@ -99,11 +99,19 @@ class Watchdog:
             restored by :meth:`close`.
         poll_s: thread poll interval (default: ``deadline_s / 4`` clamped
             to [0.05, 1.0]).
+        heartbeat_path: when set, the watchdog thread writes a small
+            liveness file there on every poll (atomic tmp+rename):
+            ``{time, pid, last_event, in_flight, steps_completed}``. An
+            OUT-of-process monitor (the run supervisor,
+            ``dgmc_tpu/resilience/supervisor.py``) watches its age — a
+            process too wedged to run even this thread goes stale and
+            gets killed, the layer below the in-process deadline dump.
     """
 
     def __init__(self, report_path, deadline_s=None, context_fn=None,
-                 signals=(), poll_s=None):
+                 signals=(), poll_s=None, heartbeat_path=None):
         self.report_path = report_path
+        self.heartbeat_path = heartbeat_path
         self.deadline_s = deadline_s or None
         self._context_fn = context_fn
         self._signals = tuple(signals)
@@ -165,7 +173,11 @@ class Watchdog:
                     sig, self._on_signal)
             except ValueError:  # not the main thread
                 break
-        if self.deadline_s:
+        # First heartbeat immediately: the supervisor's staleness watch
+        # starts from the moment the file exists, so it must exist as
+        # soon as the watchdog is armed, not one poll later.
+        self._write_heartbeat()
+        if self.deadline_s or self.heartbeat_path:
             self._thread = threading.Thread(
                 target=self._watch, name='dgmc-watchdog', daemon=True)
             self._thread.start()
@@ -200,6 +212,27 @@ class Watchdog:
         except Exception:
             pass
 
+    def _write_heartbeat(self):
+        """Liveness file for the out-of-process supervisor (thread path
+        only; best-effort, never raises)."""
+        if not self.heartbeat_path:
+            return
+        try:
+            with self._lock:
+                payload = {
+                    'time': time.time(),
+                    'pid': os.getpid(),
+                    'last_event': self._last_event,
+                    'in_flight': dict(self._in_flight),
+                }
+            ctx = self._cached_context or {}
+            if 'steps_completed' in ctx:
+                payload['steps_completed'] = ctx['steps_completed']
+            from dgmc_tpu.utils.io import write_json_atomic
+            write_json_atomic(self.heartbeat_path, payload, quiet=True)
+        except Exception:
+            pass
+
     def _watch(self):
         while not self._stop.wait(self._poll_s):
             # Refresh the context + thread-name caches for the lock-free
@@ -211,6 +244,7 @@ class Watchdog:
                     self._cached_context = self._context_fn()
                 except Exception:
                     pass
+            self._write_heartbeat()
             if not self.deadline_s:
                 continue
             with self._lock:

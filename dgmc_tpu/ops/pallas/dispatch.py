@@ -17,13 +17,23 @@ program actually used instead of leaving it to inference from timings.
 
 import contextlib
 import contextvars
+import os
 
 import jax
 
 from dgmc_tpu.obs.registry import record_dispatch  # noqa: F401  (re-export)
 
+#: Process-wide opt-out, read once at import: the run supervisor's first
+#: degradation-ladder rung (dgmc_tpu/resilience/supervisor.py) restarts a
+#: repeatedly-failing run with ``DGMC_TPU_DISABLE_FUSED=1`` so every auto
+#: gate below (and the shard_map-embedded one) picks its XLA fallback —
+#: the same switch a human would flip to rule the Pallas paths out of a
+#: hang. Values '', '0', 'false' (any case) leave kernels on.
+_ENV_DISABLED = os.environ.get(
+    'DGMC_TPU_DISABLE_FUSED', '').strip().lower() not in ('', '0', 'false')
+
 _fused_ok = contextvars.ContextVar('dgmc_tpu_fused_kernels_ok',
-                                   default=True)
+                                   default=not _ENV_DISABLED)
 # Separate switch for kernels EMBEDDED via shard_map inside GSPMD programs
 # (parallel/topk.corr_sharded_topk): those are deliberately immune to
 # disable_fused_kernels() — the orchestrator sets that while tracing the
@@ -31,7 +41,7 @@ _fused_ok = contextvars.ContextVar('dgmc_tpu_fused_kernels_ok',
 # kernel is valid. This dedicated opt-out restores an escape hatch should
 # the shard_map Pallas path misbehave on some topology.
 _embedded_ok = contextvars.ContextVar('dgmc_tpu_embedded_kernels_ok',
-                                      default=True)
+                                      default=not _ENV_DISABLED)
 
 
 def vma_of(x):
@@ -92,7 +102,8 @@ def auto_fused(kernel, size_ok=True, size_reason='size'):
     setting record it themselves with reason ``'explicit'``.
     """
     if not fused_kernels_allowed():
-        take, reason = False, 'gspmd-silenced'
+        take, reason = False, ('env-disabled' if _ENV_DISABLED
+                               else 'gspmd-silenced')
     elif jax.default_backend() != 'tpu':
         take, reason = False, f'backend={jax.default_backend()}'
     elif not size_ok:

@@ -1,0 +1,52 @@
+"""Fault-tolerant run supervision: detection → recovery, closed-loop.
+
+The watchdog (``dgmc_tpu/obs/watchdog.py``) turned silent rc:124 deaths
+into evidence; this package acts on it, treating preemption, wedged
+collectives, non-finite steps, and torn checkpoints as routine events to
+recover from — the same stance the DGMC paper takes toward noisy initial
+correspondences (detect, correct, keep iterating):
+
+- :mod:`~dgmc_tpu.resilience.supervisor` — ``--supervise``: run the CLI
+  in a child process; kill and resume from the latest checkpoint on
+  crash/hang/preemption, with a bounded exponential-backoff restart
+  budget and a graceful-degradation ladder (disable fused Pallas
+  kernels → f32 policy → shrink the mesh). Timeline in
+  ``<obs>/recovery.json``.
+- :mod:`~dgmc_tpu.resilience.faults` — ``--inject-fault``:
+  deterministic fault injection (crash/kill/stall at step N, NaN into
+  grads, checkpoint truncation/corruption, transient download
+  failures) so every recovery path is exercised by tests.
+- :mod:`~dgmc_tpu.resilience.guard` — host-side rollback policy over
+  the in-graph non-finite guard of ``make_train_step(guard=True)``.
+
+``faults`` and ``supervisor`` are jax-free (importable anywhere, even
+while a backend is wedged); ``guard`` touches jax only when a rollback
+actually fires.
+"""
+
+from dgmc_tpu.resilience.faults import (FaultInjected, FaultPlan,
+                                        FaultSpec, add_fault_args,
+                                        arm_download_faults,
+                                        consume_download_fault,
+                                        corrupt_checkpoint, parse_spec)
+from dgmc_tpu.resilience.guard import RollbackGuard
+from dgmc_tpu.resilience.supervisor import (Supervisor,
+                                            add_supervisor_args,
+                                            strip_supervisor_args,
+                                            supervise_cli)
+
+__all__ = [
+    'FaultInjected',
+    'FaultPlan',
+    'FaultSpec',
+    'add_fault_args',
+    'arm_download_faults',
+    'consume_download_fault',
+    'corrupt_checkpoint',
+    'parse_spec',
+    'RollbackGuard',
+    'Supervisor',
+    'add_supervisor_args',
+    'strip_supervisor_args',
+    'supervise_cli',
+]

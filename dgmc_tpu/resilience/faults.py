@@ -1,0 +1,313 @@
+"""Deterministic, flag-driven fault injection.
+
+Every recovery path in this repo (supervisor restarts, watchdog hang
+reports, non-finite guard skips/rollbacks, checkpoint-corruption
+fallback, download retries) is exercised by *injected* faults rather
+than by luck — the same way the DGMC paper treats noisy initial
+correspondences as a routine input to recover from, not an anomaly.
+
+Faults are armed from the CLI (``--inject-fault SPEC``, repeatable) and
+fire at exact, reproducible points:
+
+=====================  ==================================================
+``raise@N``            raise :class:`FaultInjected` before step/epoch N
+``sigterm@N``          ``SIGTERM`` to self before step N (preemption)
+``sigkill@N``          ``SIGKILL`` to self before step N (hard crash)
+``stall@N`` /          sleep ``S`` seconds (default 3600) before step N —
+``stall@N:S``          a wedged-collective stand-in the watchdog must
+                       catch and the supervisor must kill
+``nan-grads@N``        NaN into every gradient leaf on optimizer step N
+                       (in-graph; ``make_train_step(fault_nan_step=N)``)
+``ckpt-truncate@N``    truncate the largest file of the step-N checkpoint
+                       right after it is saved
+``ckpt-corrupt@N``     flip bytes in the largest file of the step-N
+                       checkpoint right after it is saved
+``download-fail`` /    fail the next K download attempts with a transient
+``download-fail:K``    error (``datasets/download.py`` must retry past
+                       them); also armable via the
+                       ``DGMC_TPU_FAULT_DOWNLOADS=K`` env var
+=====================  ==================================================
+
+**Fire-once semantics across restarts.** A supervised run replays its
+schedule after every restart; a ``sigkill@5`` that re-fired on the
+replayed step 5 would crash-loop forever. Host-side faults therefore
+record themselves in ``<state_dir>/faults_fired.json`` the moment they
+fire (before delivering the kill), and a restarted process skips them.
+``nan-grads`` deliberately does NOT use the ledger: it is part of the
+deterministic step stream, and an interrupted-and-resumed run must
+replay it to reproduce the uninterrupted run's trajectory exactly.
+
+This module imports **no jax of its own** — faults must be armable in
+any process, including the supervisor's backend-free monitor loop.
+"""
+
+import json
+import os
+import random
+import signal
+import sys
+import time
+
+__all__ = ['FaultInjected', 'FaultSpec', 'FaultPlan', 'add_fault_args',
+           'parse_spec', 'corrupt_checkpoint', 'arm_download_faults',
+           'consume_download_fault', 'download_faults_remaining',
+           'ledger_dir']
+
+FIRED_LEDGER = 'faults_fired.json'
+
+#: Host-side fault kinds that fire in the training loop, once.
+_STEP_KINDS = ('raise', 'sigterm', 'sigkill', 'stall')
+_CKPT_KINDS = ('ckpt-truncate', 'ckpt-corrupt')
+KINDS = _STEP_KINDS + _CKPT_KINDS + ('nan-grads', 'download-fail')
+
+
+class FaultInjected(RuntimeError):
+    """The ``raise@N`` fault."""
+
+
+class FaultSpec:
+    """One parsed ``kind[@step][:arg]`` spec."""
+
+    def __init__(self, kind, step=None, arg=None):
+        self.kind = kind
+        self.step = step
+        self.arg = arg
+
+    @property
+    def key(self):
+        return f'{self.kind}@{self.step}' if self.step is not None \
+            else self.kind
+
+    def __repr__(self):
+        return f'FaultSpec({self.key}' + \
+            (f':{self.arg})' if self.arg is not None else ')')
+
+
+def parse_spec(text):
+    """``'sigkill@5'`` / ``'stall@3:20'`` / ``'download-fail:2'`` ->
+    :class:`FaultSpec`. Raises ``ValueError`` with the grammar on junk."""
+    body, arg = (text.split(':', 1) + [None])[:2]
+    kind, step = (body.split('@', 1) + [None])[:2]
+    kind = kind.strip()
+    if kind not in KINDS:
+        raise ValueError(
+            f'unknown fault kind {kind!r} in spec {text!r}; known: '
+            f'{", ".join(KINDS)} (grammar: kind@step[:arg])')
+    if kind == 'download-fail':
+        if step is not None:
+            raise ValueError(
+                f'{text!r}: download-fail takes a count (:K), not a step')
+        return FaultSpec(kind, arg=int(arg) if arg else 1)
+    if step is None:
+        raise ValueError(f'{text!r}: {kind} needs a step (e.g. {kind}@3)')
+    step = int(step)
+    if arg is not None:
+        arg = float(arg)
+    elif kind == 'stall':
+        arg = 3600.0
+    return FaultSpec(kind, step=step, arg=arg)
+
+
+def add_fault_args(parser):
+    """Register ``--inject-fault`` on an argparse parser."""
+    parser.add_argument(
+        '--inject-fault', '--inject_fault', dest='inject_fault',
+        action='append', default=[], metavar='SPEC',
+        help='deterministic fault injection (repeatable): raise@N, '
+             'sigterm@N, sigkill@N, stall@N[:SEC], nan-grads@N, '
+             'ckpt-truncate@N, ckpt-corrupt@N, download-fail[:K]. '
+             'Process-killing faults fire ONCE across supervised '
+             'restarts (ledger in the checkpoint/obs dir); nan-grads '
+             'replays deterministically. See '
+             'dgmc_tpu/resilience/faults.py.')
+    return parser
+
+
+LEDGER_ENV = 'DGMC_TPU_FAULT_LEDGER_DIR'
+
+
+def ledger_dir(ckpt_dir, obs_dir):
+    """Where the fire-once ledger should live: the checkpoint dir, else
+    the obs ROOT — a supervised child's ``--obs-dir`` is rewritten to
+    ``<root>/attempt_<k>`` per attempt, and a ledger inside one attempt
+    would be invisible to the next (faults would re-fire forever) —
+    else :data:`LEDGER_ENV`, which the supervisor exports to every
+    child so a run with NEITHER flag still gets fire-once semantics
+    (a re-firing ``sigkill@N`` would otherwise crash-loop the whole
+    restart budget away)."""
+    if ckpt_dir:
+        return ckpt_dir
+    if not obs_dir:
+        return os.environ.get(LEDGER_ENV) or None
+    from dgmc_tpu.resilience.supervisor import is_attempt_dirname
+    base = os.path.basename(os.path.normpath(obs_dir))
+    if is_attempt_dirname(base):
+        return os.path.dirname(os.path.normpath(obs_dir))
+    return obs_dir
+
+
+class FaultPlan:
+    """The armed faults of one run, with the fire-once ledger.
+
+    Args:
+        specs: iterable of spec strings (or :class:`FaultSpec`).
+        state_dir: where ``faults_fired.json`` lives — pass the
+            checkpoint dir (survives supervised restarts) or the obs
+            ROOT dir. ``None`` disables the ledger (every fault can
+            re-fire; fine for single-shot tests).
+    """
+
+    def __init__(self, specs=(), state_dir=None):
+        self.specs = [s if isinstance(s, FaultSpec) else parse_spec(s)
+                      for s in (specs or ())]
+        self._state_dir = state_dir
+        self._fired = set(self._load_ledger())
+        for spec in self.specs:
+            if spec.kind == 'download-fail':
+                arm_download_faults(spec.arg)
+
+    @classmethod
+    def from_args(cls, args, state_dir=None):
+        return cls(getattr(args, 'inject_fault', ()) or (),
+                   state_dir=state_dir)
+
+    def __bool__(self):
+        return bool(self.specs)
+
+    # -- ledger ------------------------------------------------------------
+
+    def _ledger_path(self):
+        if not self._state_dir:
+            return None
+        return os.path.join(self._state_dir, FIRED_LEDGER)
+
+    def _load_ledger(self):
+        path = self._ledger_path()
+        if not path or not os.path.exists(path):
+            return []
+        try:
+            with open(path) as f:
+                return json.load(f).get('fired', [])
+        except (OSError, ValueError):
+            return []
+
+    def _mark_fired(self, spec):
+        self._fired.add(spec.key)
+        path = self._ledger_path()
+        if path:
+            from dgmc_tpu.utils.io import write_json_atomic
+            write_json_atomic(path, {'fired': sorted(self._fired)},
+                              indent=1)
+
+    # -- hooks -------------------------------------------------------------
+
+    @property
+    def nan_grads_step(self):
+        """Step for ``make_train_step(fault_nan_step=...)`` (or None)."""
+        for spec in self.specs:
+            if spec.kind == 'nan-grads':
+                return spec.step
+        return None
+
+    def before_step(self, step):
+        """Fire any armed host-side fault scheduled for ``step``
+        (1-based step/epoch counter). The ledger is written BEFORE the
+        fault delivers, so a killed-and-restarted run does not re-fire."""
+        for spec in self.specs:
+            if spec.kind not in _STEP_KINDS or spec.step != step \
+                    or spec.key in self._fired:
+                continue
+            self._mark_fired(spec)
+            print(f'[faults] firing {spec.key} at step {step}',
+                  file=sys.stderr, flush=True)
+            if spec.kind == 'raise':
+                raise FaultInjected(f'injected fault {spec.key}')
+            if spec.kind == 'stall':
+                time.sleep(spec.arg)
+            else:
+                os.kill(os.getpid(), signal.SIGTERM
+                        if spec.kind == 'sigterm' else signal.SIGKILL)
+                # SIGTERM is delivered synchronously to this thread; if
+                # a handler chain swallowed it, don't fall through as if
+                # nothing happened.
+                time.sleep(30)
+                raise FaultInjected(
+                    f'{spec.key} delivered but the process survived')
+
+    def after_checkpoint(self, ckpt, step):
+        """Corrupt the just-saved checkpoint when a ``ckpt-*@step`` fault
+        is armed. ``ckpt`` is a
+        :class:`~dgmc_tpu.train.checkpoint.Checkpointer` (the save may be
+        async; corruption waits for the commit)."""
+        for spec in self.specs:
+            if spec.kind not in _CKPT_KINDS or spec.step != step \
+                    or spec.key in self._fired:
+                continue
+            ckpt.wait_until_finished()
+            target = corrupt_checkpoint(
+                ckpt.directory, step,
+                mode='truncate' if spec.kind == 'ckpt-truncate'
+                else 'corrupt')
+            self._mark_fired(spec)
+            print(f'[faults] {spec.key}: damaged {target}',
+                  file=sys.stderr, flush=True)
+
+
+def corrupt_checkpoint(directory, step, mode='corrupt'):
+    """Damage the largest file of checkpoint ``step`` under ``directory``
+    (truncate to half, or overwrite a span with flipped bytes). Returns
+    the damaged path. The step's manifest is left intact on purpose:
+    verification catching the damage IS the recovery path under test."""
+    step_dir = os.path.join(directory, str(step))
+    if not os.path.isdir(step_dir):
+        raise FileNotFoundError(f'no checkpoint step dir {step_dir}')
+    largest, size = None, -1
+    for root, _dirs, files in os.walk(step_dir):
+        for name in files:
+            p = os.path.join(root, name)
+            s = os.path.getsize(p)
+            if s > size:
+                largest, size = p, s
+    if largest is None:
+        raise FileNotFoundError(f'checkpoint step dir {step_dir} is empty')
+    if mode == 'truncate':
+        with open(largest, 'r+b') as f:
+            f.truncate(max(1, size // 2))
+    else:
+        with open(largest, 'r+b') as f:
+            span = min(64, size)
+            head = f.read(span)
+            f.seek(0)
+            f.write(bytes(b ^ 0xFF for b in head))
+    return largest
+
+
+# -- transient-download faults (module-level: datasets/download.py pulls
+# from here lazily, and subprocess tests arm it via the env var) ---------
+
+_DOWNLOAD_FAULTS = {'remaining': int(
+    os.environ.get('DGMC_TPU_FAULT_DOWNLOADS', '0') or 0)}
+
+
+def arm_download_faults(n):
+    """The next ``n`` download attempts fail with a transient error."""
+    _DOWNLOAD_FAULTS['remaining'] = int(n)
+
+
+def download_faults_remaining():
+    return _DOWNLOAD_FAULTS['remaining']
+
+
+def consume_download_fault():
+    """True if this download attempt must fail (decrements the budget)."""
+    if _DOWNLOAD_FAULTS['remaining'] > 0:
+        _DOWNLOAD_FAULTS['remaining'] -= 1
+        return True
+    return False
+
+
+def transient_jitter(base_s, jitter_frac=0.25, rng=random):
+    """Backoff jitter helper shared with :mod:`dgmc_tpu.datasets.download`:
+    ``base_s`` stretched by up to ``jitter_frac`` (never shrunk, so the
+    documented floor holds)."""
+    return base_s * (1.0 + jitter_frac * rng.random())

@@ -1,0 +1,78 @@
+"""Host-side rollback policy over the in-graph non-finite guard.
+
+``make_train_step(guard=True)`` (dgmc_tpu/train/steps.py) skips the
+optimizer update on any step whose loss or gradient norm is non-finite
+and counts skips in the :class:`~dgmc_tpu.train.state.GuardedTrainState`
+ledger — entirely in-graph, no host sync. What it cannot do in-graph is
+*rollback*: restoring the last good parameter snapshot is a host
+decision (the snapshot lives host-side precisely so a poisoned device
+state cannot taint it). :class:`RollbackGuard` is that decision,
+evaluated wherever the training loop already fetches metrics (the
+experiment CLIs fetch every print/eval boundary), so it adds zero
+device round-trips of its own.
+"""
+
+import sys
+
+__all__ = ['RollbackGuard']
+
+
+class RollbackGuard:
+    """Snapshot-on-good, rollback-after-M-consecutive-bad.
+
+    Args:
+        max_consecutive: M — rollback triggers when the in-graph
+            ``consec_bad`` counter reaches M (0 disables).
+        obs: optional :class:`~dgmc_tpu.obs.run.RunObserver`; rollbacks
+            are logged as ``event='rollback'`` metric records so the
+            recovery timeline shows them.
+    """
+
+    def __init__(self, max_consecutive, obs=None):
+        self.max_consecutive = int(max_consecutive)
+        self.obs = obs
+        self.rollbacks = 0
+        self._snapshot = None
+        self._snapshot_step = None
+
+    def note_good(self, state, step=None):
+        """Record ``state`` as the newest known-good rollback target.
+        Call after the host has CONFIRMED finite metrics for it."""
+        from dgmc_tpu.train.checkpoint import snapshot_params
+        self._snapshot = snapshot_params(state)
+        self._snapshot_step = step
+
+    def maybe_rollback(self, state, consec_bad, step=None):
+        """``(state, rolled_back)`` — restores the last good snapshot
+        (fresh optimizer, like the willow reset protocol) when
+        ``consec_bad >= M``. The ``step`` counter and the cumulative
+        ``skip_count`` ledger survive the rollback; ``consec_bad``
+        resets. Without a snapshot yet (the run went bad before its
+        first good fetch) the guarded step keeps holding params frozen,
+        which is already safe — we just report that."""
+        if not self.max_consecutive \
+                or int(consec_bad) < self.max_consecutive:
+            return state, False
+        if self._snapshot is None:
+            print('[guard] rollback wanted but no good snapshot exists '
+                  'yet; params stay frozen by the in-graph guard',
+                  file=sys.stderr, flush=True)
+            return state, False
+        import jax.numpy as jnp
+        from dgmc_tpu.train.checkpoint import restore_params
+        rolled = restore_params(state, self._snapshot)
+        rolled = rolled.replace(step=state.step)
+        if hasattr(rolled, 'consec_bad'):
+            rolled = rolled.replace(
+                skip_count=state.skip_count,
+                consec_bad=jnp.zeros((), jnp.int32))
+        self.rollbacks += 1
+        print(f'[guard] {int(consec_bad)} consecutive non-finite steps: '
+              f'rolled back to the step-{self._snapshot_step} snapshot '
+              f'(fresh optimizer)', file=sys.stderr, flush=True)
+        if self.obs is not None:
+            self.obs.log(step if step is not None else -1,
+                         event='rollback',
+                         rollback_to=self._snapshot_step,
+                         consec_bad=int(consec_bad))
+        return rolled, True

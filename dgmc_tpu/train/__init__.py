@@ -1,9 +1,12 @@
-from dgmc_tpu.train.state import (TrainState, create_train_state,
-                                  init_variables)
+from dgmc_tpu.train.state import (TrainState, GuardedTrainState,
+                                  create_train_state, init_variables,
+                                  with_guard_counters)
 from dgmc_tpu.train.steps import (make_train_step, make_eval_step,
                                   aggregate_eval)
-from dgmc_tpu.train.checkpoint import (Checkpointer, resume_or_init,
-                                       snapshot_params, restore_params)
+from dgmc_tpu.train.checkpoint import (Checkpointer, CheckpointError,
+                                       CheckpointCorruptError,
+                                       resume_or_init, snapshot_params,
+                                       restore_params)
 # Deprecated aliases: the observability layer moved to dgmc_tpu.obs
 # (which adds the registry, RunObserver and the report CLI); these names
 # stay importable so existing experiment code and runs/ tooling keep
@@ -12,12 +15,16 @@ from dgmc_tpu.obs import MetricLogger, StepTimer, trace
 
 __all__ = [
     'TrainState',
+    'GuardedTrainState',
     'create_train_state',
     'init_variables',
+    'with_guard_counters',
     'make_train_step',
     'make_eval_step',
     'aggregate_eval',
     'Checkpointer',
+    'CheckpointError',
+    'CheckpointCorruptError',
     'resume_or_init',
     'snapshot_params',
     'restore_params',

@@ -9,38 +9,237 @@ statistics), with retention and a latest-step query for resume. The willow
 protocol's snapshot/rollback becomes trivial because the functional state
 pytree *is* the snapshot — see :func:`snapshot_params` /
 :func:`restore_params`.
+
+Hardening (the fault-tolerance layer the run supervisor builds on —
+``dgmc_tpu/resilience/``): every committed step gets a **checksummed
+manifest** (sha256 + size per file, written atomically via tmp+rename
+into ``<dir>/manifests/``), :meth:`Checkpointer.verify` re-hashes a step
+against it, and :meth:`Checkpointer.restore` walks latest→oldest past
+corrupt or torn steps instead of surfacing a raw orbax traceback — a
+truncated file, a flipped byte, or a bare half-written step directory
+falls back to the previous good checkpoint with a warning.
+``restore(step=N)`` with a missing or corrupt N raises an actionable
+error (no silent fallback when the caller pinned a step).
 """
 
+import hashlib
+import json
 import os
+import sys
 from typing import Optional
 
 import jax
 
 
-class Checkpointer:
-    """Thin orbax ``CheckpointManager`` wrapper for :class:`TrainState`."""
+class CheckpointError(RuntimeError):
+    """A checkpoint could not be restored; the message says what to do."""
 
-    def __init__(self, directory, max_to_keep: Optional[int] = 3):
+
+class CheckpointCorruptError(CheckpointError):
+    """A checkpoint failed manifest verification or deserialization."""
+
+
+#: Subdirectory of the checkpoint root holding per-step manifests. Kept
+#: OUTSIDE the orbax step directories so orbax's own item discovery and
+#: retention never see an unexpected file.
+MANIFEST_DIRNAME = 'manifests'
+
+
+def _sha256(path, chunk=1 << 20):
+    h = hashlib.sha256()
+    with open(path, 'rb') as f:
+        while True:
+            b = f.read(chunk)
+            if not b:
+                break
+            h.update(b)
+    return h.hexdigest()
+
+
+def _file_table(step_dir):
+    """{relpath: {sha256, bytes}} over every regular file under a step."""
+    out = {}
+    for root, _dirs, files in os.walk(step_dir):
+        for name in sorted(files):
+            p = os.path.join(root, name)
+            rel = os.path.relpath(p, step_dir)
+            out[rel] = {'sha256': _sha256(p),
+                        'bytes': os.path.getsize(p)}
+    return out
+
+
+def _is_coordinator():
+    """Manifests are written once per run, by process 0 (the checkpoint
+    directory is a shared filesystem in multi-host runs)."""
+    try:
+        return jax.process_index() == 0
+    except Exception:
+        return True
+
+
+class Checkpointer:
+    """Thin orbax ``CheckpointManager`` wrapper for :class:`TrainState`
+    with checksummed-manifest verification and corrupt-step fallback."""
+
+    def __init__(self, directory, max_to_keep: Optional[int] = 3,
+                 verify: bool = True):
         import orbax.checkpoint as ocp
         self._ocp = ocp
         self.directory = os.path.abspath(directory)
         self._mgr = ocp.CheckpointManager(
             self.directory,
             options=ocp.CheckpointManagerOptions(max_to_keep=max_to_keep))
+        self._verify = verify
+        #: Step the most recent :meth:`restore` actually loaded (may be
+        #: older than ``latest_step()`` after a corrupt-latest fallback).
+        self.restored_step: Optional[int] = None
+        #: Tag of the ``structures`` candidate the most recent
+        #: :meth:`restore` deserialized with (``None`` for the plain
+        #: requested structure).
+        self.restored_structure = None
+
+    # -- manifests ---------------------------------------------------------
+
+    def _step_dir(self, step: int):
+        return os.path.join(self.directory, str(step))
+
+    def _manifest_path(self, step: int):
+        return os.path.join(self.directory, MANIFEST_DIRNAME,
+                            f'{step}.json')
+
+    def write_manifest(self, step: int):
+        """Hash every file of a committed step into
+        ``manifests/<step>.json`` (atomic tmp+rename)."""
+        from dgmc_tpu.utils.io import write_json_atomic
+        path = self._manifest_path(step)
+        write_json_atomic(path, {'step': int(step), 'files': _file_table(
+            self._step_dir(step))}, indent=1, sort_keys=True)
+        return path
+
+    def finalize_manifests(self):
+        """Write manifests for committed steps that lack one and drop
+        manifests whose step was retired by retention. Called after every
+        save and on close; async saves get their manifest on the next
+        call once orbax reports them committed.
+
+        The hash runs synchronously on the caller's thread — one pass
+        over each newly committed step, deliberately: a manifest that
+        lags its step is useless against a crash arriving before some
+        background writer catches up, and verification is the whole
+        point of the manifest. Pass ``verify=False`` to the
+        :class:`Checkpointer` when save latency matters more."""
+        if not (self._verify and _is_coordinator()):
+            return
+        steps = set(self.all_steps())
+        for step in steps:
+            # all_steps() lists an async save as soon as it is RECORDED,
+            # before orbax's atomic tmp->rename commits the step dir.
+            # Hashing then would pin an empty (or worse, mid-write) file
+            # table that os.path.exists below makes permanent — the
+            # manifest must wait for the rename; the next finalize (next
+            # save, wait_until_finished, or close) picks the step up.
+            if not os.path.isdir(self._step_dir(step)):
+                continue
+            mpath = self._manifest_path(step)
+            if os.path.exists(mpath):
+                # Heal empty manifests written by pre-fix versions of
+                # this race (they verify vacuously, silently disabling
+                # the hardening for that step).
+                try:
+                    with open(mpath) as f:
+                        if json.load(f).get('files'):
+                            continue
+                except (OSError, ValueError):
+                    pass  # unreadable manifest: rewrite it too
+            try:
+                self.write_manifest(step)
+            except OSError as e:
+                print(f'checkpoint: manifest for step {step} not '
+                      f'written ({e}); verification will be skipped '
+                      f'for it', file=sys.stderr)
+        mdir = os.path.join(self.directory, MANIFEST_DIRNAME)
+        if os.path.isdir(mdir):
+            for name in os.listdir(mdir):
+                base, ext = os.path.splitext(name)
+                if ext == '.json' and base.isdigit() \
+                        and int(base) not in steps:
+                    try:
+                        os.remove(os.path.join(mdir, name))
+                    except OSError:
+                        pass
+
+    def verify(self, step: int):
+        """Problems with ``step``'s on-disk files vs its manifest.
+
+        Returns a list of human-readable problem strings — empty when the
+        step matches its manifest, or when no manifest exists (an
+        unverifiable step is not evidence of corruption; restore still
+        guards it with its own try/except)."""
+        mpath = self._manifest_path(step)
+        try:
+            with open(mpath) as f:
+                manifest = json.load(f)
+        except FileNotFoundError:
+            return []
+        except (OSError, ValueError) as e:
+            return [f'manifest unreadable: {e}']
+        problems = []
+        step_dir = self._step_dir(step)
+        for rel, want in sorted(manifest.get('files', {}).items()):
+            p = os.path.join(step_dir, rel)
+            if not os.path.isfile(p):
+                problems.append(f'missing file {rel}')
+                continue
+            size = os.path.getsize(p)
+            if size != want['bytes']:
+                problems.append(
+                    f'{rel}: size {size} != manifest {want["bytes"]}')
+                continue
+            if _sha256(p) != want['sha256']:
+                problems.append(f'{rel}: sha256 mismatch')
+        return problems
+
+    # -- save / restore ----------------------------------------------------
 
     def save(self, step: int, state, wait: bool = False):
-        self._mgr.save(step, args=self._ocp.args.StandardSave(state))
+        saved = self._mgr.save(step, args=self._ocp.args.StandardSave(state))
+        if not saved and os.path.isdir(self._step_dir(step)):
+            # orbax silently refuses save(step <= latest_step) — the
+            # exact shape of a re-save after a corrupt-latest fallback
+            # (resume at N-1, re-run epoch N, save(N) over the torn
+            # step). The caller asked to persist THIS state: replace the
+            # stale step, don't drop the save on the floor.
+            self.delete_step(step)
+            saved = self._mgr.save(
+                step, args=self._ocp.args.StandardSave(state))
+            if not saved:
+                print(f'checkpoint: orbax refused to save step {step} '
+                      f'even after clearing the old one; this state is '
+                      f'NOT persisted', file=sys.stderr)
         if wait:
             self._mgr.wait_until_finished()
+        self.finalize_manifests()
 
-    def restore(self, state, step: Optional[int] = None):
-        """Restore into the structure of ``state`` (an abstract or concrete
-        :class:`TrainState` with the right shapes/dtypes)."""
-        if step is None:
-            step = self.latest_step()
-        if step is None:
-            raise FileNotFoundError(
-                f'no checkpoint found under {self.directory}')
+    def delete_step(self, step: int):
+        """Remove a step and its manifest (clears a corrupt or stale
+        step so the same step number can be saved again)."""
+        try:
+            self._mgr.delete(step)
+        except Exception:
+            import shutil
+            shutil.rmtree(self._step_dir(step), ignore_errors=True)
+        try:
+            os.remove(self._manifest_path(step))
+        except OSError:
+            pass
+
+    def wait_until_finished(self):
+        """Block until any in-flight async save is committed, then
+        finalize its manifest."""
+        self._mgr.wait_until_finished()
+        self.finalize_manifests()
+
+    def _restore_one(self, step: int, state):
         abstract = jax.tree.map(
             lambda x: jax.ShapeDtypeStruct(
                 x.shape, x.dtype, sharding=getattr(x, 'sharding', None))
@@ -48,31 +247,171 @@ class Checkpointer:
         return self._mgr.restore(
             step, args=self._ocp.args.StandardRestore(abstract))
 
+    def restore(self, state, step: Optional[int] = None,
+                fallback: Optional[bool] = None, structures=None):
+        """Restore into the structure of ``state`` (an abstract or
+        concrete :class:`TrainState` with the right shapes/dtypes).
+
+        Without ``step``, tries the latest checkpoint and — unless
+        ``fallback=False`` — walks back through older ones past any that
+        fail manifest verification or deserialization (truncated or
+        corrupt files, half-written step directories), warning per
+        skipped step. With an explicit ``step``, a missing step raises
+        :class:`FileNotFoundError` naming the available steps and a
+        corrupt one raises :class:`CheckpointCorruptError`; fallback is
+        off unless requested (``fallback=True`` walks back from ``N``
+        through the older steps). The step actually loaded lands in
+        :attr:`restored_step`.
+
+        ``structures``: optional ordered ``(tag, candidate_state)``
+        alternatives deserialized in turn at each step — manifest
+        verification runs once per step, then every candidate structure
+        is tried before the step is declared unrestorable. The winning
+        tag lands in :attr:`restored_structure` (``None`` for the plain
+        ``state``). :func:`resume_or_init` uses this for the
+        ``--guard-bad-steps`` structure toggle."""
+        steps = self.all_steps()
+        if step is not None:
+            if step not in steps:
+                raise FileNotFoundError(
+                    f'no checkpoint for step {step} under '
+                    f'{self.directory}; available steps: '
+                    f'{steps or "none"} (pass step=None to resume from '
+                    f'the latest)')
+            fallback = bool(fallback)
+            candidates = [s for s in sorted(steps, reverse=True)
+                          if s <= step] if fallback else [step]
+        else:
+            if not steps:
+                raise FileNotFoundError(
+                    f'no checkpoint found under {self.directory}')
+            candidates = sorted(steps, reverse=True)
+            fallback = True if fallback is None else fallback
+        structures = structures or ((None, state),)
+        failures = []
+        for s in candidates:
+            problems = self.verify(s) if self._verify else []
+            if problems:
+                failures.append(f'step {s}: {"; ".join(problems)}')
+                if not fallback:
+                    raise CheckpointCorruptError(
+                        f'checkpoint step {s} under {self.directory} '
+                        f'failed verification: {"; ".join(problems)}. '
+                        f'Pick another step ({steps}) or delete the '
+                        f'corrupt one.')
+                print(f'checkpoint: step {s} failed verification '
+                      f'({"; ".join(problems)}); falling back to the '
+                      f'previous checkpoint', file=sys.stderr)
+                continue
+            restored, last_exc, errs = None, None, []
+            for tag, cand in structures:
+                try:
+                    restored = self._restore_one(s, cand)
+                    self.restored_structure = tag
+                    break
+                except Exception as e:  # torn/alien step dirs raise deep
+                    last_exc = e
+                    errs.append(f'{type(e).__name__}: {e}')
+            if restored is None:
+                detail = '; '.join(errs)
+                failures.append(f'step {s}: {detail}')
+                if not fallback:
+                    raise CheckpointCorruptError(
+                        f'checkpoint step {s} under {self.directory} '
+                        f'could not be restored ({detail}). Pick another '
+                        f'step ({steps}) or delete the broken one.'
+                    ) from last_exc
+                print(f'checkpoint: step {s} failed to restore '
+                      f'({detail}); falling back to the previous '
+                      f'checkpoint', file=sys.stderr)
+                continue
+            self.restored_step = s
+            return restored
+        raise CheckpointCorruptError(
+            f'every checkpoint under {self.directory} failed to restore:'
+            f'\n  ' + '\n  '.join(failures) +
+            f'\nDelete {self.directory} to start fresh, or repair/replace '
+            f'a step directory and retry.')
+
     def latest_step(self) -> Optional[int]:
         return self._mgr.latest_step()
 
+    def all_steps(self):
+        return sorted(self._mgr.all_steps())
+
     def close(self):
         self._mgr.wait_until_finished()
+        self.finalize_manifests()
         self._mgr.close()
+
+
+def _toggle_guard_structure(state):
+    """The alternate checkpoint structure for a ``--guard-bad-steps``
+    toggle: guard counters stripped from a
+    :class:`~dgmc_tpu.train.state.GuardedTrainState`, or zeroed counters
+    added to a plain :class:`~dgmc_tpu.train.state.TrainState`."""
+    from dgmc_tpu.train.state import (GuardedTrainState, TrainState,
+                                      with_guard_counters)
+    if isinstance(state, GuardedTrainState):
+        return TrainState(
+            step=state.step, apply_fn=state.apply_fn, params=state.params,
+            tx=state.tx, opt_state=state.opt_state,
+            batch_stats=state.batch_stats)
+    return with_guard_counters(state)
 
 
 def resume_or_init(ckpt_dir, state):
     """Shared workload resume glue: open a :class:`Checkpointer` under
     ``ckpt_dir`` (``None`` -> no checkpointing) and restore the latest saved
-    state if one exists.
+    state if one exists — falling back past corrupt/torn checkpoints (see
+    :meth:`Checkpointer.restore`).
 
     Returns ``(ckpt, state, start_epoch)`` where ``start_epoch`` is the
-    first epoch still to run (1 for a fresh start).
+    first epoch still to run (1 for a fresh start). An empty or absent
+    directory is a fresh start; a directory where every checkpoint is
+    corrupt raises :class:`CheckpointCorruptError` with instructions
+    rather than silently retraining from scratch.
+
+    Toggling ``--guard-bad-steps`` between runs changes the state PYTREE
+    STRUCTURE (``TrainState`` <-> ``GuardedTrainState``), and a structure
+    mismatch fails deserialization exactly like corruption — so each
+    step is tried with BOTH structures (newest step first, requested
+    structure first) and a toggled restore is converted to the requested
+    one (counters start fresh when the checkpoint predates the guard;
+    the skip ledger is dropped when the guard was turned off). The walk
+    is per-step rather than a whole-directory retry so retention holding
+    a mix of both structures still resumes from the NEWEST restorable
+    step instead of silently sliding back to an older same-structure
+    one.
     """
     if not ckpt_dir:
         return None, state, 1
     ckpt = Checkpointer(ckpt_dir)
-    latest = ckpt.latest_step()
-    if latest is None:
+    steps = ckpt.all_steps()
+    if not steps:
         return ckpt, state, 1
-    state = ckpt.restore(state, latest)
-    print(f'Resumed from {ckpt.directory} at epoch {latest}.')
-    return ckpt, state, latest + 1
+    from dgmc_tpu.train.state import GuardedTrainState, with_guard_counters
+    restored = ckpt.restore(
+        state,
+        structures=((None, state),
+                    ('toggled-guard', _toggle_guard_structure(state))))
+    step = ckpt.restored_step
+    if ckpt.restored_structure == 'toggled-guard':
+        if isinstance(state, GuardedTrainState):
+            restored = with_guard_counters(restored)
+            why = 'written without guard counters; counters start at 0'
+        else:
+            restored = _toggle_guard_structure(restored)
+            why = ('written with guard counters; the skip ledger is '
+                   'dropped')
+        print(f'checkpoint: step {step} under {ckpt.directory} was '
+              f'{why} (--guard-bad-steps toggled between runs)',
+              file=sys.stderr)
+    state = restored
+    note = '' if step == steps[-1] else \
+        f' (latest step {steps[-1]} was unrestorable)'
+    print(f'Resumed from {ckpt.directory} at epoch {step}.{note}')
+    return ckpt, state, step + 1
 
 
 def snapshot_params(state):

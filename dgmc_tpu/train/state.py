@@ -23,6 +23,29 @@ class TrainState(train_state.TrainState):
     batch_stats: Any = struct.field(default_factory=dict)
 
 
+class GuardedTrainState(TrainState):
+    """:class:`TrainState` extended with the non-finite-guard ledger
+    (``make_train_step(guard=True)`` — dgmc_tpu/train/steps.py): how many
+    optimizer updates were skipped for a non-finite loss/grad, and how
+    many of those skips are consecutive right now (the host-side rollback
+    trigger, :class:`dgmc_tpu.resilience.RollbackGuard`)."""
+    skip_count: Any = 0
+    consec_bad: Any = 0
+
+
+def with_guard_counters(state):
+    """Upgrade a :class:`TrainState` to a :class:`GuardedTrainState` with
+    device-resident int32 counters (concrete arrays, not weak Python
+    ints, so the jitted step signature is stable across restores)."""
+    import jax.numpy as jnp
+    return GuardedTrainState(
+        step=state.step, apply_fn=state.apply_fn, params=state.params,
+        tx=state.tx, opt_state=state.opt_state,
+        batch_stats=state.batch_stats,
+        skip_count=jnp.zeros((), jnp.int32),
+        consec_bad=jnp.zeros((), jnp.int32))
+
+
 def init_variables(model, key, batch, num_steps=None):
     """Initialize all model variables on a sample batch.
 

@@ -25,7 +25,8 @@ def _variables(state):
 
 
 def make_train_step(model, loss_on_s0=False, num_steps=None, detach=None,
-                    hits_ks=(), jit=True, pair_offset=0):
+                    hits_ks=(), jit=True, pair_offset=0, guard=False,
+                    fault_nan_step=None):
     """Build a jitted ``(state, batch, key) -> (state, metrics)`` step.
 
     Args:
@@ -40,6 +41,23 @@ def make_train_step(model, loss_on_s0=False, num_steps=None, detach=None,
             :meth:`DGMC.__call__`) — the handle the ``--pairs-per-step``
             equivalence test uses to make ``B=1`` reference steps draw
             the exact noise of batched element ``pair_offset``.
+        guard: in-graph non-finite guardrail. ``state`` must be a
+            :class:`~dgmc_tpu.train.state.GuardedTrainState` (see
+            :func:`~dgmc_tpu.train.state.with_guard_counters`). A step
+            whose loss or gradient global-norm is non-finite keeps the
+            old params/optimizer/batch_stats wholesale (``step`` still
+            advances, so deterministic per-step streams stay aligned),
+            increments the ``skip_count``/``consec_bad`` ledger, and
+            reports ``bad_step`` in the metrics; a finite step resets
+            ``consec_bad``. Rollback after M consecutive bad steps is
+            host policy (:class:`dgmc_tpu.resilience.RollbackGuard`).
+            Off (the default), the lowered step is unchanged.
+        fault_nan_step: deterministic fault injection
+            (``dgmc_tpu/resilience/faults.py`` — ``nan-grads@N``):
+            poison every gradient leaf with NaN on the Nth optimizer
+            step (1-based: fires when ``state.step == N - 1``). Trace-
+            time constant; ``None`` (the default) adds nothing to the
+            lowered program.
 
     The metrics dict carries ``loss`` (the scalar trained on — a masked
     mean over every valid correspondence in the batch) and
@@ -75,6 +93,11 @@ def make_train_step(model, loss_on_s0=False, num_steps=None, detach=None,
 
         (loss, (new_vars, S_L)), grads = jax.value_and_grad(
             loss_fn, has_aux=True)(state.params)
+        if fault_nan_step is not None:
+            fire = state.step == fault_nan_step - 1
+            grads = jax.tree.map(
+                lambda g: jnp.where(fire, jnp.asarray(jnp.nan, g.dtype),
+                                    g), grads)
         if _probes.enabled():
             # Trace-time gate (obs/probes.py): a probe-free build lowers to
             # byte-identical HLO (tests/obs/test_probes.py).
@@ -86,11 +109,40 @@ def make_train_step(model, loss_on_s0=False, num_steps=None, detach=None,
             _probes.check_finite('loss', loss, order=1000)
             _probes.check_finite('grad', gnorm, order=1001)
         with jax.named_scope('optimizer'):
-            state = state.apply_gradients(grads=grads)
+            new_state = state.apply_gradients(grads=grads)
         if state.batch_stats:
-            state = state.replace(batch_stats=new_vars['batch_stats'])
+            new_state = new_state.replace(
+                batch_stats=new_vars['batch_stats'])
+        guard_out = {}
+        if guard:
+            import optax
+            good = jnp.isfinite(loss) & jnp.isfinite(
+                optax.global_norm(grads))
 
-        out = {'loss': loss,
+            def keep(new, old):
+                return jnp.where(good, new, old)
+
+            # Bad step: the whole update is discarded (params, optimizer
+            # moments AND counts, batch stats) — exactly "old state
+            # kept". `step` still advances (apply_gradients), so replay
+            # determinism and fault_nan_step indexing survive skips.
+            state = new_state.replace(
+                params=jax.tree.map(keep, new_state.params, state.params),
+                opt_state=jax.tree.map(keep, new_state.opt_state,
+                                       state.opt_state),
+                batch_stats=jax.tree.map(keep, new_state.batch_stats,
+                                         state.batch_stats),
+                skip_count=state.skip_count
+                + (1 - good.astype(jnp.int32)),
+                consec_bad=jnp.where(good, 0, state.consec_bad + 1))
+            guard_out = {'bad_step': ~good,
+                         'skip_count': state.skip_count,
+                         'consec_bad': state.consec_bad}
+        else:
+            state = new_state
+
+        out = {**guard_out,
+               'loss': loss,
                'loss_per_pair': metrics.nll_loss(S_L, batch.y, batch.y_mask,
                                                  reduction='per_pair'),
                'acc': metrics.acc(S_L, batch.y, batch.y_mask)}

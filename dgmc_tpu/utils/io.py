@@ -1,0 +1,35 @@
+"""Atomic JSON writes, shared by every artifact the resilience loop
+reads across process boundaries (recovery.json, heartbeat.json, fault
+ledgers, checkpoint manifests): a reader must see either the previous
+complete file or the new complete file, never a torn write — tmp file in
+the same directory, then ``os.replace``.
+
+Kept import-light on purpose (stdlib only): the supervisor's monitor
+loop and the fault module use it in processes that must stay responsive
+while a jax backend wedges.
+"""
+
+import json
+import os
+
+__all__ = ['write_json_atomic']
+
+
+def write_json_atomic(path, payload, *, indent=None, sort_keys=False,
+                      quiet=False):
+    """Write ``payload`` as JSON to ``path`` via tmp+rename (atomic on
+    POSIX within one filesystem). Creates parent directories. With
+    ``quiet=True`` an ``OSError`` is swallowed and reported as a
+    ``False`` return — for telemetry writers that must never take the
+    run down with them."""
+    tmp = f'{path}.tmp.{os.getpid()}'
+    try:
+        os.makedirs(os.path.dirname(path) or '.', exist_ok=True)
+        with open(tmp, 'w') as f:
+            json.dump(payload, f, indent=indent, sort_keys=sort_keys)
+        os.replace(tmp, path)
+        return True
+    except OSError:
+        if quiet:
+            return False
+        raise

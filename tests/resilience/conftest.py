@@ -1,0 +1,23 @@
+"""De-flake fixture for the jax-using resilience tests: never read the
+persistent XLA compilation cache (same jax-0.4.37 donation+cache bug
+family as tests/parallel/conftest.py and tests/examples/conftest.py —
+the checkpoint-hardening and guard tests compile donating train steps).
+The fault/supervisor tests are jax-free and unaffected.
+"""
+
+import jax
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def _no_persistent_compile_cache():
+    from jax._src import compilation_cache
+
+    prev = jax.config.jax_enable_compilation_cache
+    jax.config.update('jax_enable_compilation_cache', False)
+    compilation_cache.reset_cache()  # un-latch is_cache_used
+    try:
+        yield
+    finally:
+        jax.config.update('jax_enable_compilation_cache', prev)
+        compilation_cache.reset_cache()

@@ -1,0 +1,180 @@
+"""The acceptance-criterion chaos matrix, end-to-end through the real
+dbp15k CLI (synthetic data, tiny shapes): a supervised run SIGKILLed at
+a random mid-training step must auto-resume and finish with EXACTLY the
+state an uninterrupted run reaches — the per-epoch PRNG stream is
+consumed positionally, so determinism is exact, not approximate. The
+remaining injected faults each get their recovery path proven the same
+way.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+#: Tiny synthetic DBP15K: 2 phase-1 + 3 phase-2 epochs, ckpt every epoch.
+SYN = ['--synthetic', '--syn_nodes_s', '48', '--syn_nodes_t', '64',
+       '--syn_edges_s', '160', '--syn_edges_t', '224', '--syn_dim', '16',
+       '--dim', '16', '--rnd_dim', '8', '--num_layers', '1',
+       '--num_steps', '2', '--k', '5', '--epochs', '6',
+       '--phase1_epochs', '3', '--ckpt_every', '1', '--seed', '11']
+
+
+def _run(tmp_path, tag, extra, timeout=900, expect_rc=0):
+    env = dict(os.environ, JAX_PLATFORMS='cpu',
+               JAX_ENABLE_COMPILATION_CACHE='false')
+    log = tmp_path / f'{tag}.log'
+    with open(log, 'w') as fh:   # file, not pipe: no deadlock on chatter
+        proc = subprocess.run(
+            [sys.executable, '-m', 'dgmc_tpu.experiments.dbp15k'] + SYN
+            + ['--ckpt_dir', str(tmp_path / f'ck_{tag}'),
+               '--metrics_log', str(tmp_path / f'{tag}.jsonl')] + extra,
+            cwd=REPO, env=env, stdout=fh, stderr=subprocess.STDOUT,
+            timeout=timeout)
+    out = log.read_text()
+    assert proc.returncode == expect_rc, (tag, proc.returncode,
+                                          out[-3000:])
+    return out
+
+
+def _final_state_leaves(ckpt_dir):
+    import numpy as np
+    import orbax.checkpoint as ocp
+    mgr = ocp.CheckpointManager(str(ckpt_dir))
+    step = mgr.latest_step()
+    tree = mgr.restore(step, args=ocp.args.StandardRestore())
+    mgr.close()
+    import jax
+    return step, [np.asarray(leaf) for leaf in jax.tree.leaves(tree)]
+
+
+def _metrics(tmp_path, tag):
+    with open(tmp_path / f'{tag}.jsonl') as f:
+        return [json.loads(line) for line in f]
+
+
+def _supervised(extra_faults, obs_tag):
+    return ['--supervise', '--max-restarts', '3',
+            '--restart-backoff', '0.1',
+            '--obs-dir'] + [obs_tag] + extra_faults
+
+
+@pytest.mark.slow
+def test_sigkill_chaos_parity(tmp_path):
+    """The headline: SIGKILL at a mid-training step under --supervise ==
+    an uninterrupted run, exactly, down to every state leaf."""
+    import numpy as np
+    _run(tmp_path, 'control', [])
+
+    obs = str(tmp_path / 'obs')
+    # "Random mid-training step", reproducibly: seeded draw over the
+    # epochs that have both a predecessor checkpoint and a successor.
+    import random
+    kill_epoch = random.Random(11).randint(2, 5)
+    out = _run(tmp_path, 'chaos',
+               _supervised(['--inject-fault', f'sigkill@{kill_epoch}'],
+                           obs))
+    assert f'firing sigkill@{kill_epoch}' in out
+    assert 'Resumed from' in out
+    assert '[supervisor] complete' in out
+
+    rec = json.load(open(os.path.join(obs, 'recovery.json')))
+    assert rec['outcome'] == 'completed'
+    assert rec['restarts'] == 1
+    assert rec['attempts'][0]['reason'] == 'signal:SIGKILL'
+
+    # Exact final-state parity, every leaf (params, optimizer, stats).
+    step_a, leaves_a = _final_state_leaves(tmp_path / 'ck_control')
+    step_b, leaves_b = _final_state_leaves(tmp_path / 'ck_chaos')
+    assert step_a == step_b == 6
+    assert len(leaves_a) == len(leaves_b)
+    for x, y in zip(leaves_a, leaves_b):
+        np.testing.assert_array_equal(x, y)
+
+    # Metric parity on the epochs after the kill (the resumed stream).
+    tail = lambda tag: [(m['step'], m.get('loss'), m.get('hits1'))
+                       for m in _metrics(tmp_path, tag)
+                       if m.get('loss') is not None and m['step'] >= 4]
+    assert tail('chaos')[-3:] == tail('control')[-3:]
+
+    # The recovery timeline renders through obs.report.
+    rep = subprocess.run(
+        [sys.executable, '-m', 'dgmc_tpu.obs.report', obs],
+        cwd=REPO, capture_output=True, text=True,
+        env=dict(os.environ, JAX_PLATFORMS='cpu'), timeout=120)
+    assert rep.returncode == 0, rep.stderr[-2000:]
+    assert 'recovery timeline' in rep.stdout
+    assert 'signal:SIGKILL' in rep.stdout
+
+
+@pytest.mark.slow
+def test_sigterm_and_raise_recovery(tmp_path):
+    """Preemption (SIGTERM) at one epoch and a crashing exception at a
+    later one, both in one supervised run: two restarts, then done."""
+    obs = str(tmp_path / 'obs')
+    out = _run(tmp_path, 'chaos',
+               _supervised(['--inject-fault', 'sigterm@2',
+                            '--inject-fault', 'raise@4'], obs))
+    assert 'firing sigterm@2' in out and 'firing raise@4' in out
+    rec = json.load(open(os.path.join(obs, 'recovery.json')))
+    assert rec['outcome'] == 'completed'
+    assert rec['restarts'] == 2
+    step, _leaves = _final_state_leaves(tmp_path / 'ck_chaos')
+    assert step == 6
+
+
+@pytest.mark.slow
+def test_stall_hang_is_killed_and_resumed(tmp_path):
+    """A wedged step (the rc:124 multichip failure mode): the child's
+    watchdog heartbeat goes stale, the supervisor kills it, and the
+    restarted run completes. The stall outlives 2x the deadline but not
+    the test: the injected sleep is the only thing keeping attempt 0
+    alive, so the SIGKILL escalation reaps it immediately."""
+    obs = str(tmp_path / 'obs')
+    out = _run(tmp_path, 'chaos',
+               _supervised(['--inject-fault', 'stall@4:600',
+                            '--watchdog-deadline', '5'], obs),
+               timeout=900)
+    assert 'firing stall@4' in out
+    rec = json.load(open(os.path.join(obs, 'recovery.json')))
+    assert rec['outcome'] == 'completed'
+    assert rec['attempts'][0]['reason'] in ('heartbeat-stale',
+                                            'hang-report')
+    step, _leaves = _final_state_leaves(tmp_path / 'ck_chaos')
+    assert step == 6
+
+
+@pytest.mark.slow
+def test_ckpt_corrupt_fault_resumes_from_previous(tmp_path):
+    """ckpt-corrupt@3 + sigkill@5: the restarted attempt finds its
+    latest intact checkpoint (4), or — had 4 been the damaged one —
+    falls back; either way it completes with full-length training."""
+    obs = str(tmp_path / 'obs')
+    out = _run(tmp_path, 'chaos',
+               _supervised(['--inject-fault', 'ckpt-corrupt@4',
+                            '--inject-fault', 'sigkill@5'], obs))
+    assert 'damaged' in out          # the fault hit a real file
+    assert 'failed verification' in out or 'failed to restore' in out
+    rec = json.load(open(os.path.join(obs, 'recovery.json')))
+    assert rec['outcome'] == 'completed'
+    step, _leaves = _final_state_leaves(tmp_path / 'ck_chaos')
+    assert step == 6
+
+
+@pytest.mark.slow
+def test_nan_grads_skips_and_reports(tmp_path):
+    """nan-grads@5 under --guard-bad-steps: the poisoned step is skipped
+    (params frozen for it), training continues, and the skip ledger
+    lands in the metrics log."""
+    _run(tmp_path, 'guarded',
+         ['--inject-fault', 'nan-grads@5', '--guard-bad-steps', '3'])
+    metrics = _metrics(tmp_path, 'guarded')
+    final = [m for m in metrics if m.get('skipped_steps') is not None][-1]
+    assert final['skipped_steps'] == 1
+    assert final['consec_bad'] == 0  # recovered by the next good step

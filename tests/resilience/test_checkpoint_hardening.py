@@ -1,0 +1,335 @@
+"""Checkpoint hardening: checksummed manifests, corrupt-latest fallback,
+and the actionable-error contract for every restore edge case the issue
+names (empty dir, torn latest, explicit missing/corrupt step).
+"""
+
+import json
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from dgmc_tpu.resilience import corrupt_checkpoint
+from dgmc_tpu.train import (Checkpointer, CheckpointCorruptError,
+                            create_train_state, make_train_step,
+                            resume_or_init)
+from dgmc_tpu.train.checkpoint import MANIFEST_DIRNAME
+
+from tests.train.test_steps import tiny_loader, tiny_model
+
+
+def _tree_equal(a, b):
+    return all(np.array_equal(np.asarray(x), np.asarray(y))
+               for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b)))
+
+
+@pytest.fixture(scope='module')
+def trained():
+    """Three distinguishable states from three real train steps. The
+    jitted step DONATES its input state, so each kept state is a deep
+    copy the next step cannot invalidate."""
+    import jax.numpy as jnp
+    model = tiny_model()
+    batch = next(iter(tiny_loader()))
+    state = create_train_state(model, jax.random.key(0), batch)
+    step = make_train_step(model)
+    states = []
+    key = jax.random.key(1)
+    for _ in range(3):
+        key, sub = jax.random.split(key)
+        state, _ = step(state, batch, sub)
+        states.append(jax.tree.map(jnp.copy, state))
+    return model, batch, states
+
+
+def _save_all(tmp_path, states, **kw):
+    ckpt = Checkpointer(tmp_path / 'ckpt', **kw)
+    for i, s in enumerate(states, start=1):
+        ckpt.save(i, s, wait=True)
+    return ckpt
+
+
+def _fresh(trained):
+    model, batch, _states = trained
+    return create_train_state(model, jax.random.key(9), batch)
+
+
+def test_manifest_written_and_verifies(tmp_path, trained):
+    _model, _batch, states = trained
+    ckpt = _save_all(tmp_path, states)
+    for step in (1, 2, 3):
+        mpath = os.path.join(ckpt.directory, MANIFEST_DIRNAME,
+                             f'{step}.json')
+        assert os.path.exists(mpath), mpath
+        assert ckpt.verify(step) == []
+    ckpt.close()
+
+
+def test_restore_clean_latest(tmp_path, trained):
+    _model, _batch, states = trained
+    ckpt = _save_all(tmp_path, states)
+    restored = ckpt.restore(_fresh(trained))
+    assert ckpt.restored_step == 3
+    assert _tree_equal(restored.params, states[-1].params)
+    ckpt.close()
+
+
+@pytest.mark.parametrize('mode', ['corrupt', 'truncate'])
+def test_corrupt_latest_falls_back_to_previous(tmp_path, trained, mode,
+                                               capsys):
+    _model, _batch, states = trained
+    ckpt = _save_all(tmp_path, states)
+    corrupt_checkpoint(ckpt.directory, 3, mode=mode)
+    assert ckpt.verify(3), 'damage must be detectable'
+    restored = ckpt.restore(_fresh(trained))
+    assert ckpt.restored_step == 2
+    assert _tree_equal(restored.params, states[1].params)
+    assert 'falling back' in capsys.readouterr().err
+    ckpt.close()
+
+
+def test_every_checkpoint_corrupt_raises_actionable(tmp_path, trained):
+    _model, _batch, states = trained
+    ckpt = _save_all(tmp_path, states)
+    for step in (1, 2, 3):
+        corrupt_checkpoint(ckpt.directory, step)
+    with pytest.raises(CheckpointCorruptError) as e:
+        ckpt.restore(_fresh(trained))
+    # The error carries per-step evidence and a next action.
+    for step in (1, 2, 3):
+        assert f'step {step}' in str(e.value)
+    assert 'Delete' in str(e.value)
+    ckpt.close()
+
+
+def test_explicit_missing_step_names_available(tmp_path, trained):
+    _model, _batch, states = trained
+    ckpt = _save_all(tmp_path, states)
+    with pytest.raises(FileNotFoundError) as e:
+        ckpt.restore(_fresh(trained), step=7)
+    assert '[1, 2, 3]' in str(e.value)
+    ckpt.close()
+
+
+def test_explicit_corrupt_step_raises_not_falls_back(tmp_path, trained):
+    """A caller who PINNED a step asked for that step: silently handing
+    back a different one would be worse than failing."""
+    _model, _batch, states = trained
+    ckpt = _save_all(tmp_path, states)
+    corrupt_checkpoint(ckpt.directory, 2)
+    with pytest.raises(CheckpointCorruptError):
+        ckpt.restore(_fresh(trained), step=2)
+    # The other steps are untouched by the pinned-step failure.
+    restored = ckpt.restore(_fresh(trained), step=1)
+    assert _tree_equal(restored.params, states[0].params)
+    ckpt.close()
+
+
+def test_explicit_step_with_fallback_walks_back(tmp_path, trained):
+    """restore(step=N, fallback=True): a corrupt pinned step with the
+    caller's explicit blessing falls back through OLDER steps instead of
+    raising an 'every checkpoint failed' error that only tried one."""
+    _model, _batch, states = trained
+    ckpt = _save_all(tmp_path, states)
+    corrupt_checkpoint(ckpt.directory, 3)
+    restored = ckpt.restore(_fresh(trained), step=3, fallback=True)
+    assert ckpt.restored_step == 2
+    assert _tree_equal(restored.params, states[1].params)
+    ckpt.close()
+
+
+def test_resave_over_existing_step_overwrites(tmp_path, trained):
+    """orbax silently no-ops save(step <= latest_step): after a corrupt-
+    latest fallback the resumed run re-runs the epoch and saves the SAME
+    step — that save must replace the torn step, not vanish and leave
+    the corrupt bytes as the latest checkpoint forever."""
+    _model, _batch, states = trained
+    ckpt = _save_all(tmp_path, states)
+    corrupt_checkpoint(ckpt.directory, 3)
+    restored = ckpt.restore(_fresh(trained))
+    assert ckpt.restored_step == 2
+    ckpt.save(3, states[2], wait=True)  # the re-run epoch's save
+    assert ckpt.verify(3) == [], 'manifest must match the NEW step 3'
+    out = ckpt.restore(_fresh(trained))
+    assert ckpt.restored_step == 3
+    assert _tree_equal(out.params, states[2].params)
+    ckpt.close()
+
+
+def test_verify_disabled_skips_manifests(tmp_path, trained):
+    _model, _batch, states = trained
+    ckpt = _save_all(tmp_path, states, verify=False)
+    assert not os.path.isdir(os.path.join(ckpt.directory,
+                                          MANIFEST_DIRNAME))
+    restored = ckpt.restore(_fresh(trained))
+    assert ckpt.restored_step == 3
+    assert restored is not None
+    ckpt.close()
+
+
+def test_retention_drops_retired_manifests(tmp_path, trained):
+    _model, _batch, states = trained
+    ckpt = Checkpointer(tmp_path / 'ckpt', max_to_keep=2)
+    for i, s in enumerate(states, start=1):
+        ckpt.save(i, s, wait=True)
+    ckpt.close()
+    mdir = os.path.join(ckpt.directory, MANIFEST_DIRNAME)
+    kept = sorted(os.listdir(mdir))
+    assert kept == ['2.json', '3.json'], kept
+
+
+def test_async_save_manifests_are_complete_after_close(tmp_path, trained):
+    """The CLIs save WITHOUT wait: orbax records the step in all_steps()
+    before its async tmp->rename commits the step dir, so a manifest
+    hashed at save() time pins an empty file table that verifies
+    vacuously forever. The manifest must instead land at a later
+    finalize (next save / close), with the real file contents."""
+    _model, _batch, states = trained
+    ckpt = Checkpointer(tmp_path / 'ckpt')
+    for i, s in enumerate(states, start=1):
+        ckpt.save(i, s)  # async — no wait
+    ckpt.close()
+    for step in (1, 2, 3):
+        mpath = os.path.join(ckpt.directory, MANIFEST_DIRNAME,
+                             f'{step}.json')
+        with open(mpath) as f:
+            assert json.load(f)['files'], f'empty manifest for step {step}'
+        assert ckpt.verify(step) == []
+
+
+def test_finalize_skips_uncommitted_step_and_heals_empty_manifest(
+        tmp_path, trained, monkeypatch):
+    _model, _batch, states = trained
+    ckpt = _save_all(tmp_path, states)
+    # An in-flight async step: listed by all_steps(), dir not yet
+    # renamed into place — no manifest may be written for it.
+    monkeypatch.setattr(ckpt, 'all_steps', lambda: [1, 2, 3, 4])
+    ckpt.finalize_manifests()
+    assert not os.path.exists(os.path.join(
+        ckpt.directory, MANIFEST_DIRNAME, '4.json'))
+    # An empty manifest left behind by the pre-fix race is healed on the
+    # next finalize pass instead of disabling verification for the step.
+    mpath = os.path.join(ckpt.directory, MANIFEST_DIRNAME, '2.json')
+    with open(mpath, 'w') as f:
+        json.dump({'step': 2, 'files': {}}, f)
+    ckpt.finalize_manifests()
+    with open(mpath) as f:
+        assert json.load(f)['files']
+    assert ckpt.verify(2) == []
+    ckpt.close()
+
+
+# -- resume_or_init edge cases ---------------------------------------------
+
+def test_resume_empty_dir_is_fresh_start(tmp_path, trained):
+    state = _fresh(trained)
+    ckpt, out_state, start = resume_or_init(str(tmp_path / 'ck'), state)
+    assert start == 1
+    assert out_state is state
+    ckpt.close()
+
+
+def test_resume_none_dir_disables_checkpointing(trained):
+    state = _fresh(trained)
+    ckpt, out_state, start = resume_or_init(None, state)
+    assert ckpt is None and out_state is state and start == 1
+
+
+def test_resume_torn_latest_falls_back(tmp_path, trained, capsys):
+    """A step directory orbax committed but whose payload was damaged
+    after the fact (the ckpt-corrupt fault; also what a torn write looks
+    like once the commit marker survived): resume must land on the
+    previous good step, not crash, not restart from scratch."""
+    _model, _batch, states = trained
+    ckpt = _save_all(tmp_path, states)
+    ckpt.close()
+    corrupt_checkpoint(str(tmp_path / 'ckpt'), 3, mode='truncate')
+    ckpt2, out_state, start = resume_or_init(str(tmp_path / 'ckpt'),
+                                             _fresh(trained))
+    assert start == 3  # resumed AT step 2 -> next epoch is 3
+    assert _tree_equal(out_state.params, states[1].params)
+    assert 'unrestorable' in capsys.readouterr().out
+    ckpt2.close()
+
+
+def test_resume_all_corrupt_raises_with_instructions(tmp_path, trained):
+    _model, _batch, states = trained
+    ckpt = _save_all(tmp_path, states)
+    ckpt.close()
+    for step in (1, 2, 3):
+        corrupt_checkpoint(str(tmp_path / 'ckpt'), step)
+    with pytest.raises(CheckpointCorruptError):
+        resume_or_init(str(tmp_path / 'ckpt'), _fresh(trained))
+
+
+def test_resume_guard_turned_on_adopts_plain_checkpoints(tmp_path, trained,
+                                                         capsys):
+    """Plain checkpoints + a guarded resume state (--guard-bad-steps added
+    between runs): the structure mismatch must read as a toggle, not as
+    every-checkpoint-corrupt; counters start fresh."""
+    from dgmc_tpu.train import GuardedTrainState, with_guard_counters
+    _model, _batch, states = trained
+    _save_all(tmp_path, states).close()
+    guarded = with_guard_counters(_fresh(trained))
+    ckpt, out_state, start = resume_or_init(str(tmp_path / 'ckpt'), guarded)
+    assert start == 4
+    assert isinstance(out_state, GuardedTrainState)
+    assert _tree_equal(out_state.params, states[-1].params)
+    assert int(out_state.skip_count) == 0
+    assert int(out_state.consec_bad) == 0
+    assert '--guard-bad-steps toggled' in capsys.readouterr().err
+    ckpt.close()
+
+
+def test_resume_guard_turned_off_drops_the_ledger(tmp_path, trained,
+                                                  capsys):
+    """Guarded checkpoints + a plain resume state: adopt the weights,
+    drop the counters, say so."""
+    from dgmc_tpu.train import GuardedTrainState, with_guard_counters
+    _model, _batch, states = trained
+    _save_all(tmp_path, [with_guard_counters(s) for s in states]).close()
+    ckpt, out_state, start = resume_or_init(str(tmp_path / 'ckpt'),
+                                            _fresh(trained))
+    assert start == 4
+    assert not isinstance(out_state, GuardedTrainState)
+    assert _tree_equal(out_state.params, states[-1].params)
+    assert '--guard-bad-steps toggled' in capsys.readouterr().err
+    ckpt.close()
+
+
+def test_resume_mixed_structure_retention_keeps_newest(tmp_path, trained,
+                                                       capsys):
+    """Retention holding BOTH structures (the guard was toggled mid-
+    history): resume must land on the NEWEST restorable step with the
+    structure converted — not silently slide back to an older step that
+    happens to match the requested structure."""
+    from dgmc_tpu.train import GuardedTrainState, with_guard_counters
+    _model, _batch, states = trained
+    ckpt = Checkpointer(tmp_path / 'ckpt')
+    ckpt.save(1, states[0], wait=True)               # plain
+    ckpt.save(2, with_guard_counters(states[1]), wait=True)  # guarded
+    ckpt.close()
+    # Guard off again: newest (guarded) step must win, converted.
+    ckpt2, out_state, start = resume_or_init(str(tmp_path / 'ckpt'),
+                                             _fresh(trained))
+    assert start == 3
+    assert not isinstance(out_state, GuardedTrainState)
+    assert _tree_equal(out_state.params, states[1].params)
+    assert '--guard-bad-steps toggled' in capsys.readouterr().err
+    ckpt2.close()
+
+
+def test_resume_real_corruption_still_raises_despite_toggle_retry(
+        tmp_path, trained):
+    """The toggle retry must not mask genuine corruption: when every
+    checkpoint is damaged, BOTH structures fail and the original
+    actionable error surfaces."""
+    from dgmc_tpu.train import with_guard_counters
+    _model, _batch, states = trained
+    _save_all(tmp_path, states).close()
+    for step in (1, 2, 3):
+        corrupt_checkpoint(str(tmp_path / 'ckpt'), step)
+    with pytest.raises(CheckpointCorruptError):
+        resume_or_init(str(tmp_path / 'ckpt'),
+                       with_guard_counters(_fresh(trained)))

@@ -1,0 +1,106 @@
+"""datasets/download.py retry behavior, driven by the deterministic
+transient-download fault: transient failures back off and retry, the
+budget is finite with a terminal actionable error, and permanent
+failures (4xx, bad paths) never burn retries.
+"""
+
+import urllib.error
+
+import pytest
+
+from dgmc_tpu.datasets import download
+from dgmc_tpu.resilience import faults
+
+
+@pytest.fixture(autouse=True)
+def _no_sleep_no_leftover_faults(monkeypatch):
+    monkeypatch.setattr('time.sleep', lambda s: None)
+    faults.arm_download_faults(0)
+    yield
+    faults.arm_download_faults(0)
+
+
+@pytest.fixture
+def src(tmp_path):
+    p = tmp_path / 'payload.bin'
+    p.write_bytes(b'dgmc' * 100)
+    return p
+
+
+def test_fetch_retries_past_transient_faults(tmp_path, src, capsys):
+    faults.arm_download_faults(2)
+    dest = tmp_path / 'out.bin'
+    got = download.fetch(src.as_uri(), str(dest), retries=4,
+                         backoff_s=0.01)
+    assert got == str(dest)
+    assert dest.read_bytes() == src.read_bytes()
+    assert faults.download_faults_remaining() == 0
+    err = capsys.readouterr().err
+    assert err.count('retrying in') == 2
+
+
+def test_fetch_exhausted_budget_raises_terminal(tmp_path, src):
+    faults.arm_download_faults(10)
+    dest = tmp_path / 'out.bin'
+    with pytest.raises(RuntimeError) as e:
+        download.fetch(src.as_uri(), str(dest), retries=2, backoff_s=0.01)
+    msg = str(e.value)
+    assert 'after 3 attempt(s)' in msg
+    assert 'fetch it manually' in msg
+    assert not dest.exists()
+    assert not dest.with_suffix('.bin.part').exists()
+
+
+def test_fetch_permanent_failure_not_retried(tmp_path, monkeypatch):
+    calls = []
+
+    def fake_urlopen(url, timeout=None):
+        calls.append(url)
+        raise urllib.error.HTTPError(url, 404, 'Not Found', {}, None)
+
+    monkeypatch.setattr(download.urllib.request, 'urlopen', fake_urlopen)
+    with pytest.raises(RuntimeError) as e:
+        download.fetch('http://example.invalid/x.zip',
+                       str(tmp_path / 'x.zip'), retries=5, backoff_s=0.01)
+    assert len(calls) == 1, 'a 404 must not be retried'
+    assert 'after 1 attempt(s)' in str(e.value)
+
+
+def test_fetch_rate_limit_is_transient(tmp_path, src, monkeypatch):
+    """429 is the server saying "later", not "never": it retries."""
+    calls = []
+    real_urlopen = download.urllib.request.urlopen
+
+    def flaky_urlopen(url, timeout=None):
+        calls.append(url)
+        if len(calls) < 3:
+            raise urllib.error.HTTPError(url, 429, 'Too Many Requests',
+                                         {}, None)
+        return real_urlopen(url, timeout=timeout)
+
+    monkeypatch.setattr(download.urllib.request, 'urlopen', flaky_urlopen)
+    dest = tmp_path / 'out.bin'
+    download.fetch(src.as_uri(), str(dest), retries=4, backoff_s=0.01)
+    assert len(calls) == 3
+    assert dest.read_bytes() == src.read_bytes()
+
+
+def test_env_var_arms_download_faults():
+    """Subprocess tests arm the fault through the environment; the
+    module-level budget reads it at import. Pin the documented name in a
+    fresh interpreter (reloading the module in-process would rebind the
+    exception classes other tests hold references to)."""
+    import os
+    import subprocess
+    import sys
+    out = subprocess.run(
+        [sys.executable, '-c',
+         'from dgmc_tpu.resilience import faults; '
+         'print(faults.download_faults_remaining())'],
+        env=dict(os.environ, DGMC_TPU_FAULT_DOWNLOADS='2',
+                 JAX_PLATFORMS='cpu'),
+        capture_output=True, text=True, timeout=120,
+        cwd=os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))))
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert out.stdout.strip() == '2'

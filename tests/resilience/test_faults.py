@@ -1,0 +1,168 @@
+"""The fault-injection API itself: spec grammar, fire-once ledger,
+checkpoint damage, transient-download arming. Every recovery path the
+rest of this package tests is driven through these hooks, so their
+semantics (exact step, fire once across restarts, deterministic replay
+for nan-grads) are pinned here first.
+"""
+
+import json
+import os
+
+import pytest
+
+from dgmc_tpu.resilience import faults
+from dgmc_tpu.resilience.faults import (FaultInjected, FaultPlan,
+                                        corrupt_checkpoint, ledger_dir,
+                                        parse_spec)
+
+
+# -- spec grammar ----------------------------------------------------------
+
+@pytest.mark.parametrize('text,kind,step,arg', [
+    ('raise@3', 'raise', 3, None),
+    ('sigterm@1', 'sigterm', 1, None),
+    ('sigkill@12', 'sigkill', 12, None),
+    ('stall@4', 'stall', 4, 3600.0),
+    ('stall@4:2.5', 'stall', 4, 2.5),
+    ('nan-grads@7', 'nan-grads', 7, None),
+    ('ckpt-truncate@2', 'ckpt-truncate', 2, None),
+    ('ckpt-corrupt@2', 'ckpt-corrupt', 2, None),
+    ('download-fail', 'download-fail', None, 1),
+    ('download-fail:3', 'download-fail', None, 3),
+])
+def test_parse_spec(text, kind, step, arg):
+    spec = parse_spec(text)
+    assert (spec.kind, spec.step, spec.arg) == (kind, step, arg)
+
+
+@pytest.mark.parametrize('bad', [
+    'explode@3',          # unknown kind
+    'raise',              # step required
+    'sigkill',            # step required
+    'download-fail@3',    # takes a count, not a step
+    'raise@x',            # non-integer step
+])
+def test_parse_spec_rejects(bad):
+    with pytest.raises(ValueError):
+        parse_spec(bad)
+
+
+def test_spec_key_roundtrip():
+    assert parse_spec('sigkill@5').key == 'sigkill@5'
+    assert parse_spec('download-fail:2').key == 'download-fail'
+
+
+# -- fire-once ledger ------------------------------------------------------
+
+def test_raise_fires_at_exact_step(tmp_path):
+    plan = FaultPlan(['raise@3'], state_dir=str(tmp_path))
+    plan.before_step(1)
+    plan.before_step(2)
+    with pytest.raises(FaultInjected):
+        plan.before_step(3)
+
+
+def test_ledger_prevents_refire_across_restarts(tmp_path):
+    """A supervised restart replays the schedule from the checkpoint; the
+    ledger (written BEFORE the fault delivers) must stop the replayed
+    step from re-firing — otherwise sigkill@N crash-loops forever."""
+    plan = FaultPlan(['raise@3'], state_dir=str(tmp_path))
+    with pytest.raises(FaultInjected):
+        plan.before_step(3)
+    ledger = json.load(open(tmp_path / faults.FIRED_LEDGER))
+    assert ledger['fired'] == ['raise@3']
+    # "Restarted process": a fresh plan over the same state_dir.
+    replay = FaultPlan(['raise@3'], state_dir=str(tmp_path))
+    replay.before_step(3)  # must not raise
+
+
+def test_no_state_dir_refires_in_fresh_plan():
+    """Without a ledger dir the fire-once record is in-memory only: the
+    same plan never re-fires (monotonic steps), but a fresh plan — a new
+    process without persisted state — fires again."""
+    plan = FaultPlan(['raise@2'], state_dir=None)
+    with pytest.raises(FaultInjected):
+        plan.before_step(2)
+    plan.before_step(2)  # same plan: already fired
+    with pytest.raises(FaultInjected):
+        FaultPlan(['raise@2'], state_dir=None).before_step(2)
+
+
+def test_ledger_dir_resolution(tmp_path):
+    """The ledger must survive the supervisor's per-attempt --obs-dir
+    rewrite: inside attempt_<k> it climbs to the obs root."""
+    assert ledger_dir('/ck', '/obs') == '/ck'
+    assert ledger_dir(None, '/obs/root') == '/obs/root'
+    assert ledger_dir(None, '/obs/root/attempt_3') == '/obs/root'
+    assert ledger_dir(None, '/obs/attempt_x') == '/obs/attempt_x'
+    assert ledger_dir(None, None) is None
+
+
+def test_nan_grads_not_ledgered(tmp_path):
+    """nan-grads is part of the deterministic step stream: a resumed run
+    must REPLAY it to reproduce the uninterrupted trajectory, so it never
+    enters the fired ledger (it is compiled into the step, not fired by
+    before_step)."""
+    plan = FaultPlan(['nan-grads@4'], state_dir=str(tmp_path))
+    assert plan.nan_grads_step == 4
+    for step in range(1, 10):
+        plan.before_step(step)  # never raises, never writes the ledger
+    assert not os.path.exists(tmp_path / faults.FIRED_LEDGER)
+
+
+# -- checkpoint damage -----------------------------------------------------
+
+def _fake_step_dir(tmp_path, step=3):
+    d = tmp_path / str(step) / 'default'
+    d.mkdir(parents=True)
+    (d / 'small.bin').write_bytes(b'x' * 64)
+    (d / 'big.bin').write_bytes(bytes(range(256)) * 64)
+    return d / 'big.bin'
+
+
+def test_corrupt_checkpoint_truncates_largest(tmp_path):
+    big = _fake_step_dir(tmp_path)
+    orig = big.stat().st_size
+    hit = corrupt_checkpoint(str(tmp_path), 3, mode='truncate')
+    assert hit == str(big)
+    assert big.stat().st_size == orig // 2
+
+
+def test_corrupt_checkpoint_flips_bytes(tmp_path):
+    big = _fake_step_dir(tmp_path)
+    orig = big.read_bytes()
+    hit = corrupt_checkpoint(str(tmp_path), 3, mode='corrupt')
+    assert hit == str(big)
+    damaged = big.read_bytes()
+    assert len(damaged) == len(orig) and damaged != orig
+
+
+def test_corrupt_checkpoint_missing_step(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        corrupt_checkpoint(str(tmp_path), 9)
+
+
+# -- transient-download arming ---------------------------------------------
+
+def test_download_fault_budget():
+    faults.arm_download_faults(2)
+    try:
+        assert faults.consume_download_fault()
+        assert faults.consume_download_fault()
+        assert not faults.consume_download_fault()
+    finally:
+        faults.arm_download_faults(0)
+
+
+def test_download_fault_armed_by_plan():
+    FaultPlan(['download-fail:3'])
+    try:
+        assert faults.download_faults_remaining() == 3
+    finally:
+        faults.arm_download_faults(0)
+
+
+def test_transient_jitter_stretches_never_shrinks():
+    for _ in range(50):
+        d = faults.transient_jitter(2.0)
+        assert 2.0 <= d <= 2.5

@@ -1,0 +1,139 @@
+"""The in-graph non-finite guardrail + host-side rollback policy: a bad
+step must freeze the WHOLE update (params, optimizer, batch stats) while
+the step counter and skip ledger advance, and M consecutive bad steps
+must roll back to the last good snapshot.
+
+Driven by the deterministic ``nan-grads@N`` fault — the injection is
+compiled into the step, so the bad step lands at exactly N.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dgmc_tpu.resilience import RollbackGuard
+from dgmc_tpu.train import (create_train_state, make_train_step,
+                            with_guard_counters)
+from dgmc_tpu.train.state import GuardedTrainState
+
+from tests.train.test_steps import tiny_loader, tiny_model
+
+
+def _tree_equal(a, b):
+    return all(np.array_equal(np.asarray(x), np.asarray(y))
+               for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b)))
+
+
+@pytest.fixture(scope='module')
+def _model_batch():
+    model = tiny_model()
+    batch = next(iter(tiny_loader()))
+    return model, batch
+
+
+@pytest.fixture
+def setup(_model_batch):
+    """Fresh state per test: the jitted steps donate their input state,
+    so a shared state would be invalidated by the first test that runs."""
+    model, batch = _model_batch
+    state = with_guard_counters(
+        create_train_state(model, jax.random.key(0), batch))
+    return model, batch, state
+
+
+def test_with_guard_counters_structure(setup):
+    _model, _batch, state = setup
+    assert isinstance(state, GuardedTrainState)
+    assert state.skip_count.dtype == jnp.int32
+    assert int(state.skip_count) == 0 and int(state.consec_bad) == 0
+
+
+def test_bad_step_freezes_update_and_counts(setup):
+    model, batch, state = setup
+    step = make_train_step(model, guard=True, fault_nan_step=2)
+    key = jax.random.key(1)
+
+    key, sub = jax.random.split(key)
+    state, out = step(state, batch, sub)
+    assert not bool(out['bad_step'])
+
+    before = jax.tree.map(jnp.copy, {'params': state.params,
+                                     'opt': state.opt_state,
+                                     'bs': state.batch_stats})
+    step_before = int(state.step)
+    key, sub = jax.random.split(key)
+    state, out = step(state, batch, sub)  # nan-grads fires here
+    assert bool(out['bad_step'])
+    assert _tree_equal(state.params, before['params'])
+    assert _tree_equal(state.opt_state, before['opt'])
+    assert _tree_equal(state.batch_stats, before['bs'])
+    # The step counter still advances: deterministic streams (and the
+    # nan-grads indexing itself) stay aligned across skips.
+    assert int(state.step) == step_before + 1
+    assert int(state.skip_count) == 1
+    assert int(state.consec_bad) == 1
+
+    # A good step trains again and resets the consecutive counter (the
+    # cumulative skip ledger survives).
+    key, sub = jax.random.split(key)
+    state, out = step(state, batch, sub)
+    assert not bool(out['bad_step'])
+    assert not _tree_equal(state.params, before['params'])
+    assert int(state.skip_count) == 1
+    assert int(state.consec_bad) == 0
+
+
+def test_unguarded_step_unchanged(setup):
+    """guard=False still returns a plain update with no ledger keys."""
+    model, batch, _state = setup
+    state = create_train_state(model, jax.random.key(0), batch)
+    step = make_train_step(model)
+    state, out = step(state, batch, jax.random.key(1))
+    assert 'bad_step' not in out and 'skip_count' not in out
+
+
+def test_rollback_after_m_consecutive(setup):
+    model, batch, state = setup
+    # NaN every step from 1 on: consec_bad ratchets with no good step.
+    step = make_train_step(model, guard=True, fault_nan_step=1)
+    # (fault_nan_step fires when state.step == 0 only; emulate permanent
+    # badness by re-zeroing the step counter each iteration.)
+    guard = RollbackGuard(max_consecutive=3)
+    guard.note_good(state, step=0)
+    good_params = jax.tree.map(jnp.copy, state.params)
+
+    key = jax.random.key(1)
+    rolled_at = None
+    for i in range(1, 5):
+        key, sub = jax.random.split(key)
+        state, out = step(state.replace(step=jnp.zeros((), jnp.int32)),
+                          batch, sub)
+        assert bool(out['bad_step'])
+        state, rolled = guard.maybe_rollback(state, int(state.consec_bad),
+                                             step=i)
+        if rolled:
+            rolled_at = i
+            break
+    assert rolled_at == 3
+    assert guard.rollbacks == 1
+    assert _tree_equal(state.params, good_params)
+    # The ledger survives the rollback; the consecutive counter resets.
+    assert int(state.skip_count) == 3
+    assert int(state.consec_bad) == 0
+
+
+def test_rollback_without_snapshot_reports_and_holds(setup, capsys):
+    _model, _batch, state = setup
+    guard = RollbackGuard(max_consecutive=2)
+    out_state, rolled = guard.maybe_rollback(state, 5, step=1)
+    assert not rolled and out_state is state
+    assert 'no good snapshot' in capsys.readouterr().err
+
+
+def test_rollback_disabled_with_zero(setup):
+    _model, _batch, state = setup
+    guard = RollbackGuard(max_consecutive=0)
+    guard.note_good(state, step=0)
+    _out, rolled = guard.maybe_rollback(state, 100, step=1)
+    assert not rolled

@@ -1,0 +1,132 @@
+"""The recovery timeline through the obs toolchain: a supervised obs
+root (recovery.json + attempt_<k>/ subdirs) must load as its final
+attempt, summarize/render the timeline, and diff-gate on restart
+regressions — all from artifacts alone, no live run.
+"""
+
+import json
+import os
+
+import pytest
+
+from dgmc_tpu.obs.diff import diff_runs
+from dgmc_tpu.obs.report import load_run, render, summarize
+
+
+def _write_attempt(root, k, steps=3, hang=False):
+    d = os.path.join(root, f'attempt_{k}')
+    os.makedirs(d, exist_ok=True)
+    with open(os.path.join(d, 'metrics.jsonl'), 'w') as f:
+        for s in range(1, steps + 1):
+            f.write(json.dumps({'step': s, 'loss': 1.0 / s}) + '\n')
+    with open(os.path.join(d, 'timings.json'), 'w') as f:
+        json.dump({'steps': {'count': steps, 'mean_s': 0.1,
+                             'p50_s': 0.1, 'p95_s': 0.12, 'max_s': 0.2,
+                             'total_s': 0.1 * steps},
+                   'compiles': {'events': [], 'total_s': 0.0},
+                   'wall_s': 1.0}, f)
+    if hang:
+        with open(os.path.join(d, 'hang_report.json'), 'w') as f:
+            json.dump({'reason': 'deadline: no event for 5.0s'}, f)
+    return d
+
+
+def _write_recovery(root, restarts, outcome='completed', degradations=()):
+    os.makedirs(root, exist_ok=True)
+    attempts = [
+        {'attempt': k, 'reason': 'signal:SIGKILL', 'rc': -9,
+         'steps_completed': 2, 'start_time': 100.0 + 10 * k,
+         'end_time': 105.0 + 10 * k}
+        for k in range(restarts)]
+    attempts.append({'attempt': restarts, 'reason': 'completed', 'rc': 0,
+                     'steps_completed': 3, 'start_time': 200.0,
+                     'end_time': 210.0})
+    with open(os.path.join(root, 'recovery.json'), 'w') as f:
+        json.dump({'outcome': outcome, 'restarts': restarts,
+                   'degradations': [{'rung': r, 'attempt': 1,
+                                     'detail': r} for r in degradations],
+                   'attempts': attempts, 'events': []}, f)
+
+
+@pytest.fixture
+def supervised_root(tmp_path):
+    root = str(tmp_path / 'obs')
+    _write_recovery(root, restarts=1)
+    _write_attempt(root, 0, hang=True)   # the killed attempt
+    _write_attempt(root, 1)              # the clean resume
+    return root
+
+
+def test_load_run_binds_last_attempt(supervised_root):
+    run = load_run(supervised_root)
+    assert run['attempts'] == 2
+    assert run['recovery']['restarts'] == 1
+    # The final attempt is the run's outcome: its timings, and NOT the
+    # killed attempt's hang report (a recovered run must not diff as
+    # hung).
+    assert run['timings']['steps']['count'] == 3
+    assert run['hang'] is None
+
+
+def test_summarize_and_render_timeline(supervised_root):
+    s = summarize(load_run(supervised_root))
+    assert s['recovery']['outcome'] == 'completed'
+    assert s['recovery']['restarts'] == 1
+    assert [a['reason'] for a in s['recovery']['attempts']] == \
+        ['signal:SIGKILL', 'completed']
+    text = render(load_run(supervised_root))
+    assert 'recovery timeline' in text
+    assert 'signal:SIGKILL' in text
+
+
+def test_diff_gates_on_extra_restarts(tmp_path, supervised_root):
+    base_root = str(tmp_path / 'base')
+    _write_recovery(base_root, restarts=0)
+    _write_attempt(base_root, 0)
+    base = summarize(load_run(base_root))
+    cand = summarize(load_run(supervised_root))
+
+    # Default threshold 0: one new restart is a regression.
+    rows, regs = diff_runs(base, cand)
+    row = next(r for r in rows if r['metric'] == 'restarts')
+    assert row['status'] == 'REGRESSION' and row in regs
+    # Identical runs: clean.
+    rows, regs = diff_runs(cand, cand)
+    row = next(r for r in rows if r['metric'] == 'restarts')
+    assert row['status'] == 'ok' and not regs
+    # Slack of 1 restart: allowed.
+    rows, _regs = diff_runs(base, cand, thresholds={'restarts': 1})
+    row = next(r for r in rows if r['metric'] == 'restarts')
+    assert row['status'] == 'ok'
+
+
+def test_diff_gave_up_fails_unconditionally(tmp_path):
+    root_a = str(tmp_path / 'a')
+    _write_recovery(root_a, restarts=0)
+    _write_attempt(root_a, 0)
+    root_b = str(tmp_path / 'b')
+    _write_recovery(root_b, restarts=5, outcome='gave-up')
+    _write_attempt(root_b, 0)
+    rows, regs = diff_runs(summarize(load_run(root_a)),
+                           summarize(load_run(root_b)),
+                           thresholds={'restarts': 100})
+    rec = next(r for r in rows if r['metric'] == 'recovery')
+    assert rec['status'] == 'REGRESSION' and rec in regs
+
+
+def test_unsupervised_candidate_skips_gate(tmp_path):
+    root_a = str(tmp_path / 'a')
+    _write_recovery(root_a, restarts=2)
+    _write_attempt(root_a, 0)
+    root_b = str(tmp_path / 'b')
+    _write_attempt(root_b, 0)
+    os.rename(os.path.join(root_b, 'attempt_0'),
+              os.path.join(root_b, 'solo'))
+    # root_b: a plain unsupervised run dir.
+    for name in os.listdir(os.path.join(root_b, 'solo')):
+        os.rename(os.path.join(root_b, 'solo', name),
+                  os.path.join(root_b, name))
+    rows, _regs = diff_runs(summarize(load_run(root_a)),
+                            summarize(load_run(root_b)))
+    row = next(r for r in rows if r['metric'] == 'restarts')
+    assert row['status'] == 'skipped'

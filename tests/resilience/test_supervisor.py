@@ -1,0 +1,389 @@
+"""The run supervisor against a scriptable toy child: crash restarts,
+heartbeat-stale and hang-report kills, the restart budget, and the
+graceful-degradation ladder. jax-free on both sides — the monitor loop
+must work in any process, and these tests pin exactly the behaviors the
+slow chaos tests then exercise through the real CLIs.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+from dgmc_tpu.resilience.supervisor import (Supervisor,
+                                            strip_supervisor_args,
+                                            _flag_value,
+                                            _replace_flag_value,
+                                            LADDER_RUNGS)
+
+#: A child whose per-attempt behavior is scripted by a JSON plan file:
+#: ``{"attempts": [{"action": "crash"|"hang"|"hang-report"|"ok",
+#: "steps": N}, ...]}`` — attempt index persists in a counter file, the
+#: child dumps its argv+env evidence per attempt, writes a heartbeat
+#: like the real watchdog thread would, then acts.
+CHILD = r'''
+import json, os, sys, time
+plan_path, counter_path = sys.argv[1], sys.argv[2]
+argv = sys.argv[3:]
+obs_dir = None
+for i, tok in enumerate(argv):
+    if tok in ('--obs-dir', '--obs_dir'):
+        obs_dir = argv[i + 1]
+k = 0
+if os.path.exists(counter_path):
+    k = json.load(open(counter_path))['attempt'] + 1
+json.dump({'attempt': k}, open(counter_path, 'w'))
+plan = json.load(open(plan_path))['attempts']
+me = plan[min(k, len(plan) - 1)]
+if me['action'] == 'wedge-early':
+    time.sleep(120)   # wedged BEFORE the watchdog thread ever arms:
+                      # no heartbeat, no hang_report, ever
+if obs_dir:
+    os.makedirs(obs_dir, exist_ok=True)
+    json.dump({'argv': argv,
+               'DGMC_TPU_DISABLE_FUSED':
+                   os.environ.get('DGMC_TPU_DISABLE_FUSED')},
+              open(os.path.join(obs_dir, 'evidence.json'), 'w'))
+    json.dump({'time': time.time(), 'pid': os.getpid(),
+               'steps_completed': me.get('steps', k)},
+              open(os.path.join(obs_dir, 'heartbeat.json'), 'w'))
+ckpt_dir = None
+for i, tok in enumerate(argv):
+    if tok in ('--ckpt_dir', '--ckpt-dir'):
+        ckpt_dir = argv[i + 1]
+if ckpt_dir and me.get('ckpt_step') is not None:
+    os.makedirs(os.path.join(ckpt_dir, str(me['ckpt_step'])),
+                exist_ok=True)
+action = me['action']
+if action == 'crash':
+    sys.exit(me.get('rc', 3))
+if action == 'kill-self':
+    import signal
+    os.kill(os.getpid(), signal.SIGKILL)
+if action == 'hang':
+    time.sleep(120)   # heartbeat never refreshes -> goes stale
+if action == 'hang-report':
+    json.dump({'reason': 'deadline: no event for 600.0s'},
+              open(os.path.join(obs_dir, 'hang_report.json'), 'w'))
+    time.sleep(120)
+sys.exit(0)
+'''
+
+
+def _supervise(tmp_path, attempts, *, argv=(), max_restarts=5,
+               hang_deadline_s=None, ladder=(), **kw):
+    child = tmp_path / 'child.py'
+    child.write_text(CHILD)
+    plan = tmp_path / 'plan.json'
+    plan.write_text(json.dumps({'attempts': attempts}))
+    obs = tmp_path / 'obs'
+    sup = Supervisor(
+        [sys.executable, str(child), str(plan),
+         str(tmp_path / 'counter.json')],
+        list(argv) + ['--obs-dir', str(obs)],
+        obs_dir=str(obs), max_restarts=max_restarts, backoff_s=0.05,
+        grace_s=2.0, poll_s=0.05, hang_deadline_s=hang_deadline_s,
+        ladder=ladder, **kw)
+    rc = sup.run()
+    recovery = json.load(open(obs / 'recovery.json'))
+    return rc, recovery, obs
+
+
+def _evidence(obs, attempt):
+    return json.load(open(obs / f'attempt_{attempt}' / 'evidence.json'))
+
+
+def test_completes_clean_without_restart(tmp_path):
+    rc, rec, _obs = _supervise(tmp_path, [{'action': 'ok'}])
+    assert rc == 0
+    assert rec['outcome'] == 'completed'
+    assert rec['restarts'] == 0
+    assert [a['reason'] for a in rec['attempts']] == ['completed']
+
+
+def test_crashes_restart_until_success(tmp_path):
+    rc, rec, obs = _supervise(
+        tmp_path,
+        [{'action': 'crash'}, {'action': 'crash'}, {'action': 'ok'}])
+    assert rc == 0
+    assert rec['outcome'] == 'completed'
+    assert rec['restarts'] == 2
+    assert [a['reason'] for a in rec['attempts']] == \
+        ['exit:3', 'exit:3', 'completed']
+    # Per-attempt telemetry is isolated: --obs-dir rewritten per attempt.
+    for k in range(3):
+        ev = _evidence(obs, k)
+        assert ev['argv'][-1].endswith(f'attempt_{k}')
+
+
+def test_death_by_signal_is_recorded_and_retried(tmp_path):
+    """SIGKILL (what a preempted or OOM-killed child looks like) is
+    attributed by signal name and retried like any crash."""
+    rc, rec, _obs = _supervise(
+        tmp_path, [{'action': 'kill-self'}, {'action': 'ok'}])
+    assert rc == 0
+    assert rec['outcome'] == 'completed'
+    assert rec['attempts'][0]['reason'] == 'signal:SIGKILL'
+
+
+def test_restart_budget_exhaustion_gives_up(tmp_path):
+    rc, rec, _obs = _supervise(
+        tmp_path, [{'action': 'crash', 'rc': 7}], max_restarts=2)
+    assert rc == 7
+    assert rec['outcome'] == 'gave-up'
+    assert rec['restarts'] == 3  # initial + 2 restarts, all failed
+    assert [a['reason'] for a in rec['attempts']] == ['exit:7'] * 3
+    assert any(e['event'] == 'give-up' for e in rec['events'])
+
+
+def test_stale_heartbeat_kills_and_restarts(tmp_path):
+    rc, rec, _obs = _supervise(
+        tmp_path, [{'action': 'hang'}, {'action': 'ok'}],
+        hang_deadline_s=0.3)
+    assert rc == 0
+    assert rec['outcome'] == 'completed'
+    assert rec['attempts'][0]['reason'] == 'heartbeat-stale'
+    assert rec['attempts'][1]['reason'] == 'completed'
+
+
+def test_hang_report_kills_and_restarts(tmp_path):
+    """A deadline hang_report.json appearing in the attempt dir is the
+    in-process watchdog screaming; the supervisor must kill + restart
+    without waiting for the heartbeat to also go stale."""
+    rc, rec, _obs = _supervise(
+        tmp_path, [{'action': 'hang-report'}, {'action': 'ok'}],
+        hang_deadline_s=600.0)
+    assert rc == 0
+    assert rec['attempts'][0]['reason'] == 'hang-report'
+    assert rec['outcome'] == 'completed'
+
+
+def test_degradation_ladder_escalates_on_same_step(tmp_path):
+    """Three crashes at the SAME step: after the second, the ladder's
+    first rung must fire (fused kernels off via env), after the third
+    the second rung (--f32). A different-step crash does not escalate."""
+    rc, rec, obs = _supervise(
+        tmp_path,
+        [{'action': 'crash', 'steps': 5}, {'action': 'crash', 'steps': 5},
+         {'action': 'crash', 'steps': 5}, {'action': 'ok', 'steps': 5}],
+        ladder=('disable-fused', 'f32', 'shrink-mesh'),
+        argv=['--model_shards', '4'])
+    assert rc == 0
+    assert rec['outcome'] == 'completed'
+    rungs = [d['rung'] for d in rec['degradations']]
+    assert rungs == ['disable-fused', 'f32']
+    # Attempt 0/1 ran clean; the rungs appear in later attempts' env/argv.
+    assert _evidence(obs, 0)['DGMC_TPU_DISABLE_FUSED'] is None
+    assert '--f32' not in _evidence(obs, 1)['argv']
+    assert _evidence(obs, 2)['DGMC_TPU_DISABLE_FUSED'] == '1'
+    assert '--f32' in _evidence(obs, 3)['argv']
+    # shrink-mesh never fired (budget recovered before rung 3).
+    assert _flag_value(_evidence(obs, 3)['argv'],
+                       ('--model_shards',)) == '4'
+
+
+def test_progressing_preemptions_do_not_degrade(tmp_path):
+    """Heartbeat step counts are per-PROCESS and reset on every restart:
+    a run making checkpoint progress between repeated preemptions must
+    not read as stuck at one step (global step = resumed-from checkpoint
+    step + local count), so the ladder stays untouched and the run just
+    restarts."""
+    ck = tmp_path / 'ck'
+    rc, rec, _obs = _supervise(
+        tmp_path,
+        [{'action': 'crash', 'steps': 5, 'ckpt_step': 5},
+         {'action': 'crash', 'steps': 5, 'ckpt_step': 10},
+         {'action': 'crash', 'steps': 5, 'ckpt_step': 15},
+         {'action': 'ok', 'steps': 5}],
+        ladder=('disable-fused', 'f32', 'shrink-mesh'),
+        argv=['--ckpt_dir', str(ck)], ckpt_dir=str(ck))
+    assert rc == 0
+    assert rec['outcome'] == 'completed'
+    assert rec['degradations'] == []
+    assert [a['steps_completed'] for a in rec['attempts']] == \
+        [5, 10, 15, 20]
+
+
+def test_f32_rung_skips_already_f32_spellings():
+    """Any spelling of an already-f32 run (--f32, --precision f32,
+    --precision=f32) must not burn the rung on a no-op rewrite."""
+    for argv in (['--f32'], ['--precision', 'f32'], ['--precision=f32']):
+        out, _env, desc = LADDER_RUNGS['f32'](list(argv), {})
+        assert desc is None and out == argv
+    out, _env, desc = LADDER_RUNGS['f32']([], {})
+    assert '--f32' in out and desc
+
+
+def test_shrink_mesh_rung_halves_model_shards():
+    argv, env, desc = LADDER_RUNGS['shrink-mesh'](
+        ['--model_shards', '8'], {})
+    assert _flag_value(argv, ('--model_shards',)) == '4'
+    assert '8 -> 4' in desc
+    # Floor: a 1-shard mesh cannot shrink; the rung reports nothing.
+    argv, env, desc = LADDER_RUNGS['shrink-mesh'](
+        ['--model_shards', '1'], {})
+    assert desc is None
+
+
+def test_no_first_heartbeat_is_bounded(tmp_path):
+    """A child wedged BEFORE its watchdog thread exists (imports, a
+    distributed init whose peer never joins) writes neither heartbeat
+    nor hang_report: the benefit of the doubt must be bounded, not an
+    eternal proc.wait."""
+    rc, rec, _obs = _supervise(
+        tmp_path, [{'action': 'wedge-early'}, {'action': 'ok'}],
+        hang_deadline_s=0.3, first_heartbeat_s=1.0)
+    assert rc == 0
+    assert rec['outcome'] == 'completed'
+    assert rec['attempts'][0]['reason'] == 'no-first-heartbeat'
+    assert rec['attempts'][1]['reason'] == 'completed'
+
+
+def test_supervisor_provides_fault_ledger_home(tmp_path, monkeypatch):
+    """A supervised run with NEITHER --ckpt_dir nor --obs-dir still
+    needs fire-once fault semantics: the supervisor exports the
+    recovery dir as the ledger home and faults.ledger_dir picks it up."""
+    from dgmc_tpu.resilience.faults import LEDGER_ENV, ledger_dir
+    obs = tmp_path / 'obs'
+    sup = Supervisor([sys.executable, '-c', 'pass'], [],
+                     obs_dir=str(obs))
+    assert sup._base_env[LEDGER_ENV] == str(obs)
+    monkeypatch.delenv(LEDGER_ENV, raising=False)
+    assert ledger_dir(None, None) is None
+    monkeypatch.setenv(LEDGER_ENV, str(obs))
+    assert ledger_dir(None, None) == str(obs)
+    # Explicit dirs still outrank the env fallback.
+    assert ledger_dir('ck', None) == 'ck'
+    assert ledger_dir(None, str(obs / 'attempt_3')) == str(obs)
+
+
+def test_transient_spawn_failure_retries_within_budget(tmp_path,
+                                                       monkeypatch):
+    """A failed fork/exec (EAGAIN under memory pressure) is a transient
+    failure like any crash: it must consume a restart + backoff, not
+    give up instantly with budget still available."""
+    import dgmc_tpu.resilience.supervisor as sup_mod
+    real_popen = subprocess.Popen
+    calls = {'n': 0}
+
+    def flaky_popen(*a, **kw):
+        calls['n'] += 1
+        if calls['n'] == 1:
+            raise OSError(11, 'Resource temporarily unavailable')
+        return real_popen(*a, **kw)
+
+    monkeypatch.setattr(sup_mod.subprocess, 'Popen', flaky_popen)
+    rc, rec, _obs = _supervise(tmp_path, [{'action': 'ok'}])
+    assert rc == 0
+    assert rec['outcome'] == 'completed'
+    assert rec['restarts'] == 1
+    assert rec['attempts'][0]['reason'].startswith('spawn-failed')
+    assert rec['attempts'][1]['reason'] == 'completed'
+
+
+def test_persistent_spawn_failure_exhausts_budget(tmp_path):
+    obs = tmp_path / 'obs'
+    sup = Supervisor(['/nonexistent-interpreter'],
+                     ['--obs-dir', str(obs)], obs_dir=str(obs),
+                     max_restarts=1, backoff_s=0.01, poll_s=0.05)
+    rc = sup.run()
+    assert rc == 1
+    rec = json.load(open(obs / 'recovery.json'))
+    assert rec['outcome'] == 'gave-up'
+    assert len(rec['attempts']) == 2
+    assert all(a['reason'].startswith('spawn-failed')
+               for a in rec['attempts'])
+
+
+def test_stale_evidence_from_previous_session_is_cleared(tmp_path):
+    """A re-run under the same --obs-dir restarts attempt numbering at
+    0, so a previous session's deadline hang_report.json and hours-old
+    heartbeat.json are sitting in attempt_0 when the new child spawns.
+    They must be cleared pre-spawn, not read as this child's liveness
+    evidence — otherwise the supervisor kills a healthy child on its
+    first poll and can burn the whole restart budget."""
+    obs = tmp_path / 'obs'
+    stale = obs / 'attempt_0'
+    os.makedirs(stale / 'host_0')
+    json.dump({'reason': 'deadline: no event for 600.0s'},
+              open(stale / 'hang_report.json', 'w'))
+    json.dump({'time': time.time() - 3600, 'steps_completed': 1},
+              open(stale / 'heartbeat.json', 'w'))
+    json.dump({'time': time.time() - 3600, 'steps_completed': 1},
+              open(stale / 'host_0' / 'heartbeat.json', 'w'))
+    rc, rec, _obs = _supervise(
+        tmp_path, [{'action': 'ok'}], hang_deadline_s=0.3)
+    assert rc == 0
+    assert rec['outcome'] == 'completed'
+    assert rec['restarts'] == 0
+    assert [a['reason'] for a in rec['attempts']] == ['completed']
+
+
+def test_supervisor_preempted_forwards_signal(tmp_path):
+    """SIGTERM to the SUPERVISOR (scheduler preemption of the monitor
+    itself) kills the child and exits 128+signum without restarting."""
+    child = tmp_path / 'child.py'
+    child.write_text(CHILD)
+    plan = tmp_path / 'plan.json'
+    plan.write_text(json.dumps({'attempts': [{'action': 'hang'}]}))
+    obs = tmp_path / 'obs'
+    driver = tmp_path / 'driver.py'
+    driver.write_text(f'''
+import sys
+sys.path.insert(0, {str(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))!r})
+from dgmc_tpu.resilience.supervisor import Supervisor
+sup = Supervisor([sys.executable, {str(child)!r}, {str(plan)!r},
+                  {str(tmp_path / 'counter.json')!r}],
+                 ['--obs-dir', {str(obs)!r}], obs_dir={str(obs)!r},
+                 backoff_s=0.05, poll_s=0.05, grace_s=2.0)
+print('READY', flush=True)
+sys.exit(sup.run())
+''')
+    proc = subprocess.Popen([sys.executable, str(driver)],
+                            stdout=subprocess.PIPE, text=True)
+    try:
+        assert proc.stdout.readline().strip() == 'READY'
+        # Give the supervisor a beat to spawn the child, then preempt.
+        deadline = time.time() + 20
+        while time.time() < deadline and not (
+                obs / 'attempt_0' / 'heartbeat.json').exists():
+            time.sleep(0.05)
+        proc.send_signal(signal.SIGTERM)
+        rc = proc.wait(timeout=30)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait()
+    assert rc == 128 + signal.SIGTERM
+    rec = json.load(open(obs / 'recovery.json'))
+    assert rec['outcome'] == 'preempted'
+
+
+# -- argv surgery ----------------------------------------------------------
+
+def test_strip_supervisor_args():
+    assert strip_supervisor_args(
+        ['--epochs', '3', '--supervise', '--max-restarts', '2',
+         '--restart-backoff', '0.5', '--obs-dir', 'x']) == \
+        ['--epochs', '3', '--obs-dir', 'x']
+    assert strip_supervisor_args(['--max_restarts=9', 'pos']) == ['pos']
+
+
+def test_replace_flag_value_both_syntaxes():
+    assert _replace_flag_value(['--obs-dir', 'a', '--epochs', '2'],
+                               ('--obs-dir', '--obs_dir'), 'b') == \
+        ['--obs-dir', 'b', '--epochs', '2']
+    assert _replace_flag_value(['--obs_dir=a'], ('--obs-dir', '--obs_dir'),
+                               'b') == ['--obs_dir=b']
+    # Absent flag: appended.
+    assert _replace_flag_value(['--epochs', '2'], ('--obs-dir',), 'b') == \
+        ['--epochs', '2', '--obs-dir', 'b']
+
+
+def test_flag_value_reads_both_syntaxes():
+    assert _flag_value(['--model_shards', '8'], ('--model_shards',)) == '8'
+    assert _flag_value(['--model_shards=8'], ('--model_shards',)) == '8'
+    assert _flag_value([], ('--model_shards',)) is None
