@@ -19,15 +19,26 @@ a run is launched, in two tiers:
   lints over the package source for tracer leaks, host syncs inside
   jitted code, jit-inside-loop construction, and static-arg
   hashability traps.
+- **sharded-HLO tier** (:mod:`~dgmc_tpu.analysis.shd_rules`, on the
+  shared post-GSPMD walker :mod:`~dgmc_tpu.analysis.hlo_comm`): compile
+  the registered multi-device specimens under their meshes and run
+  communication rules over the partitioned HLO's collective schedule —
+  branch-divergent collectives (the static face of the multichip-hang
+  class), implicit full replication of correspondence-shaped tensors,
+  resharding churn inside the consensus loop, per-specimen
+  communication-byte budgets, and bf16-accumulation precision-contract
+  violations.
 
 A recompile-hazard pass (:mod:`~dgmc_tpu.analysis.recompile`) hashes
 abstract step signatures across padding buckets and cross-checks them
 against the ``obs`` compile telemetry of a recorded run.
 
 CLI: ``python -m dgmc_tpu.analysis.lint`` (installed as ``dgmc-lint``),
-with ``--json``, severity levels, and a committed baseline-suppression
-file (``lint-baseline.json``) so known findings don't fail CI while new
-ones do (``--fail-on new``).
+with ``--json``, severity levels, ``--select``/``--ignore`` rule
+filters, per-rule ``--explain`` docs, and a committed
+baseline-suppression file (``lint-baseline.json``) so known findings
+don't fail CI while new ones do (``--fail-on new``;
+``--prune-baseline`` retires entries that stopped reproducing).
 """
 
 from dgmc_tpu.analysis.findings import (Finding, Severity, load_baseline,
@@ -37,7 +48,10 @@ from dgmc_tpu.analysis.jaxpr_rules import (analyze_closed_jaxpr,
                                            callback_equations)
 from dgmc_tpu.analysis.source_rules import lint_source_tree, lint_source_file
 from dgmc_tpu.analysis.recompile import analyze_buckets, bucket_signature
-from dgmc_tpu.analysis.registry import default_specimens, run_trace_tier
+from dgmc_tpu.analysis.registry import (SpecimenCache, default_specimens,
+                                        run_trace_tier)
+from dgmc_tpu.analysis.hlo_comm import collective_schedule, parse_hlo_module
+from dgmc_tpu.analysis.shd_rules import analyze_sharded_hlo, run_sharded_tier
 
 __all__ = [
     'Finding',
@@ -52,6 +66,11 @@ __all__ = [
     'lint_source_file',
     'analyze_buckets',
     'bucket_signature',
+    'SpecimenCache',
     'default_specimens',
     'run_trace_tier',
+    'collective_schedule',
+    'parse_hlo_module',
+    'analyze_sharded_hlo',
+    'run_sharded_tier',
 ]

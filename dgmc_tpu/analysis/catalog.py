@@ -1,0 +1,233 @@
+"""The rule catalog: every lint rule's id, tier, severity, and doc.
+
+One structured table owns what a rule IS (``dgmc-lint --list-rules``),
+what it means (``dgmc-lint --explain RULE`` — what/why/fix), and the
+reference page (``docs/source/modules/lint-rules.rst`` enumerates the
+same entries; a test pins the two in sync). Pure data — no jax — so the
+CLI can answer ``--explain`` without bringing up a backend.
+"""
+
+import dataclasses
+from typing import Dict
+
+__all__ = ['RuleDoc', 'RULES', 'RULE_CATALOG', 'TIERS', 'explain_rule']
+
+#: Tier key -> human name (the order tiers report in).
+TIERS = {
+    'TRC': 'trace (lowered jaxpr / compiled executable)',
+    'SRC': 'source (ast lints over the package source)',
+    'RCP': 'recompile (padding-bucket churn + obs telemetry)',
+    'SHD': 'sharded HLO (post-GSPMD partitioned programs)',
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class RuleDoc:
+    """One rule's documentation: a one-line title plus what/why/fix."""
+    rule: str
+    severity: str
+    title: str
+    what: str
+    why: str
+    fix: str
+
+    @property
+    def tier(self) -> str:
+        return TIERS[self.rule[:3]]
+
+
+def _r(rule, severity, title, what, why, fix):
+    return RuleDoc(rule=rule, severity=severity, title=title, what=what,
+                   why=why, fix=fix)
+
+
+RULES: Dict[str, RuleDoc] = {d.rule: d for d in [
+    # --- trace tier ------------------------------------------------------
+    _r('TRC001', 'error',
+       'dtype promotion: 64-bit value introduced in a <=32-bit pipeline',
+       'An equation introduces an f64/i64/u64/c128 result from '
+       'non-64-bit inputs.',
+       'The pipeline is 32-bit-or-narrower by design; TPUs have no f64 '
+       'units, XLA emulates them at >10x cost, and one wide value '
+       'poisons everything downstream of it.',
+       'Find the introducing op (the finding carries per-equation '
+       'source provenance) and pin its dtype — usually a Python float '
+       'default, np.float64 constant, or an int64 index helper.'),
+    _r('TRC002', 'warning',
+       'giant constant folded into the program',
+       'A constant above --max-const-bytes (default 1 MiB) is baked '
+       'into the traced program.',
+       'Big baked-in arrays bloat every serialized executable, defeat '
+       'donation, and usually mean a dataset or lookup table was '
+       'closed over at trace time instead of being passed in.',
+       'Pass the array as an argument (donatable, shardable) instead '
+       'of closing over it.'),
+    _r('TRC003', 'error',
+       'host callback in a program expected callback-free '
+       '(probes disabled)',
+       'A host-callback equation (debug_callback / pure_callback / '
+       'io_callback) appears although probes are disabled.',
+       'The obs probe layer guarantees byte-identical HLO with probes '
+       'off; a callback here means a probe or stray jax.debug.print '
+       'leaked past its trace-time gate and will fence device->host '
+       'every step.',
+       'Gate the callback behind the probe switch (obs/probes.py) or '
+       'delete it; re-run dgmc-lint to confirm zero callback '
+       'equations.'),
+    _r('TRC004', 'error',
+       'donated argument lost its input-output aliasing',
+       'An argument was donated but the compiled executable retains no '
+       'input-output aliasing for it.',
+       'Donation silently degrades to a copy — and broken aliasing is '
+       'the defect class of the jax-0.4.37 persistent-cache bug '
+       '(executables deserialized with broken aliasing read freed '
+       'buffers).',
+       'Make the donated input shape/dtype match an output, or stop '
+       'donating it; a fresh compile must alias or the step was never '
+       'entitled to donate.'),
+    _r('TRC005', 'info',
+       'scatter without unique_indices (serial/atomic on TPU)',
+       'A scatter op without unique_indices=True.',
+       'TPU lowers it serially (or via atomics). Inherent to unsorted '
+       'GNN segment aggregation in places — the committed baseline '
+       'carries the reviewed sites; the rule catches new ones.',
+       'Prefer sorted/blocked aggregation forms (ops/blocked.py) on '
+       'hot paths; where the scatter is inherent, review and '
+       'baseline it.'),
+    _r('TRC006', 'warning',
+       'large sort where a top-k selection was intended',
+       'A sort over an axis of >= 4096 elements.',
+       'A full sort of a large axis on TPU is a multi-pass '
+       'bandwidth-bound operation; every such site in this codebase '
+       'was meant to be a streaming top-k shortlist.',
+       'Use jax.lax.top_k or the blockwise running top-k '
+       '(ops/topk.py) instead of argsort/sort.'),
+    # --- source tier -----------------------------------------------------
+    _r('SRC100', 'error', 'source file failed to parse',
+       'The source tier could not ast-parse a .py file.',
+       'An unparseable file is invisible to every source rule — the '
+       'lint would silently stop covering it.',
+       'Fix the syntax error (the finding carries the location).'),
+    _r('SRC101', 'error',
+       'tracer leak: jitted function stores to self/global',
+       'A jit-compiled function assigns a traced value to self.<attr> '
+       'or a declared global.',
+       'The stored tracer escapes the trace and poisons the next call '
+       '(UnexpectedTracerError at best, stale constants at worst).',
+       'Return the value instead of storing it, or move the store '
+       'outside the jitted function.'),
+    _r('SRC102', 'warning',
+       'host sync inside jitted code (float/int/bool/.item/np.asarray)',
+       'float(x) / int(x) / bool(x) / x.item() / np.asarray(x) on a '
+       'traced value inside jitted code.',
+       'Each forces concretization: a trace-time error under jit, or a '
+       'silent device->host fence where tracing is avoided.',
+       'Keep the value on device (jnp ops, lax.cond for control flow); '
+       'pull to host only outside the jit boundary.'),
+    _r('SRC103', 'warning', 'jax.jit constructed inside a loop',
+       'jax.jit(...) is called inside a loop body.',
+       'Every iteration builds a fresh wrapper whose compile cache is '
+       'thrown away — the textbook recompile-churn generator.',
+       'Hoist the jit construction out of the loop and reuse the '
+       'wrapper.'),
+    _r('SRC104', 'warning',
+       'static arg with an unhashable (mutable) default',
+       'static_argnums/static_argnames names a parameter whose default '
+       'is a mutable list/dict/set literal.',
+       'Static args are jit cache keys and must be hashable; the '
+       'default explodes the first time it is actually used.',
+       'Use a hashable default (tuple, frozenset, None-sentinel).'),
+    # --- recompile pass --------------------------------------------------
+    _r('RCP201', 'warning',
+       'padding bucket dominated by another (avoidable compile churn)',
+       'A padding bucket every one of whose padded dimensions is <= '
+       'another bucket of the SAME pair-batch size.',
+       'Collating into the bigger padding serves both batches with ONE '
+       'XLA program at the cost of a few masked rows; the dominated '
+       'bucket is pure compile churn. The pair-batch axis (B, '
+       '--pairs-per-step) is deliberately NOT a padding axis: padding '
+       'B replicates the whole per-pair cost and changes how many '
+       'gradient samples a step averages.',
+       'Collate into the larger node/edge padding (utils/data.'
+       'pad_pair_batch limits) so the dominated bucket disappears.'),
+    _r('RCP202', 'warning',
+       'compile events exceed what padding buckets explain',
+       'An obs-recorded run compiled more programs than its distinct '
+       'padding signatures * the per-bucket budget.',
+       'Recompiles are coming from somewhere the padding analysis '
+       'cannot see: unstable static args, trace-time Python values, '
+       'dtype flips.',
+       'Diff the compile-event labels in the obs run (timings.json) '
+       'against the padding buckets; stabilize whatever argument is '
+       'changing identity.'),
+    # --- sharded-HLO tier ------------------------------------------------
+    _r('SHD301', 'error',
+       'collective sequence diverges across sibling branches',
+       'A conditional whose branches carry different collective '
+       'sequences in the partitioned program — a collective reachable '
+       'on one control path but not its sibling.',
+       'If the predicate ever disagrees across devices (non-replicated '
+       'input, NaN-path divergence), part of the mesh posts a '
+       'collective its peers never enter and every participant blocks '
+       'forever: the static face of the rc:124 multichip-hang class '
+       '(ROADMAP item 1).',
+       'Hoist the collective out of the conditional, or make both '
+       'branches communicate identically (same kinds, same order).'),
+    _r('SHD302', 'error',
+       'implicit full replication of a correspondence-shaped tensor',
+       'An all-gather / collective-broadcast whose result is a full '
+       '[B, N_s, N_t]-shaped tensor at least as large as the '
+       "specimen's declared correspondence payload.",
+       'GSPMD inserts these silently at sharding boundaries; one of '
+       'them re-materializes on every device the S matrix the sharded '
+       'layout exists to split — at the million-entity scale of '
+       'ROADMAP item 3 that is an instant OOM.',
+       'Add a with_sharding_constraint at the producing op, or '
+       'reformulate the consumer to operate shard-locally '
+       '(shard_map, as parallel/topk.py does).'),
+    _r('SHD303', 'warning',
+       'resharding churn inside the consensus iteration body',
+       'Two or more resharding collectives (collective-permute / '
+       'all-to-all) inside one while-loop body.',
+       'The layout is bounced back and forth on EVERY consensus '
+       'iteration — communication cost that scales with num_steps '
+       'instead of being paid once.',
+       'Settle the layout before the loop: put matching sharding '
+       'constraints on the loop-carried state so GSPMD keeps one '
+       'layout through the body.'),
+    _r('SHD304', 'warning',
+       'per-step collective payload exceeds the specimen budget',
+       "The program's total collective bytes exceed the specimen's "
+       'recorded comm_budget_bytes (analysis/registry.py).',
+       'Communication budgets are recorded next to the specimen like '
+       'the recompile pass records compiles-per-bucket: silent growth '
+       'in moved bytes is how sharding regressions land unnoticed.',
+       'If the new communication is intended, raise the budget in the '
+       'registry and re-baseline; otherwise find the moved sharding '
+       'boundary (the finding lists the per-kind byte breakdown).'),
+    _r('SHD305', 'error',
+       'precision contract: f32->bf16 downcast feeds an accumulation',
+       'A reduce/dot accumulating in bf16 — worst when an explicit '
+       'f32->bf16 convert feeds it.',
+       "models/precision.py's contract is bf16 COMPUTE with f32 "
+       'ACCUMULATION: a bf16 running sum stops absorbing addends once '
+       'it is ~256x any contribution, so long reductions silently '
+       'lose mass. This is a correctness rule, not a style rule.',
+       'Set preferred_element_type=f32 on the contraction, or keep '
+       'the reduction input in f32 (cast AFTER the accumulation).'),
+]}
+
+#: ``{rule: one-line title}`` — the ``--list-rules`` table (kept under
+#: the historical name; lint.py re-exports it).
+RULE_CATALOG = {rule: doc.title for rule, doc in RULES.items()}
+
+
+def explain_rule(rule: str) -> str:
+    """The ``--explain`` rendering of one rule (raises KeyError on an
+    unknown id)."""
+    d = RULES[rule]
+    return (f'{d.rule} — {d.title}\n'
+            f'  severity: {d.severity}    tier: {d.tier}\n'
+            f'  What: {d.what}\n'
+            f'  Why:  {d.why}\n'
+            f'  Fix:  {d.fix}')

@@ -1,0 +1,461 @@
+"""Shared post-GSPMD HLO walker: computations, collectives, schedules.
+
+One parser owns every place this repo reads cross-device communication
+out of compiled programs:
+
+- :func:`collective_table` — op-kind counts and payload bytes, the
+  account ``obs/cost.py`` publishes into ``efficiency.json`` (it used to
+  carry its own line scanner; that implementation now lives here, and
+  cost imports it).
+- :func:`parse_hlo_module` / :func:`collective_schedule` — the
+  structured view the SHD lint tier
+  (:mod:`~dgmc_tpu.analysis.shd_rules`) needs: every computation
+  (ENTRY, while bodies/conditions, conditional branches, called
+  subroutines) with its ops in program order, each collective carrying
+  its kind, ``channel_id``, ``replica_groups``, payload bytes, scope
+  ``op_name`` and source provenance.
+
+Input is the text of a compiled executable (``compiled.as_text()``,
+post-SPMD-partitioning HLO — ops spelt ``all-reduce(...)``, or the
+async ``all-reduce-start``/``-done`` pair real TPU executables overlap
+with compute; a pair counts as ONE collective) or lowered StableHLO asm
+(manual ``shard_map`` collectives spelt ``stablehlo.all_reduce`` —
+handled by :func:`collective_table` only; StableHLO regions carry no
+collective schedule worth walking before partitioning).
+
+Pure text parsing — importing this module must never bring up a jax
+backend, so the CLI can analyze saved dumps anywhere.
+"""
+
+import dataclasses
+import re
+from typing import Dict, Iterator, List, Optional, Tuple
+
+__all__ = [
+    'COLLECTIVE_OPS', 'DTYPE_BYTES', 'hlo_shape_bytes', 'mlir_tensor_info',
+    'HloOp', 'HloComputation', 'HloModule', 'CollectiveOp',
+    'parse_hlo_module', 'collective_schedule', 'collective_table',
+    'trim_source_path',
+]
+
+#: Cross-device collective ops, HLO spelling (the StableHLO spelling
+#: substitutes ``_`` for ``-``).
+COLLECTIVE_OPS = ('all-reduce', 'all-gather', 'reduce-scatter',
+                  'all-to-all', 'collective-permute',
+                  'collective-broadcast')
+
+DTYPE_BYTES = {
+    'f64': 8, 'f32': 4, 'f16': 2, 'bf16': 2, 'f8e4m3fn': 1, 'f8e5m2': 1,
+    'c64': 8, 'c128': 16,
+    's64': 8, 's32': 4, 's16': 2, 's8': 1,
+    'i64': 8, 'i32': 4, 'i16': 2, 'i8': 1, 'i4': 1, 'i1': 1,
+    'u64': 8, 'u32': 4, 'u16': 2, 'u8': 1, 'ui64': 8, 'ui32': 4,
+    'ui16': 2, 'ui8': 1, 'pred': 1,
+}
+
+# `f32[128,4]` — layout suffixes (`{1,0}`) deliberately unmatched.
+_HLO_SHAPE = re.compile(r'([a-z][a-z0-9]*)\[([0-9,]*)\]')
+# MLIR `tensor<8x16xf32>` types (StableHLO asm).
+_MLIR_TENSOR = re.compile(r'tensor<(?:([0-9x?]*)x)?([a-z][a-z0-9]*)>')
+
+
+def hlo_shape_bytes(text: str) -> int:
+    """Sum of payload bytes over every HLO shape literal in ``text``."""
+    total = 0
+    for dtype, dims in _HLO_SHAPE.findall(text):
+        n = 1
+        for d in dims.split(','):
+            if d:
+                n *= int(d)
+        total += n * DTYPE_BYTES.get(dtype, 4)
+    return total
+
+
+def mlir_tensor_info(dims: str, dtype: str) -> Tuple[int, int]:
+    """(element_count, bytes) for one parsed MLIR ``tensor<...>`` type."""
+    n = 1
+    if dims:
+        for d in dims.split('x'):
+            if d in ('', '?'):
+                continue
+            n *= int(d)
+    return n, n * DTYPE_BYTES.get(dtype, 4)
+
+
+def _shape_dims(type_text: str) -> Optional[Tuple[str, Tuple[int, ...]]]:
+    """(dtype, dims) of the FIRST array shape in an HLO type string;
+    None for token/opaque/empty types."""
+    m = _HLO_SHAPE.search(type_text)
+    if not m:
+        return None
+    dims = tuple(int(d) for d in m.group(2).split(',') if d)
+    return m.group(1), dims
+
+
+def trim_source_path(fname: str) -> str:
+    """Stabilize an absolute source path across checkouts/venvs — keep
+    everything from the last ``site-packages``/repo-ish component (the
+    same normalization :func:`~dgmc_tpu.analysis.jaxpr_rules.
+    eqn_provenance` applies to jaxpr source info)."""
+    for marker in ('site-packages/', 'dist-packages/'):
+        if marker in fname:
+            return fname.split(marker, 1)[1]
+    parts = fname.split('/')
+    for anchor in ('dgmc_tpu', 'tests', 'examples', 'benchmarks'):
+        if anchor in parts:
+            return '/'.join(parts[parts.index(anchor):])
+    return fname
+
+
+# ---------------------------------------------------------------------------
+# Structured HLO module parsing
+# ---------------------------------------------------------------------------
+
+# `ENTRY %main.10_spmd (param: f32[4,4]) -> f32[] {` / `%region_2.30 (...`
+_COMP_HEADER = re.compile(
+    r'^(ENTRY\s+)?%?([\w.\-]+)\s*\(.*\)\s*->\s*.+\{\s*$')
+# `  %x = f32[4,4]{1,0} all-reduce(...)`, `  ROOT %y = (s32[], f32[]) ...`
+_OP_LINE = re.compile(
+    r'^\s*(ROOT\s+)?%?([\w.\-]+)\s*=\s*(\(.*?\)|\S+)\s+([\w\-]+)\(')
+_CHANNEL_ID = re.compile(r'channel_id=(\d+)')
+_REGION_REF = re.compile(
+    r'\b(condition|body|true_computation|false_computation|to_apply|'
+    r'calls)=%?([\w.\-]+)')
+_BRANCHES = re.compile(r'branch_computations=\{([^}]*)\}')
+_METADATA_OP_NAME = re.compile(r'op_name="([^"]*)"')
+_METADATA_SOURCE = re.compile(
+    r'source_file="([^"]*)"(?:\s+source_line=(\d+))?')
+
+
+def _replica_groups(line: str) -> Optional[str]:
+    """The raw ``replica_groups=`` value: either the brace list
+    ``{{0,1},{2,3}}`` or the iota form ``[2,2]<=[4]`` /
+    ``[2,2]<=[2,2]T(1,0)`` — consumed with bracket balancing, not a
+    regex, because the brace form nests commas."""
+    key = 'replica_groups='
+    start = line.find(key)
+    if start < 0:
+        return None
+    i = start + len(key)
+    depth = 0
+    out = []
+    while i < len(line):
+        c = line[i]
+        if c in '{[(':
+            depth += 1
+        elif c in '}])':
+            depth -= 1
+            if depth < 0:
+                break
+        elif c == ',' and depth == 0:
+            break
+        elif c == ' ' and depth == 0 and out and out[-1] not in '<=':
+            break
+        out.append(c)
+        i += 1
+    return ''.join(out) or None
+
+
+@dataclasses.dataclass
+class HloOp:
+    """One parsed HLO instruction."""
+    result: str
+    result_type: str
+    opcode: str
+    line: str
+    is_root: bool = False
+
+    @property
+    def collective_kind(self) -> Optional[str]:
+        """Base collective kind (``-start`` normalized away; ``-done``
+        and non-collectives return None — an async pair is counted at
+        its ``-start``)."""
+        op = self.opcode
+        if op.endswith('-done'):
+            return None
+        if op.endswith('-start'):
+            op = op[:-len('-start')]
+        return op if op in COLLECTIVE_OPS else None
+
+    @property
+    def channel_id(self) -> Optional[int]:
+        m = _CHANNEL_ID.search(self.line)
+        return int(m.group(1)) if m else None
+
+    @property
+    def replica_groups(self) -> Optional[str]:
+        return _replica_groups(self.line)
+
+    @property
+    def op_name(self) -> str:
+        """The scope path from ``metadata={op_name=...}`` (GSPMD copies
+        it from the op that demanded the communication)."""
+        m = _METADATA_OP_NAME.search(self.line)
+        return m.group(1) if m else ''
+
+    @property
+    def source_loc(self) -> Optional[str]:
+        """``relative/file.py:line`` from op metadata, or None."""
+        m = _METADATA_SOURCE.search(self.line)
+        if not m or not m.group(1):
+            return None
+        path = trim_source_path(m.group(1))
+        return f'{path}:{m.group(2)}' if m.group(2) else path
+
+    @property
+    def result_bytes(self) -> int:
+        """Payload bytes of the result type (tuple results — e.g. an
+        async ``-start`` wrapping bookkeeping shapes — sum every listed
+        shape: an upper bound close enough for attribution)."""
+        return hlo_shape_bytes(self.result_type)
+
+    @property
+    def result_shape(self) -> Optional[Tuple[str, Tuple[int, ...]]]:
+        return _shape_dims(self.result_type)
+
+    def operands(self) -> List[Tuple[str, Tuple[int, ...], str]]:
+        """``(dtype, dims, %name)`` for each typed operand in the call
+        parens — HLO text carries operand types inline."""
+        start = self.line.find(self.opcode + '(')
+        if start < 0:
+            return []
+        start += len(self.opcode) + 1
+        depth = 1
+        i = start
+        while i < len(self.line) and depth:
+            if self.line[i] == '(':
+                depth += 1
+            elif self.line[i] == ')':
+                depth -= 1
+            i += 1
+        args = self.line[start:i - 1]
+        # Split on top-level commas only — shape dims (`f32[4,8]`) and
+        # nested tuples carry commas of their own.
+        pieces, depth, cur = [], 0, []
+        for c in args:
+            if c in '([{':
+                depth += 1
+            elif c in ')]}':
+                depth -= 1
+            if c == ',' and depth == 0:
+                pieces.append(''.join(cur))
+                cur = []
+            else:
+                cur.append(c)
+        if cur:
+            pieces.append(''.join(cur))
+        out = []
+        for piece in pieces:
+            m = re.search(r'([a-z][a-z0-9]*)\[([0-9,]*)\][^%]*%([\w.\-]+)',
+                          piece)
+            if m:
+                dims = tuple(int(d) for d in m.group(2).split(',') if d)
+                out.append((m.group(1), dims, m.group(3)))
+        return out
+
+    def called_computations(self) -> List[str]:
+        """Region computations this op enters: while body/condition,
+        conditional branches, ``call``/``fusion`` targets. ``to_apply``
+        is a region only for ``call``-like ops — on reductions and
+        collectives it names the scalar combiner, which cannot hold
+        collectives and whose shared clones would be double-walked."""
+        out = []
+        for kind, name in _REGION_REF.findall(self.line):
+            if kind == 'to_apply' and self.opcode not in ('call',
+                                                         'async-start'):
+                continue
+            out.append(name)
+        m = _BRANCHES.search(self.line)
+        if m:
+            out.extend(n.strip().lstrip('%')
+                       for n in m.group(1).split(',') if n.strip())
+        return out
+
+    def branch_computations(self) -> List[str]:
+        """Branch regions of a ``conditional`` (either spelling), in
+        branch order; empty for other ops."""
+        if self.opcode != 'conditional':
+            return []
+        m = _BRANCHES.search(self.line)
+        if m:
+            return [n.strip().lstrip('%')
+                    for n in m.group(1).split(',') if n.strip()]
+        refs = dict((k, v) for k, v in _REGION_REF.findall(self.line))
+        out = []
+        for key in ('true_computation', 'false_computation'):
+            if key in refs:
+                out.append(refs[key])
+        return out
+
+
+@dataclasses.dataclass
+class HloComputation:
+    name: str
+    is_entry: bool
+    ops: List[HloOp]
+
+
+@dataclasses.dataclass
+class HloModule:
+    computations: Dict[str, HloComputation]
+    entry: Optional[str]
+
+    def iter_ops(self) -> Iterator[Tuple[HloComputation, HloOp]]:
+        for comp in self.computations.values():
+            for op in comp.ops:
+                yield comp, op
+
+    def while_bodies(self) -> List[Tuple[HloOp, str]]:
+        """``(while_op, body_computation_name)`` for every while."""
+        out = []
+        for _, op in self.iter_ops():
+            if op.opcode != 'while':
+                continue
+            refs = dict(_REGION_REF.findall(op.line))
+            if 'body' in refs:
+                out.append((op, refs['body']))
+        return out
+
+    def flatten_collectives(self, comp_name: str,
+                            _seen: Optional[frozenset] = None,
+                            ) -> List['CollectiveOp']:
+        """Collectives reachable from ``comp_name``, program order,
+        descending into regions (a while body contributes once — its
+        per-iteration repetition is a schedule property, not an op
+        count)."""
+        comp = self.computations.get(comp_name)
+        if comp is None:
+            return []
+        seen = (_seen or frozenset()) | {comp_name}
+        out = []
+        for op in comp.ops:
+            kind = op.collective_kind
+            if kind is not None:
+                out.append(CollectiveOp.from_op(kind, op, comp_name))
+            for sub in op.called_computations():
+                if sub not in seen:
+                    out.extend(self.flatten_collectives(sub, seen))
+        return out
+
+
+@dataclasses.dataclass
+class CollectiveOp:
+    """One collective in a program's communication schedule."""
+    kind: str
+    channel_id: Optional[int]
+    replica_groups: Optional[str]
+    nbytes: int
+    computation: str
+    op_name: str
+    source_loc: Optional[str]
+    line: str
+
+    @classmethod
+    def from_op(cls, kind: str, op: HloOp, comp_name: str):
+        return cls(kind=kind, channel_id=op.channel_id,
+                   replica_groups=op.replica_groups,
+                   nbytes=op.result_bytes, computation=comp_name,
+                   op_name=op.op_name, source_loc=op.source_loc,
+                   line=op.line)
+
+
+def parse_hlo_module(text: str) -> HloModule:
+    """Parse compiled-HLO text into computations of ops (program order
+    preserved). Lines outside any computation (module header, config)
+    are ignored; a malformed line is skipped, never fatal — the walker
+    is a reader of compiler output, not a validator."""
+    computations: Dict[str, HloComputation] = {}
+    entry = None
+    current: Optional[HloComputation] = None
+    for raw in text.splitlines():
+        stripped = raw.strip()
+        m = _COMP_HEADER.match(stripped)
+        if m and ' = ' not in stripped:
+            current = HloComputation(name=m.group(2),
+                                     is_entry=bool(m.group(1)), ops=[])
+            computations[current.name] = current
+            if current.is_entry:
+                entry = current.name
+            continue
+        if stripped == '}':
+            current = None
+            continue
+        m = _OP_LINE.match(raw)
+        if m:
+            if current is None:
+                # Headerless fragments (saved snippets, test fixtures):
+                # collect loose ops under an implicit computation.
+                current = computations.setdefault(
+                    '<module>', HloComputation('<module>', False, []))
+            current.ops.append(HloOp(
+                result=m.group(2), result_type=m.group(3),
+                opcode=m.group(4), line=stripped,
+                is_root=bool(m.group(1))))
+    return HloModule(computations=computations, entry=entry)
+
+
+def collective_schedule(text_or_module) -> List[CollectiveOp]:
+    """The program's collective schedule: every collective reachable
+    from ENTRY in program order, descending through while bodies/
+    conditions, conditional branches, and calls. This is what the SHD
+    rules consume — op kind, replica groups, channel ids, payload
+    bytes, and the region each collective sits in."""
+    module = (text_or_module if isinstance(text_or_module, HloModule)
+              else parse_hlo_module(text_or_module))
+    if module.entry is None:
+        # Fixture fragments without an ENTRY marker: treat the first
+        # computation as the program.
+        names = list(module.computations)
+        if not names:
+            return []
+        return module.flatten_collectives(names[0])
+    return module.flatten_collectives(module.entry)
+
+
+# ---------------------------------------------------------------------------
+# Aggregate table (the obs/cost.py account)
+# ---------------------------------------------------------------------------
+
+
+def _stablehlo_collective_table(text: str) -> Dict[str, Dict[str, int]]:
+    ops: Dict[str, Dict[str, int]] = {}
+    for line in text.splitlines():
+        for name in COLLECTIVE_OPS:
+            if 'stablehlo.' + name.replace('-', '_') not in line:
+                continue
+            row = ops.setdefault(name, {'count': 0, 'bytes': 0})
+            row['count'] += 1
+            tensors = _MLIR_TENSOR.findall(line)
+            if tensors:
+                _, nbytes = mlir_tensor_info(tensors[-1][0] or '',
+                                             tensors[-1][1])
+                row['bytes'] += nbytes
+            break
+    return ops
+
+
+def collective_table(text: str) -> Dict:
+    """Collective-op counts and result bytes from program text.
+
+    Accepts post-GSPMD compiled HLO (structured parse — every
+    computation's ops, async ``-start``/``-done`` pairs counted once)
+    and StableHLO asm (manual ``shard_map`` collectives, line scan).
+    Returns ``{'ops': {name: {'count', 'bytes'}}, 'count', 'bytes'}``
+    (empty ``ops`` when the program moves nothing between devices).
+    This is the single collective accounting both ``obs/cost.py`` and
+    the SHD lint tier build on.
+    """
+    if 'stablehlo.' in text:
+        ops = _stablehlo_collective_table(text)
+    else:
+        ops = {}
+        for _, op in parse_hlo_module(text).iter_ops():
+            kind = op.collective_kind
+            if kind is None:
+                continue
+            row = ops.setdefault(kind, {'count': 0, 'bytes': 0})
+            row['count'] += 1
+            row['bytes'] += op.result_bytes
+    return {'ops': ops,
+            'count': sum(r['count'] for r in ops.values()),
+            'bytes': sum(r['bytes'] for r in ops.values())}

@@ -6,13 +6,22 @@ Usage::
     dgmc-lint --write-baseline          # record current findings
     dgmc-lint --json --fail-on new      # CI gate: fail on un-baselined
     dgmc-lint --obs-dir runs/obs_pf     # + recompile telemetry cross-check
+    dgmc-lint --explain SHD301          # one rule's what/why/fix
+    dgmc-lint --select SHD301,SHD305    # only these rules
+    dgmc-lint --ignore TRC005           # drop these rules
+    dgmc-lint --prune-baseline          # drop stale baseline entries
 
 Tiers (each skippable): ``--skip-trace`` (lower + walk the registered
 hot functions), ``--skip-source`` (ast lints over the package source),
-``--skip-recompile`` (padding-bucket churn). The recompile pass needs a
-recorded run's buckets: it runs only when ``--obs-dir`` is given —
-padding buckets are a runtime artifact, there is nothing to analyze
-statically without one.
+``--skip-recompile`` (padding-bucket churn), ``--skip-sharded`` (SHD
+rules over the post-GSPMD partitioned HLO of the multi-device
+specimens — needs enough devices; CI forces 8 virtual CPU devices so
+the tier runs on every push). The recompile pass needs a recorded
+run's buckets: it runs only when ``--obs-dir`` is given — padding
+buckets are a runtime artifact, there is nothing to analyze statically
+without one. The trace and sharded tiers share one build/trace/lower/
+compile per specimen (:class:`~dgmc_tpu.analysis.registry.
+SpecimenCache`).
 
 Exit status: 0 clean under the ``--fail-on`` policy, 1 otherwise, 2 on
 usage errors. ``--fail-on`` policies: ``new`` (default — findings not in
@@ -26,36 +35,20 @@ import os
 import sys
 
 from dgmc_tpu.analysis import findings as findings_mod
+from dgmc_tpu.analysis.catalog import RULE_CATALOG, explain_rule
 from dgmc_tpu.analysis.findings import (Severity, default_baseline_path,
                                         load_baseline, sort_findings,
                                         split_by_baseline, write_baseline)
 
-RULE_CATALOG = {
-    'TRC001': 'dtype promotion: 64-bit value introduced in a <=32-bit '
-              'pipeline',
-    'TRC002': 'giant constant folded into the program',
-    'TRC003': 'host callback in a program expected callback-free '
-              '(probes disabled)',
-    'TRC004': 'donated argument lost its input-output aliasing',
-    'TRC005': 'scatter without unique_indices (serial/atomic on TPU)',
-    'TRC006': 'large sort where a top-k selection was intended',
-    'SRC100': 'source file failed to parse',
-    'SRC101': 'tracer leak: jitted function stores to self/global',
-    'SRC102': 'host sync inside jitted code (float/int/bool/.item/'
-              'np.asarray)',
-    'SRC103': 'jax.jit constructed inside a loop',
-    'SRC104': 'static arg with an unhashable (mutable) default',
-    'RCP201': 'padding bucket dominated by another (avoidable compile '
-              'churn)',
-    'RCP202': 'compile events exceed what padding buckets explain',
-}
+__all__ = ['RULE_CATALOG', 'build_parser', 'collect_findings', 'main']
 
 
 def build_parser():
     p = argparse.ArgumentParser(
         prog='dgmc-lint',
         description='Static TPU-hostility analysis: jaxpr/HLO trace '
-                    'rules, source ast lints, recompile-hazard checks.')
+                    'rules, source ast lints, recompile-hazard checks, '
+                    'and sharded-HLO communication rules.')
     p.add_argument('--json', action='store_true',
                    help='emit the machine-readable report on stdout')
     p.add_argument('--baseline', default=None,
@@ -65,21 +58,36 @@ def build_parser():
     p.add_argument('--write-baseline', action='store_true',
                    help='record the current findings as the baseline '
                         'and exit 0')
+    p.add_argument('--prune-baseline', action='store_true',
+                   help='drop baseline entries whose finding no longer '
+                        'reproduces (tiers/specimens/rules not analyzed '
+                        'in this run are preserved) and exit 0')
     p.add_argument('--fail-on', choices=('new', 'error', 'any', 'none'),
                    default='new',
                    help='exit-1 policy (default: new — findings not in '
                         'the baseline)')
     p.add_argument('--min-severity', default='info',
-                   help='drop findings below this severity '
-                        '(info|warning|error)')
-    p.add_argument('--rules', default=None,
-                   help='comma-separated rule ids to keep (default all)')
+                   help='drop findings below this severity from the '
+                        'report and the --fail-on policy '
+                        '(info|warning|error); baseline rewrites '
+                        '(--write-baseline/--prune-baseline) ignore it '
+                        'so a filtered run cannot un-suppress reviewed '
+                        'lower-severity entries')
+    p.add_argument('--select', '--rules', dest='select', default=None,
+                   help='comma-separated rule ids to keep (default all; '
+                        'tiers none of whose rules survive the filter '
+                        'are skipped entirely; --rules is the legacy '
+                        'spelling)')
+    p.add_argument('--ignore', default=None,
+                   help='comma-separated rule ids to drop')
     p.add_argument('--skip-trace', action='store_true',
                    help='skip the jaxpr/HLO trace tier')
     p.add_argument('--skip-source', action='store_true',
                    help='skip the source ast tier')
     p.add_argument('--skip-recompile', action='store_true',
                    help='skip the padding-bucket recompile pass')
+    p.add_argument('--skip-sharded', action='store_true',
+                   help='skip the sharded-HLO (SHD) tier')
     p.add_argument('--source-root', default=None,
                    help='source tree to lint (default: the installed '
                         'dgmc_tpu package)')
@@ -88,16 +96,34 @@ def build_parser():
                         'buckets + compile telemetry (RCP202)')
     p.add_argument('--max-const-bytes', type=int, default=None,
                    help='TRC002 threshold in bytes (default 1 MiB)')
+    p.add_argument('--comm-budget-bytes', type=int, default=None,
+                   help='SHD304 fallback budget for specimens without '
+                        'their own comm_budget_bytes (default: only '
+                        'per-specimen budgets fire)')
     p.add_argument('--list-rules', action='store_true',
                    help='print the rule catalog and exit')
+    p.add_argument('--explain', default=None, metavar='RULE[,RULE...]',
+                   help="print the rule's what/why/fix doc and exit "
+                        '(see also docs/source/modules/lint-rules.rst)')
     return p
 
 
 def collect_findings(args, progress):
-    """``(findings, skipped_specimens)`` for the enabled tiers."""
+    """``(findings, skipped_specimens)`` for the enabled tiers.
+
+    A tier runs only when it can still produce a selected rule: with
+    ``--select SRC101`` there is no reason to pay the trace/SHD tiers'
+    specimen compiles (the dominant lint cost) for findings the filter
+    is guaranteed to drop. ``_rules_analyzed`` — also the baseline
+    writers' preservation set — is the single source of that truth."""
+    rules = _rules_analyzed(args)
+
+    def tier_on(prefix):
+        return any(r.startswith(prefix) for r in rules)
+
     out = []
     skipped = []
-    if not args.skip_source:
+    if tier_on('SRC'):
         from dgmc_tpu.analysis.source_rules import lint_source_tree
         root = args.source_root
         if root is None:
@@ -105,7 +131,11 @@ def collect_findings(args, progress):
             root = os.path.dirname(os.path.abspath(dgmc_tpu.__file__))
         progress(f'source tier: {root}')
         out.extend(lint_source_tree(root))
-    if not args.skip_recompile and args.obs_dir:
+    if tier_on('RCP'):
+        # _rules_analyzed drops RCP without --obs-dir: padding buckets
+        # are a runtime artifact, there is nothing to analyze
+        # statically. (The trace tier's fixed shapes are already one
+        # program each by construction.)
         from dgmc_tpu.analysis.recompile import (analyze_buckets,
                                                  load_obs_buckets)
         buckets, events = load_obs_buckets(args.obs_dir)
@@ -113,35 +143,62 @@ def collect_findings(args, progress):
                  f'from {args.obs_dir}')
         out.extend(analyze_buckets(buckets, specimen='obs',
                                    compile_events=events))
-        # Without an obs dir there is nothing to analyze statically —
-        # buckets are a runtime artifact. (The trace tier's fixed shapes
-        # are already one program each by construction.)
-    if not args.skip_trace:
+    cache = None
+    if tier_on('TRC') or tier_on('SHD'):
+        from dgmc_tpu.analysis.registry import SpecimenCache
+        cache = SpecimenCache()
+    if tier_on('TRC'):
         from dgmc_tpu.analysis.registry import run_trace_tier
         out.extend(run_trace_tier(const_bytes=args.max_const_bytes,
-                                  on_progress=progress, skipped=skipped))
+                                  on_progress=progress, skipped=skipped,
+                                  cache=cache))
+    if tier_on('SHD'):
+        from dgmc_tpu.analysis.shd_rules import run_sharded_tier
+        out.extend(run_sharded_tier(
+            cache=cache, comm_budget_bytes=args.comm_budget_bytes,
+            on_progress=progress, skipped=skipped))
     return out, skipped
 
 
+def _rules_analyzed(args):
+    """The rule-id set this run can produce, given tier skips and
+    select/ignore filters — everything OUTSIDE it is preserved on
+    baseline rewrites."""
+    rules = set(RULE_CATALOG)
+    if args.skip_trace:
+        rules -= {r for r in rules if r.startswith('TRC')}
+    if args.skip_source:
+        rules -= {r for r in rules if r.startswith('SRC')}
+    if args.skip_recompile or not args.obs_dir:
+        rules -= {r for r in rules if r.startswith('RCP')}
+    if args.skip_sharded:
+        rules -= {r for r in rules if r.startswith('SHD')}
+    if args.select:
+        rules &= _parse_rules(args.select)
+    if args.ignore:
+        rules -= _parse_rules(args.ignore)
+    return rules
+
+
 def _entries_not_analyzed(prior_baseline, args, skipped_specimens):
-    """Prior-baseline entries whose producing tier/specimen this run did
-    not analyze — preserved verbatim on ``--write-baseline`` so a
-    refresh from a smaller environment (fewer devices, a skipped tier)
-    cannot silently un-suppress findings CI will still produce."""
+    """Prior-baseline entries whose producing tier/specimen/rule this
+    run did not analyze — preserved verbatim on ``--write-baseline`` /
+    ``--prune-baseline`` so a refresh from a smaller environment (fewer
+    devices, a skipped tier, a --select subset) cannot silently
+    un-suppress findings CI will still produce."""
     skipped = set(skipped_specimens)
+    analyzed_rules = _rules_analyzed(args)
     keep = []
     for e in prior_baseline.values():
         rule = e.get('rule', '')
         specimen = e.get('where', '').split(':', 1)[0]
-        if rule.startswith('TRC') and (args.skip_trace
-                                       or specimen in skipped):
-            keep.append(e)
-        elif rule.startswith('SRC') and args.skip_source:
-            keep.append(e)
-        elif rule.startswith('RCP') and (args.skip_recompile
-                                         or not args.obs_dir):
+        if rule not in analyzed_rules or specimen in skipped:
             keep.append(e)
     return keep
+
+
+def _parse_rules(spec):
+    return {r.strip() for r in spec.split(',') if r.strip()}
 
 
 def render_text(report, stream=sys.stdout):
@@ -166,6 +223,20 @@ def main(argv=None):
         for rule, desc in sorted(RULE_CATALOG.items()):
             print(f'{rule}  {desc}')
         return 0
+    if args.explain:
+        rules = sorted(_parse_rules(args.explain))
+        unknown = [r for r in rules if r not in RULE_CATALOG]
+        if unknown:
+            print(f'dgmc-lint: unknown rule id(s): {unknown} '
+                  f'(--list-rules prints the catalog)', file=sys.stderr)
+            return 2
+        print('\n\n'.join(explain_rule(r) for r in rules))
+        return 0
+    if args.write_baseline and args.prune_baseline:
+        print('dgmc-lint: --write-baseline and --prune-baseline are '
+              'mutually exclusive (regenerate OR prune)',
+              file=sys.stderr)
+        return 2
 
     quiet = args.json
 
@@ -178,14 +249,13 @@ def main(argv=None):
     except ValueError as e:
         print(f'dgmc-lint: {e}', file=sys.stderr)
         return 2
-    keep_rules = (set(r.strip() for r in args.rules.split(',') if r.strip())
-                  if args.rules else None)
-    if keep_rules is not None:
-        unknown = keep_rules - set(RULE_CATALOG)
-        if unknown:
-            print(f'dgmc-lint: unknown rule id(s): {sorted(unknown)}',
-                  file=sys.stderr)
-            return 2
+    keep_rules = _parse_rules(args.select) if args.select else None
+    drop_rules = _parse_rules(args.ignore) if args.ignore else set()
+    unknown = ((keep_rules or set()) | drop_rules) - set(RULE_CATALOG)
+    if unknown:
+        print(f'dgmc-lint: unknown rule id(s): {sorted(unknown)}',
+              file=sys.stderr)
+        return 2
 
     if args.obs_dir and not os.path.exists(
             os.path.join(args.obs_dir, 'timings.json')):
@@ -196,10 +266,17 @@ def main(argv=None):
         return 2
 
     found, skipped_specimens = collect_findings(args, progress)
-    found = [f for f in found if f.severity >= min_sev]
     if keep_rules is not None:
         found = [f for f in found if f.rule in keep_rules]
+    if drop_rules:
+        found = [f for f in found if f.rule not in drop_rules]
     found = sort_findings(found)
+    # --min-severity filters the REPORT only. Baseline rewrites work on
+    # the unfiltered set: `--prune-baseline --min-severity error` must
+    # not classify still-reproducing warning/info suppressions as stale
+    # (_entries_not_analyzed protects skipped tiers/rules/specimens,
+    # but severity is a per-finding property it cannot see).
+    reported = [f for f in found if f.severity >= min_sev]
 
     baseline_path = args.baseline or default_baseline_path()
     if args.write_baseline:
@@ -211,23 +288,45 @@ def main(argv=None):
                     f'specimens not analyzed here)' if preserved else '')
             print(f'dgmc-lint: wrote {len(found)} finding(s) to '
                   f'{baseline_path}{kept}')
+    elif args.prune_baseline:
+        prior = load_baseline(baseline_path)
+        produced = {f.fingerprint for f in found}
+        protected = {e['fingerprint'] for e in _entries_not_analyzed(
+            prior, args, skipped_specimens)}
+        stale = [e for fp, e in prior.items()
+                 if fp not in produced and fp not in protected]
+        kept = [e for fp, e in prior.items()
+                if fp in produced or fp in protected]
+        # Prune only: kept entries pass through verbatim, nothing is
+        # added — accepting NEW findings stays an explicit
+        # --write-baseline review.
+        write_baseline(baseline_path, (), preserved_entries=kept)
+        if not quiet:
+            print(f'dgmc-lint: pruned {len(stale)} stale entr'
+                  f'{"y" if len(stale) == 1 else "ies"} from '
+                  f'{baseline_path} ({len(kept)} kept)')
+            for e in stale:
+                print(f'  - {e.get("rule")} {e.get("where")}')
+        return 0
 
     baseline = load_baseline(baseline_path)
-    new, suppressed = split_by_baseline(found, baseline)
+    new, suppressed = split_by_baseline(reported, baseline)
 
     report = {
         'tool': 'dgmc-lint',
         'baseline': baseline_path if baseline or args.write_baseline
         else None,
-        'findings': [f.to_json() for f in found],
+        'findings': [f.to_json() for f in reported],
         'new': [f.fingerprint for f in new],
         'summary': {
-            'total': len(found),
+            'total': len(reported),
             'new': len(new),
             'suppressed': len(suppressed),
-            'errors': sum(f.severity == Severity.ERROR for f in found),
-            'warnings': sum(f.severity == Severity.WARNING for f in found),
-            'infos': sum(f.severity == Severity.INFO for f in found),
+            'errors': sum(f.severity == Severity.ERROR
+                          for f in reported),
+            'warnings': sum(f.severity == Severity.WARNING
+                            for f in reported),
+            'infos': sum(f.severity == Severity.INFO for f in reported),
         },
     }
     if args.json:
@@ -240,7 +339,7 @@ def main(argv=None):
     if args.write_baseline or args.fail_on == 'none':
         return 0
     if args.fail_on == 'any':
-        return 1 if found else 0
+        return 1 if reported else 0
     if args.fail_on == 'error':
         return 1 if any(f.severity == Severity.ERROR for f in new) else 0
     return 1 if new else 0                                   # 'new'

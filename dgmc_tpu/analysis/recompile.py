@@ -10,10 +10,16 @@ persistent cache correctly.
 Two findings:
 
 ``RCP201`` avoidable-compile-churn
-    A bucket is *dominated* by another (every dimension <=): collating
-    into the bigger bucket's padding would serve both batches with ONE
-    program at the cost of a few masked rows. Dominated buckets are pure
-    churn.
+    A bucket is *dominated* by another (every padded dimension <= at the
+    SAME pair-batch size): collating into the bigger bucket's padding
+    would serve both batches with ONE program at the cost of a few
+    masked rows. Dominated buckets are pure churn. The pair-batch axis
+    (``B`` — ``--pairs-per-step`` replicas x pairs, PR 6's batched hot
+    loop) is deliberately NOT a padding axis: padding ``B`` up
+    replicates the entire per-pair cost (not a few masked rows) and
+    changes how many independent gradient samples one step averages, so
+    buckets that differ only in ``B`` are distinct programs by design,
+    never churn.
 ``RCP202`` compile-churn-telemetry
     Cross-check against a recorded ``obs`` run (``--obs-dir``): the run
     compiled far more programs than its distinct padding buckets can
@@ -113,8 +119,14 @@ def analyze_buckets(buckets: Sequence[Dict], *, specimen='padding',
     findings = []
     dims = [(_dims(b), b) for b in buckets]
     for d, b in dims:
+        # Domination holds the pair-batch axis fixed (od[0] == d[0]):
+        # B is a structural axis — a B=1 batch cannot ride a B=2
+        # program without doubling the step's work and changing its
+        # gradient semantics — so only the node/edge PADDING axes are
+        # collatable.
         dominators = [ob for od, ob in dims
-                      if od != d and all(x >= y for x, y in zip(od, d))]
+                      if od != d and od[0] == d[0]
+                      and all(x >= y for x, y in zip(od, d))]
         if dominators:
             dom = max(dominators, key=lambda ob: _dims(ob))
             findings.append(Finding(

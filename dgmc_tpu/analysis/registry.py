@@ -22,13 +22,15 @@ aliasing, scatter forms — is shape-independent, and small specimens keep
 
 import contextlib
 import dataclasses
-from typing import Callable, Dict, List, Optional
+import warnings
+from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
 from dgmc_tpu.analysis.findings import Finding
-from dgmc_tpu.analysis.jaxpr_rules import (TraceContext, analyze_closed_jaxpr,
-                                           analyze_donation)
+from dgmc_tpu.analysis.jaxpr_rules import (TraceContext,
+                                           analyze_closed_jaxpr,
+                                           compiled_donation_findings)
 
 
 @dataclasses.dataclass
@@ -37,12 +39,20 @@ class Specimen:
 
     ``build()`` returns ``{'fn': callable, 'args': tuple}`` plus
     optional ``'donate_argnums'`` (tuple — run the donation-aliasing
-    rule) and ``'expect_no_callbacks'`` (default True).
+    rule), ``'prejitted'`` (the callable is already jitted, e.g. with
+    its own ``in_shardings``), ``'corr_bytes'`` (full correspondence-
+    matrix payload in bytes — arms the SHD302 replication rule) and
+    ``'comm_budget_bytes'`` (per-step collective-byte budget — arms
+    SHD304, recorded here like the recompile pass's compiles-per-bucket
+    budget).
     """
     name: str
     build: Callable[[], Dict]
     #: None = always runnable; else the minimum jax.devices() count.
     min_devices: int = 0
+    #: Which lint tiers analyze this specimen: ``'trace'`` (jaxpr +
+    #: donation rules) and/or ``'shd'`` (post-GSPMD sharded-HLO rules).
+    tiers: Tuple[str, ...] = ('trace',)
 
 
 @contextlib.contextmanager
@@ -200,8 +210,132 @@ def _sharded_train_step_specimen():
     return build
 
 
+def _sharded_forward_rows_specimen():
+    """Row-sharded S forward (ROADMAP item 3's layout): the dense DGMC
+    forward with the correspondence matrix constrained to
+    ``corr_spec()`` — batch over ``data``, source-node rows over
+    ``model`` — compiled on a ``data=2 x model=2`` mesh. The SHD tier
+    watches its partitioned HLO for an all-gather that would silently
+    re-materialize the full ``[B, N_s, N_t]`` S it is supposed to keep
+    sharded (SHD302)."""
+    def build():
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from dgmc_tpu.models import DGMC, RelCNN
+        from dgmc_tpu.parallel import make_mesh
+        from dgmc_tpu.parallel.mesh import corr_sharding
+        from dgmc_tpu.train import create_train_state
+        from dgmc_tpu.utils.data import PairBatch
+        one = _pair_batch(np.random.RandomState(0))
+        n_data = 2
+        batch = PairBatch(
+            s=jax.tree.map(lambda x: np.repeat(x, n_data, 0), one.s),
+            t=jax.tree.map(lambda x: np.repeat(x, n_data, 0), one.t),
+            y=np.repeat(one.y, n_data, 0),
+            y_mask=np.repeat(one.y_mask, n_data, 0))
+        mesh = make_mesh(data=2, model=2, devices=jax.devices()[:4])
+        model = DGMC(RelCNN(4, 8, num_layers=1),
+                     RelCNN(4, 4, num_layers=1),
+                     num_steps=1, k=-1,
+                     corr_sharding=corr_sharding(mesh))
+        # Init under the FULL batch: the corr constraint pins B to the
+        # data-axis size, so a B=1 init batch cannot trace.
+        state = create_train_state(model, jax.random.key(0), batch,
+                                   learning_rate=1e-3)
+        repl = NamedSharding(mesh, P())
+        batched = NamedSharding(mesh, P('data'))
+
+        def forward(params, batch, key):
+            return model.apply({'params': params}, batch.s, batch.t,
+                               train=False, rngs={'noise': key})
+
+        step = jax.jit(forward, in_shardings=(repl, batched, repl))
+        # The full-S payload this layout must never materialize,
+        # derived from the batch itself so it tracks fixture-shape
+        # changes: [B, N_s, N_t] x f32.
+        b, n_s = batch.y.shape
+        n_t = batch.t.x.shape[1]
+        return {'fn': step,
+                'args': (jax.device_put(state.params, repl),
+                         jax.device_put(batch, batched),
+                         jax.device_put(jax.random.key(1), repl)),
+                'prejitted': True,
+                'corr_bytes': b * n_s * n_t * 4,
+                'comm_budget_bytes': 1 << 20}
+    return build
+
+
+def _sharded_train_step_pairs_specimen():
+    """Pairs-per-step >= 2 donating train step on the full
+    ``data x model`` mesh — the exact program family of the rc:124
+    multichip hangs (ROADMAP item 1: the ``data=4, model=2`` path).
+    ``B = 4`` = 2 pair replicas x 2 data shards, matching the
+    ``--pairs-per-step 2`` collation of ``utils/data.pad_pair_batch``."""
+    def build():
+        import jax
+
+        from dgmc_tpu.models import DGMC, RelCNN
+        from dgmc_tpu.parallel import make_mesh, replicate, shard_batch
+        from dgmc_tpu.parallel.sharding import make_sharded_train_step
+        from dgmc_tpu.train import create_train_state
+        from dgmc_tpu.utils.data import PairBatch
+        one = _pair_batch(np.random.RandomState(0))
+        reps = 4
+        batch = PairBatch(
+            s=jax.tree.map(lambda x: np.repeat(x, reps, 0), one.s),
+            t=jax.tree.map(lambda x: np.repeat(x, reps, 0), one.t),
+            y=np.repeat(one.y, reps, 0),
+            y_mask=np.repeat(one.y_mask, reps, 0))
+        model = DGMC(RelCNN(4, 8, num_layers=1),
+                     RelCNN(4, 4, num_layers=1), num_steps=1, k=-1)
+        state = create_train_state(model, jax.random.key(0), one,
+                                   learning_rate=1e-3)
+        mesh = make_mesh(data=2, model=2, devices=jax.devices()[:4])
+        step = make_sharded_train_step(model, mesh)
+        return {'fn': step,
+                'args': (replicate(state, mesh),
+                         shard_batch(batch, mesh), jax.random.key(1)),
+                'prejitted': True,
+                'donate_argnums': (0,),
+                'comm_budget_bytes': 1 << 20}
+    return build
+
+
+def _sharded_topk_cols_specimen():
+    """``parallel/topk.py`` distributed top-k, column-sharded: local
+    blockwise top-k per shard + one candidate all_gather. Its declared
+    ``corr_bytes`` is the ``N_s x N_t`` score matrix the design must
+    never materialize — an all-gather that big is exactly the defeat
+    SHD302 exists to catch."""
+    def build():
+        import jax
+
+        from dgmc_tpu.parallel import make_mesh
+        from dgmc_tpu.parallel.topk import sharded_topk_cols
+        rng = np.random.RandomState(1)
+        h_s = rng.randn(1, 16, 8).astype(np.float32)
+        h_t = rng.randn(1, 24, 8).astype(np.float32)
+        mesh = make_mesh(data=1, model=2, devices=jax.devices()[:2])
+
+        def topk(h_s, h_t):
+            return sharded_topk_cols(mesh, h_s, h_t, 4, block=8)
+
+        return {'fn': topk, 'args': (h_s, h_t),
+                'corr_bytes':
+                    h_s.shape[0] * h_s.shape[1] * h_t.shape[1] * 4,
+                'comm_budget_bytes': 64 << 10}
+    return build
+
+
 def default_specimens() -> List[Specimen]:
-    """The registered hot-function matrix (order = report order)."""
+    """The registered hot-function matrix (order = report order).
+
+    The multi-device specimens registered for the ``shd`` tier only do
+    not feed the trace tier: their jaxpr-level content duplicates the
+    single-device specimens' (same model code), and keeping them out of
+    the trace tier keeps the baseline's TRC entries stable while the
+    SHD tier grows."""
     return [
         Specimen('forward_dense', _forward_specimen(k=-1)),
         Specimen('forward_sparse_k3', _forward_specimen(k=3)),
@@ -212,63 +346,153 @@ def default_specimens() -> List[Specimen]:
         Specimen('ops.masked_softmax', _softmax_specimen()),
         Specimen('ops.segment_sum', _segment_specimen()),
         Specimen('parallel.sharded_train_step',
-                 _sharded_train_step_specimen(), min_devices=2),
+                 _sharded_train_step_specimen(), min_devices=2,
+                 tiers=('trace', 'shd')),
+        Specimen('parallel.sharded_forward_rows',
+                 _sharded_forward_rows_specimen(), min_devices=4,
+                 tiers=('shd',)),
+        Specimen('parallel.sharded_train_step_pairs2',
+                 _sharded_train_step_pairs_specimen(), min_devices=4,
+                 tiers=('shd',)),
+        Specimen('parallel.sharded_topk_cols',
+                 _sharded_topk_cols_specimen(), min_devices=2,
+                 tiers=('shd',)),
     ]
 
 
-def run_specimen(spec: Specimen, *, const_bytes=None) -> List[Finding]:
+class SpecimenArtifacts:
+    """Per-lint-run shared build/trace/lower/compile of one specimen.
+
+    Every tier that looks at the same program pulls its view from here:
+    the trace tier reads :meth:`closed_jaxpr`, the donation rule and
+    the SHD tier read :meth:`compiled` (plus the warnings captured on
+    the way — jax reports unusable donations at lowering time). Each
+    stage runs AT MOST ONCE per lint process however many tiers ask —
+    pinned by the compile-count test
+    (``tests/analysis/test_lowering_cache.py``); before this cache the
+    trace tier and the sharded analyses each traced and compiled their
+    own copy of every donating specimen."""
+
+    def __init__(self, spec: Specimen):
+        self.spec = spec
+        self.stats = {'builds': 0, 'traces': 0, 'lowerings': 0,
+                      'compiles': 0}
+        #: Warnings captured during lowering + compile (the donation
+        #: rule reads these).
+        self.warnings = []
+        self._built = None
+        self._jitted = None
+        self._traced = None
+        self._lowered = None
+        self._compiled = None
+
+    def built(self) -> Dict:
+        if self._built is None:
+            with probes_forced_off():
+                self._built = self.spec.build()
+            self.stats['builds'] += 1
+        return self._built
+
+    def _jit(self):
+        if self._jitted is None:
+            import jax
+            built = self.built()
+            if built.get('prejitted'):
+                self._jitted = built['fn']
+            else:
+                donate = tuple(built.get('donate_argnums') or ())
+                self._jitted = jax.jit(built['fn'],
+                                       donate_argnums=donate)
+        return self._jitted
+
+    def traced(self):
+        """``jax.stages.Traced`` — ONE trace serves both the jaxpr view
+        (``.jaxpr``) and the lowering."""
+        if self._traced is None:
+            with probes_forced_off():
+                self._traced = self._jit().trace(*self.built()['args'])
+            self.stats['traces'] += 1
+        return self._traced
+
+    def closed_jaxpr(self):
+        return self.traced().jaxpr
+
+    def lowered(self):
+        if self._lowered is None:
+            with probes_forced_off(), \
+                    warnings.catch_warnings(record=True) as caught:
+                warnings.simplefilter('always')
+                self._lowered = self.traced().lower()
+            self.warnings.extend(caught)
+            self.stats['lowerings'] += 1
+        return self._lowered
+
+    def compiled(self):
+        if self._compiled is None:
+            lowered = self.lowered()
+            with warnings.catch_warnings(record=True) as caught:
+                warnings.simplefilter('always')
+                self._compiled = lowered.compile()
+            self.warnings.extend(caught)
+            self.stats['compiles'] += 1
+        return self._compiled
+
+
+class SpecimenCache:
+    """Shared :class:`SpecimenArtifacts` across lint tiers: one
+    build/trace/lower/compile per specimen per lint run."""
+
+    def __init__(self):
+        self._arts: Dict[str, SpecimenArtifacts] = {}
+
+    def artifacts(self, spec: Specimen) -> SpecimenArtifacts:
+        art = self._arts.get(spec.name)
+        if art is None:
+            art = self._arts[spec.name] = SpecimenArtifacts(spec)
+        return art
+
+    def stats(self) -> Dict[str, Dict[str, int]]:
+        return {name: dict(a.stats) for name, a in self._arts.items()}
+
+
+def run_specimen(spec: Specimen, *, const_bytes=None,
+                 artifacts: Optional[SpecimenArtifacts] = None,
+                 ) -> List[Finding]:
     """Trace + (when donating) compile one specimen and run every
-    trace-tier rule over it."""
-    import jax
+    trace-tier rule over it. Pass ``artifacts`` (from a
+    :class:`SpecimenCache`) to reuse the trace/lowering across tiers."""
     kw = {}
     if const_bytes is not None:
         kw['const_bytes'] = const_bytes
-    with probes_forced_off():
-        built = spec.build()
-        fn, args = built['fn'], built['args']
-        ctx = TraceContext(specimen=spec.name, **kw)
-        if built.get('prejitted'):
-            # Already a jitted callable (e.g. the sharded step with its
-            # in_shardings): trace through its wrapper for the jaxpr
-            # rules, and reuse its own lowering for donation.
-            closed = jax.make_jaxpr(lambda *a: fn(*a))(*args)
-        else:
-            closed = jax.make_jaxpr(fn)(*args)
-        findings = analyze_closed_jaxpr(closed, ctx)
-        donate = built.get('donate_argnums')
-        if donate:
-            if built.get('prejitted'):
-                findings += _donation_of_prejitted(fn, args, donate,
-                                                  spec.name)
-            else:
-                findings += analyze_donation(fn, args,
-                                             donate_argnums=donate,
-                                             specimen=spec.name)
+    art = artifacts if artifacts is not None else SpecimenArtifacts(spec)
+    ctx = TraceContext(specimen=spec.name, **kw)
+    findings = analyze_closed_jaxpr(art.closed_jaxpr(), ctx)
+    donate = art.built().get('donate_argnums')
+    if donate:
+        findings += compiled_donation_findings(art.warnings,
+                                               art.compiled(), donate,
+                                               spec.name)
     return findings
-
-
-def _donation_of_prejitted(fn, args, donate, specimen) -> List[Finding]:
-    import warnings
-    from dgmc_tpu.analysis.jaxpr_rules import compiled_donation_findings
-    with warnings.catch_warnings(record=True) as caught:
-        warnings.simplefilter('always')
-        compiled = fn.lower(*args).compile()
-    return compiled_donation_findings(caught, compiled, donate, specimen)
 
 
 def run_trace_tier(specimens: Optional[List[Specimen]] = None, *,
                    const_bytes=None,
                    on_progress: Optional[Callable[[str], None]] = None,
-                   skipped: Optional[List[str]] = None) -> List[Finding]:
-    """Run every runnable specimen; skips mesh specimens when the
-    process has too few devices (reported via ``on_progress``, and
+                   skipped: Optional[List[str]] = None,
+                   cache: Optional[SpecimenCache] = None) -> List[Finding]:
+    """Run every runnable trace-tier specimen; skips mesh specimens when
+    the process has too few devices (reported via ``on_progress``, and
     appended to ``skipped`` when given — baseline writers use that to
-    preserve the skipped specimens' prior entries)."""
+    preserve the skipped specimens' prior entries). ``cache`` shares
+    each specimen's single trace/lowering with the other tiers."""
     import jax
     findings = []
     n_dev = len(jax.devices())
+    cache = cache if cache is not None else SpecimenCache()
     for spec in (specimens if specimens is not None
                  else default_specimens()):
+        if 'trace' not in spec.tiers:
+            continue
         if spec.min_devices and n_dev < spec.min_devices:
             if on_progress:
                 on_progress(f'skip {spec.name} '
@@ -279,5 +503,6 @@ def run_trace_tier(specimens: Optional[List[Specimen]] = None, *,
             continue
         if on_progress:
             on_progress(f'trace {spec.name}')
-        findings.extend(run_specimen(spec, const_bytes=const_bytes))
+        findings.extend(run_specimen(spec, const_bytes=const_bytes,
+                                     artifacts=cache.artifacts(spec)))
     return findings

@@ -1,0 +1,371 @@
+"""SHD tier: rules over post-GSPMD partitioned HLO of sharded specimens.
+
+The trace tier sees programs *before* partitioning; every hazard this
+tier hunts only exists *after* GSPMD has inserted the communication —
+which is exactly why ROADMAP item 1's multichip hangs and item 3's
+sharding defeats were runtime-only discoveries until now. Each
+registered multi-device specimen is compiled under its mesh, the
+partitioned HLO is parsed once
+(:func:`~dgmc_tpu.analysis.hlo_comm.parse_hlo_module` — the same walker
+``obs/cost.py`` builds its collective account on), and five rules run
+over the per-program collective schedule:
+
+``SHD301`` branch-divergent-collectives (error)
+    A ``conditional`` whose sibling branches carry different collective
+    sequences — a collective reachable on one control path but not the
+    other. If the predicate ever disagrees across devices (non-replicated
+    input, NaN-path divergence), part of the mesh enters a collective
+    its peers never post: the static face of the rc:124 multichip-hang
+    class.
+``SHD302`` corr-replication (error)
+    An ``all-gather``/``collective-broadcast`` materializing a full
+    correspondence-shaped tensor (rank >= 3 result at least as big as
+    the specimen's declared ``[B, N_s, N_t]`` payload). GSPMD inserts
+    these silently at sharding boundaries; one of them un-shards the
+    million-entity S matrix the whole layout exists to split.
+``SHD303`` reshard-churn (warning)
+    Two or more resharding collectives (``collective-permute`` /
+    ``all-to-all``) inside one ``while`` body — layout bounced back and
+    forth every consensus iteration instead of being settled once
+    outside the loop.
+``SHD304`` comm-budget (warning)
+    The program's total collective payload exceeds the specimen's
+    recorded per-step communication budget (``comm_budget_bytes`` in the
+    specimen build, like the recompile pass's compiles-per-bucket
+    budget). Reported in power-of-two buckets so the finding's identity
+    survives small payload drift but releases on an order-of-magnitude
+    regression.
+``SHD305`` precision-contract (error)
+    A reduction/contraction accumulating in bf16 — worst when an
+    explicit f32->bf16 ``convert`` feeds it (precision was available and
+    thrown away before the accumulation). ``models/precision.py``'s
+    contract is bf16 *compute* with f32 *accumulation*; a bf16 running
+    sum stops absorbing addends once it is ~256x any contribution, so
+    this is a correctness rule, not a style rule.
+"""
+
+import dataclasses
+import re
+from typing import List, Optional
+
+from dgmc_tpu.analysis.findings import Finding, Severity
+from dgmc_tpu.analysis.hlo_comm import (HloModule, collective_schedule,
+                                        parse_hlo_module)
+
+__all__ = ['ShardedContext', 'analyze_sharded_hlo', 'run_sharded_tier',
+           'check_branch_divergence', 'check_corr_replication',
+           'check_reshard_churn', 'check_comm_budget',
+           'check_precision_contract']
+
+#: Collectives that re-replicate a sharded tensor (SHD302).
+_REPLICATING = ('all-gather', 'collective-broadcast')
+#: Collectives that move a tensor between layouts (SHD303).
+_RESHARDING = ('collective-permute', 'all-to-all')
+
+_LHS_CONTRACT = re.compile(r'lhs_contracting_dims=\{([0-9,]*)\}')
+
+
+@dataclasses.dataclass
+class ShardedContext:
+    """Provenance prefix + thresholds for one partitioned program."""
+    specimen: str = 'program'
+    #: Full correspondence-matrix payload bytes (``B*N_s*N_t*itemsize``)
+    #: when the specimen declares one; SHD302 runs only with it set.
+    corr_bytes: Optional[int] = None
+    #: Per-step collective-byte budget; SHD304 runs only with it set.
+    comm_budget_bytes: Optional[int] = None
+    #: Minimum accumulated elements before a bf16 accumulator is worth
+    #: flagging (tiny reductions cannot drift meaningfully).
+    accum_elems: int = 64
+    #: Resharding collectives inside one loop body before SHD303 fires.
+    reshard_churn_min: int = 2
+
+
+def _prod(dims) -> int:
+    out = 1
+    for d in dims:
+        out *= d
+    return out
+
+
+def _loc(op_or_coll, fallback: str) -> str:
+    """Stable location for a finding: source provenance when the HLO
+    metadata carries it, else the op-name scope path, else a structural
+    fallback (never the compiler's drifting computation names)."""
+    loc = getattr(op_or_coll, 'source_loc', None)
+    if loc:
+        return loc
+    name = getattr(op_or_coll, 'op_name', '')
+    return name or fallback
+
+
+def _pow2_bucket(nbytes: int) -> str:
+    """``<= 2^k`` byte bucket — the finding's identity-bearing size, so
+    the fingerprint survives payload jitter but releases when the
+    program's communication grows past the next power of two."""
+    k = max(1, nbytes)
+    bucket = 1
+    while bucket < k:
+        bucket <<= 1
+    if bucket >= 1 << 20:
+        return f'<= {bucket >> 20} MiB'
+    if bucket >= 1 << 10:
+        return f'<= {bucket >> 10} KiB'
+    return f'<= {bucket} B'
+
+
+# ---------------------------------------------------------------------------
+# Rules
+# ---------------------------------------------------------------------------
+
+
+def check_branch_divergence(module: HloModule,
+                            ctx: ShardedContext) -> List[Finding]:
+    """SHD301: sibling conditional branches with different collective
+    sequences."""
+    out = []
+    cond_idx = 0
+    for comp, op in module.iter_ops():
+        branches = op.branch_computations()
+        if len(branches) < 2:
+            continue
+        cond_idx += 1
+        seqs = [tuple(c.kind for c in module.flatten_collectives(b))
+                for b in branches]
+        if len(set(seqs)) <= 1:
+            continue
+        rendered = ' vs '.join('[' + ', '.join(s) + ']' for s in seqs)
+        out.append(Finding(
+            rule='SHD301', severity=Severity.ERROR,
+            where=f'{ctx.specimen}:{_loc(op, f"conditional#{cond_idx}")}',
+            message=(f'collective sequence diverges across conditional '
+                     f'branches ({rendered}) — a collective reachable '
+                     f'on one control path but not its sibling'),
+            detail=('if the predicate ever disagrees across devices, '
+                    'part of the mesh posts a collective its peers '
+                    'never enter: distributed deadlock (the rc:124 '
+                    'multichip-hang class). Hoist the collective out '
+                    'of the conditional or make both branches '
+                    'communicate identically; branch computations: '
+                    + ', '.join(branches))))
+    return out
+
+
+def check_corr_replication(module: HloModule,
+                           ctx: ShardedContext) -> List[Finding]:
+    """SHD302: all-gather materializing a full correspondence-shaped
+    tensor."""
+    if not ctx.corr_bytes:
+        return []
+    out = []
+    for coll in collective_schedule(module):
+        if coll.kind not in _REPLICATING:
+            continue
+        # Identify "S got un-sharded": a rank>=3 result (the [B, N_s,
+        # N_t] family) at least as large as the declared full matrix.
+        m = re.search(r'([a-z][a-z0-9]*)\[([0-9,]+)\]', coll.line)
+        if not m:
+            continue
+        dims = [int(d) for d in m.group(2).split(',') if d]
+        if len(dims) < 3 or coll.nbytes < ctx.corr_bytes:
+            continue
+        shape = f'{m.group(1)}[{m.group(2)}]'
+        out.append(Finding(
+            rule='SHD302', severity=Severity.ERROR,
+            where=f'{ctx.specimen}:{_loc(coll, coll.kind)}',
+            message=(f'`{coll.kind}` materializes a full '
+                     f'correspondence-shaped tensor ({shape}) — '
+                     f'implicit replication defeats the S-matrix '
+                     f'sharding'),
+            detail=(f'payload {coll.nbytes} B >= declared full '
+                    f'correspondence payload {ctx.corr_bytes} B '
+                    f'(replica_groups={coll.replica_groups}, '
+                    f'channel_id={coll.channel_id}); add a '
+                    f'with_sharding_constraint at the producing op or '
+                    f'reformulate the consumer to work on shards')))
+    return out
+
+
+def check_reshard_churn(module: HloModule,
+                        ctx: ShardedContext) -> List[Finding]:
+    """SHD303: repeated resharding collectives inside one loop body."""
+    out = []
+    for i, (while_op, body) in enumerate(module.while_bodies()):
+        resh = [c for c in module.flatten_collectives(body)
+                if c.kind in _RESHARDING]
+        if len(resh) < ctx.reshard_churn_min:
+            continue
+        kinds = sorted({c.kind for c in resh})
+        out.append(Finding(
+            rule='SHD303', severity=Severity.WARNING,
+            where=f'{ctx.specimen}:{_loc(while_op, f"while#{i}")}',
+            message=(f'resharding churn inside a loop body '
+                     f'({"/".join(kinds)} round-trip) — the layout is '
+                     f'bounced every iteration'),
+            detail=(f'{len(resh)} resharding collective(s), '
+                    f'{sum(c.nbytes for c in resh)} B payload per '
+                    f'iteration; settle the layout once outside the '
+                    f'loop (sharding constraints on the carried state) '
+                    f'instead of round-tripping it in the consensus '
+                    f'iteration body')))
+    return out
+
+
+def check_comm_budget(module: HloModule,
+                      ctx: ShardedContext) -> List[Finding]:
+    """SHD304: total per-step collective payload over the specimen's
+    recorded budget."""
+    if not ctx.comm_budget_bytes:
+        return []
+    sched = collective_schedule(module)
+    total = sum(c.nbytes for c in sched)
+    if total <= ctx.comm_budget_bytes:
+        return []
+    per_kind = {}
+    for c in sched:
+        per_kind[c.kind] = per_kind.get(c.kind, 0) + c.nbytes
+    breakdown = ', '.join(f'{k}: {v} B'
+                          for k, v in sorted(per_kind.items()))
+    return [Finding(
+        rule='SHD304', severity=Severity.WARNING,
+        where=f'{ctx.specimen}:comm-budget',
+        message=(f'collective payload {_pow2_bucket(total)} per step '
+                 f'exceeds the recorded '
+                 f'{ctx.comm_budget_bytes} B communication budget'),
+        detail=(f'exact total {total} B over {len(sched)} '
+                f'collective(s) — {breakdown}; either the new '
+                f'communication is intended (raise the specimen budget '
+                f'in the registry and re-baseline) or a sharding '
+                f'boundary moved'))]
+
+
+def _fed_by_f32_convert(defs, operand_name: str) -> bool:
+    producer = defs.get(operand_name)
+    if producer is None or producer.opcode != 'convert':
+        return False
+    ops = producer.operands()
+    return bool(ops) and ops[0][0] == 'f32'
+
+
+def check_precision_contract(module: HloModule,
+                             ctx: ShardedContext) -> List[Finding]:
+    """SHD305: bf16 accumulation (reduce/dot), worst when fed by an
+    explicit f32->bf16 downcast."""
+    out = []
+    hits = 0
+    for comp in module.computations.values():
+        defs = {op.result: op for op in comp.ops}
+        for op in comp.ops:
+            shape = op.result_shape
+            if shape is None or shape[0] != 'bf16':
+                continue
+            operands = op.operands()
+            if op.opcode == 'reduce':
+                if not operands:
+                    continue
+                in_elems = _prod(operands[0][1])
+                acc = in_elems // max(_prod(shape[1]), 1)
+                fed = _fed_by_f32_convert(defs, operands[0][2])
+            elif op.opcode == 'dot':
+                m = _LHS_CONTRACT.search(op.line)
+                if not m or not operands:
+                    continue
+                lhs_dims = operands[0][1]
+                acc = 1
+                try:
+                    for idx in (int(s) for s in m.group(1).split(',')
+                                if s):
+                        acc *= lhs_dims[idx]
+                except IndexError:
+                    continue
+                fed = any(_fed_by_f32_convert(defs, o[2])
+                          for o in operands[:2])
+            else:
+                continue
+            if acc < ctx.accum_elems:
+                continue
+            if fed:
+                message = (f'f32->bf16 downcast feeds `{op.opcode}` '
+                           f'with a bf16 accumulator — '
+                           f'f32-accumulation contract violation')
+            else:
+                message = (f'`{op.opcode}` accumulates in bf16 — '
+                           f'f32-accumulation contract violation')
+            # Structural fallback (opcode + walk ordinal, like
+            # SHD301's conditional#N) — comp.name/op.result are
+            # compiler-assigned and renumber on unrelated recompiles,
+            # which would churn the fingerprint.
+            out.append(Finding(
+                rule='SHD305', severity=Severity.ERROR,
+                where=f'{ctx.specimen}:'
+                      f'{_loc(op, f"{op.opcode}#{hits}")}',
+                message=message,
+                detail=(f'{acc} element(s) accumulated into a bf16 '
+                        f'result ({op.result_type}); a bf16 running '
+                        f'sum stops absorbing addends at ~256x scale — '
+                        f'set preferred_element_type=f32 on the '
+                        f'contraction / keep the reduction in f32 '
+                        f'(models/precision.py contract)')))
+            hits += 1
+    return out
+
+
+def analyze_sharded_hlo(hlo_text: str,
+                        ctx: Optional[ShardedContext] = None,
+                        ) -> List[Finding]:
+    """All SHD rules over one partitioned program (parsed once)."""
+    ctx = ctx or ShardedContext()
+    module = parse_hlo_module(hlo_text)
+    out = []
+    out += check_branch_divergence(module, ctx)
+    out += check_corr_replication(module, ctx)
+    out += check_reshard_churn(module, ctx)
+    out += check_comm_budget(module, ctx)
+    out += check_precision_contract(module, ctx)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Tier driver
+# ---------------------------------------------------------------------------
+
+
+def run_sharded_tier(specimens=None, *, cache=None,
+                     comm_budget_bytes=None, on_progress=None,
+                     skipped=None) -> List[Finding]:
+    """Compile every SHD-registered specimen under its mesh (reusing the
+    lint run's shared :class:`~dgmc_tpu.analysis.registry.SpecimenCache`
+    lowerings) and run the SHD rules over the partitioned HLO. Mesh
+    specimens below the process's device count are skipped (reported,
+    and appended to ``skipped`` so baseline writers preserve their
+    prior entries)."""
+    import jax
+
+    from dgmc_tpu.analysis.registry import SpecimenCache, default_specimens
+
+    cache = cache if cache is not None else SpecimenCache()
+    findings = []
+    n_dev = len(jax.devices())
+    for spec in (specimens if specimens is not None
+                 else default_specimens()):
+        if 'shd' not in spec.tiers:
+            continue
+        if spec.min_devices and n_dev < spec.min_devices:
+            if on_progress:
+                on_progress(f'skip {spec.name} (needs >= '
+                            f'{spec.min_devices} devices, have {n_dev})')
+            if skipped is not None and spec.name not in skipped:
+                skipped.append(spec.name)
+            continue
+        if on_progress:
+            on_progress(f'sharded-hlo {spec.name}')
+        art = cache.artifacts(spec)
+        built = art.built()
+        text = art.compiled().as_text()
+        ctx = ShardedContext(
+            specimen=spec.name,
+            corr_bytes=built.get('corr_bytes'),
+            comm_budget_bytes=built.get('comm_budget_bytes',
+                                        comm_budget_bytes))
+        findings.extend(analyze_sharded_hlo(text, ctx))
+    return findings

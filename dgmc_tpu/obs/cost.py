@@ -19,7 +19,11 @@ model-FLOPs utilization):
   + backward.
 - **Collectives** in sharded programs: all-reduce / all-gather /
   reduce-scatter / all-to-all / collective-permute op counts and result
-  bytes, from compiled HLO (post-GSPMD) or manual-collective StableHLO.
+  bytes, from compiled HLO (post-GSPMD) or manual-collective StableHLO —
+  counted by the shared HLO walker
+  (:mod:`dgmc_tpu.analysis.hlo_comm`), the same parser the lint SHD
+  tier builds its collective schedules on, so the cost account and the
+  lint rules can never disagree about what a program moves.
 - **MFU / roofline utilization**: ``flops / (step_time * peak_flops)``
   against a per-backend peak table (:data:`PEAK_FLOPS`, moved here from
   ``bench.py``) with an explicit CPU fallback entry, so smoke runs on
@@ -47,10 +51,19 @@ import math
 import re
 import sys
 
+# The collective accounting (op table, byte counting, dtype widths)
+# lives in the shared walker; re-exported here so existing callers of
+# ``cost.collective_table`` / ``cost.COLLECTIVE_OPS`` keep working.
+from dgmc_tpu.analysis.hlo_comm import (COLLECTIVE_OPS,  # noqa: F401
+                                        DTYPE_BYTES as _DTYPE_BYTES,
+                                        collective_table, hlo_shape_bytes,
+                                        mlir_tensor_info)
+
 __all__ = [
-    'PEAK_FLOPS', 'CPU_PEAK_FLOPS', 'STAGE_NAMES', 'peak_flops_entry',
-    'stage_table', 'collective_table', 'analysis_totals', 'cost_summary',
-    'efficiency_payload', 'specimen_costs', 'main',
+    'PEAK_FLOPS', 'CPU_PEAK_FLOPS', 'STAGE_NAMES', 'COLLECTIVE_OPS',
+    'peak_flops_entry', 'stage_table', 'collective_table',
+    'analysis_totals', 'cost_summary', 'efficiency_payload',
+    'specimen_costs', 'main',
 ]
 
 #: Documented dense-matmul peak FLOP/s per chip (bf16, public TPU spec
@@ -79,21 +92,6 @@ CPU_PEAK_FLOPS = 48e9
 #: ``optimizer`` come from ``train/steps.py``).
 STAGE_NAMES = ('psi1', 'psi2', 'initial_corr', 'topk', 'consensus_iter',
                'loss', 'optimizer')
-
-#: Cross-device collective ops, HLO spelling (the StableHLO spelling
-#: substitutes ``_`` for ``-``).
-COLLECTIVE_OPS = ('all-reduce', 'all-gather', 'reduce-scatter',
-                  'all-to-all', 'collective-permute',
-                  'collective-broadcast')
-
-_DTYPE_BYTES = {
-    'f64': 8, 'f32': 4, 'f16': 2, 'bf16': 2, 'f8e4m3fn': 1, 'f8e5m2': 1,
-    'c64': 8, 'c128': 16,
-    's64': 8, 's32': 4, 's16': 2, 's8': 1,
-    'i64': 8, 'i32': 4, 'i16': 2, 'i8': 1, 'i4': 1, 'i1': 1,
-    'u64': 8, 'u32': 4, 'u16': 2, 'u8': 1, 'ui64': 8, 'ui32': 4,
-    'ui16': 2, 'ui8': 1, 'pred': 1,
-}
 
 
 def peak_flops_entry(device=None):
@@ -134,17 +132,9 @@ _CONTRACT_ATTR = re.compile(r'lhs_contracting_dimensions\s*=\s*'
 
 
 def _tensor_info(dims, dtype):
-    """(element_count, bytes) for one parsed ``tensor<...>`` type."""
-    if not dims:
-        n = 1
-    else:
-        n = 1
-        for d in dims.split('x'):
-            if d in ('', '?'):
-                continue
-            n *= int(d)
-    itemsize = _DTYPE_BYTES.get(dtype, 4)
-    return n, n * itemsize
+    """(element_count, bytes) for one parsed ``tensor<...>`` type —
+    the shared walker's MLIR-type accounting."""
+    return mlir_tensor_info(dims or '', dtype)
 
 
 def stage_of(op_name):
@@ -244,67 +234,6 @@ def stage_table(asm):
 
 
 # ---------------------------------------------------------------------------
-# Collectives (compiled HLO text or manual-collective StableHLO)
-# ---------------------------------------------------------------------------
-
-_HLO_SHAPE = re.compile(r'([a-z][a-z0-9]*)\[([0-9,]*)\]')
-
-
-def _hlo_shape_bytes(text):
-    total = 0
-    for dtype, dims in _HLO_SHAPE.findall(text):
-        n = 1
-        for d in dims.split(','):
-            if d:
-                n *= int(d)
-        total += n * _DTYPE_BYTES.get(dtype, 4)
-    return total
-
-
-def collective_table(text):
-    """Collective-op counts and result bytes from program text.
-
-    Accepts post-GSPMD compiled HLO (``compiled.as_text()`` — ops spelt
-    ``all-reduce(...)``, or the async pair ``all-reduce-start(...)`` /
-    ``-done`` that real TPU executables overlap with compute; only the
-    ``-start`` is counted so a pair is one collective) and StableHLO
-    asm (manual ``shard_map`` collectives spelt
-    ``stablehlo.all_reduce``). Returns
-    ``{'ops': {name: {'count', 'bytes'}}, 'count', 'bytes'}`` (empty
-    ``ops`` when the program moves nothing between devices).
-    """
-    ops = {}
-    for line in text.splitlines():
-        for name in COLLECTIVE_OPS:
-            mlir_name = 'stablehlo.' + name.replace('-', '_')
-            if mlir_name in line:
-                row = ops.setdefault(name, {'count': 0, 'bytes': 0})
-                row['count'] += 1
-                tensors = _TENSOR.findall(line)
-                if tensors:
-                    _, nbytes = _tensor_info(tensors[-1][0] or '',
-                                             tensors[-1][1])
-                    row['bytes'] += nbytes
-                break
-            token = next((t for t in (f' {name}(', f' {name}-start(')
-                          if t in line and '=' in line), None)
-            if token:
-                row = ops.setdefault(name, {'count': 0, 'bytes': 0})
-                row['count'] += 1
-                # Result shape(s): between '=' and the op call token.
-                # The -start result wraps the payload in a tuple with
-                # bookkeeping shapes; _hlo_shape_bytes sums what is
-                # listed, an upper bound close enough for attribution.
-                head = line.split(token)[0]
-                head = head.split('=', 1)[1] if '=' in head else head
-                row['bytes'] += _hlo_shape_bytes(head)
-                break
-    return {'ops': ops,
-            'count': sum(r['count'] for r in ops.values()),
-            'bytes': sum(r['bytes'] for r in ops.values())}
-
-
-# ---------------------------------------------------------------------------
 # Program summaries
 # ---------------------------------------------------------------------------
 
@@ -401,7 +330,7 @@ def _compiled_stage_bytes(hlo_text):
         row['ops'] += 1
         head = line.split('=', 1)[0] + '=' + \
             line.split('=', 1)[1].split('(', 1)[0]
-        row['bytes_out'] += _hlo_shape_bytes(head)
+        row['bytes_out'] += hlo_shape_bytes(head)
     return table
 
 
@@ -465,15 +394,12 @@ def efficiency_payload(programs, fallback_step_time_s=None, device=None):
 
 def _compile_specimen(spec):
     """Build + AOT-compile one registry specimen (probes forced off —
-    the registry's contract); returns ``(lowered, compiled)``."""
-    import jax
-    from dgmc_tpu.analysis.registry import probes_forced_off
-    with probes_forced_off():
-        built = spec.build()
-        fn, args = built['fn'], built['args']
-        jitted = fn if built.get('prejitted') else jax.jit(fn)
-        lowered = jitted.lower(*args)
-        return lowered, lowered.compile()
+    the registry's contract, enforced by the shared
+    :class:`~dgmc_tpu.analysis.registry.SpecimenArtifacts` this rides
+    on); returns ``(lowered, compiled)`` from ONE trace."""
+    from dgmc_tpu.analysis.registry import SpecimenArtifacts
+    art = SpecimenArtifacts(spec)
+    return art.lowered(), art.compiled()
 
 
 def specimen_costs(names=None, on_progress=None):

@@ -1,0 +1,190 @@
+"""The shared post-GSPMD HLO walker: module parsing, collective
+schedules (regions included), and the aggregate table obs/cost rides.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from dgmc_tpu.analysis import hlo_comm
+
+# A hand-written partitioned module exercising every structural feature
+# the walker must understand: ENTRY order, a while body/condition pair,
+# a conditional with branch computations, async -start/-done pairing,
+# channel ids, both replica_groups spellings, and a call target.
+MODULE = (
+    'HloModule jit_step, entry_computation_layout={()->f32[]}\n'
+    '\n'
+    '%add.clone (x.1: f32[], y.1: f32[]) -> f32[] {\n'
+    '  %x.1 = f32[] parameter(0)\n'
+    '  %y.1 = f32[] parameter(1)\n'
+    '  ROOT %add.2 = f32[] add(f32[] %x.1, f32[] %y.1)\n'
+    '}\n'
+    '\n'
+    '%branch_a (p0: f32[4]) -> f32[4] {\n'
+    '  %p0 = f32[4]{0} parameter(0)\n'
+    '  ROOT %ar.a = f32[4]{0} all-reduce(f32[4]{0} %p0),'
+    ' channel_id=7, replica_groups={{0,1},{2,3}}, to_apply=%add.clone\n'
+    '}\n'
+    '\n'
+    '%branch_b (p1: f32[4]) -> f32[4] {\n'
+    '  ROOT %p1 = f32[4]{0} parameter(0)\n'
+    '}\n'
+    '\n'
+    '%helper (h0: f32[8]) -> f32[8] {\n'
+    '  %h0 = f32[8]{0} parameter(0)\n'
+    '  ROOT %cp = f32[8]{0} collective-permute(f32[8]{0} %h0),'
+    ' channel_id=9, source_target_pairs={{0,1},{1,0}}\n'
+    '}\n'
+    '\n'
+    '%body (carry: (s32[], f32[4,8])) -> (s32[], f32[4,8]) {\n'
+    '  %carry = (s32[], f32[4,8]{1,0}) parameter(0)\n'
+    '  %gte = f32[4,8]{1,0}'
+    ' get-tuple-element((s32[], f32[4,8]{1,0}) %carry), index=1\n'
+    '  %ar.body = f32[4,8]{1,0} all-reduce(f32[4,8]{1,0} %gte),'
+    ' channel_id=1, replica_groups=[2,2]<=[4], to_apply=%add.clone\n'
+    '  %i = s32[] get-tuple-element((s32[], f32[4,8]{1,0}) %carry),'
+    ' index=0\n'
+    '  ROOT %t = (s32[], f32[4,8]{1,0}) tuple(s32[] %i,'
+    ' f32[4,8]{1,0} %ar.body)\n'
+    '}\n'
+    '\n'
+    '%cond (carry.1: (s32[], f32[4,8])) -> pred[] {\n'
+    '  %carry.1 = (s32[], f32[4,8]{1,0}) parameter(0)\n'
+    '  %i.1 = s32[]'
+    ' get-tuple-element((s32[], f32[4,8]{1,0}) %carry.1), index=0\n'
+    '  %c10 = s32[] constant(10)\n'
+    '  ROOT %lt = pred[] compare(s32[] %i.1, s32[] %c10),'
+    ' direction=LT\n'
+    '}\n'
+    '\n'
+    'ENTRY %main_spmd (param: f32[4,8], p2: f32[4], p3: s32[],'
+    ' p4: f32[8]) -> f32[] {\n'
+    '  %param = f32[4,8]{1,0} parameter(0)\n'
+    '  %p2 = f32[4]{0} parameter(1)\n'
+    '  %p3 = s32[] parameter(2)\n'
+    '  %p4 = f32[8]{0} parameter(3)\n'
+    '  %init = (s32[], f32[4,8]{1,0}) tuple(s32[] %p3,'
+    ' f32[4,8]{1,0} %param)\n'
+    '  %loop = (s32[], f32[4,8]{1,0})'
+    ' while((s32[], f32[4,8]{1,0}) %init), condition=%cond,'
+    ' body=%body\n'
+    '  %cc = f32[4]{0} conditional(s32[] %p3, f32[4]{0} %p2,'
+    ' f32[4]{0} %p2), branch_computations={%branch_a, %branch_b}\n'
+    '  %called = f32[8]{0} call(f32[8]{0} %p4), to_apply=%helper\n'
+    '  %ags = f32[16]{0} all-gather-start(f32[4]{0} %cc),'
+    ' channel_id=3, replica_groups={{0,1,2,3}}, dimensions={0}\n'
+    '  %agd = f32[16]{0} all-gather-done(f32[16]{0} %ags)\n'
+    '  ROOT %out = f32[] constant(0)\n'
+    '}\n'
+)
+
+
+def test_parse_module_computations_and_entry():
+    mod = hlo_comm.parse_hlo_module(MODULE)
+    assert mod.entry == 'main_spmd'
+    assert {'add.clone', 'branch_a', 'branch_b', 'helper', 'body',
+            'cond', 'main_spmd'} <= set(mod.computations)
+    assert [op.opcode for op in mod.computations['body'].ops] == [
+        'parameter', 'get-tuple-element', 'all-reduce',
+        'get-tuple-element', 'tuple']
+
+
+def test_collective_schedule_walks_regions_in_program_order():
+    sched = hlo_comm.collective_schedule(MODULE)
+    # while body's all-reduce, both conditional branches, the called
+    # helper's collective-permute, then the async all-gather — once.
+    assert [c.kind for c in sched] == [
+        'all-reduce', 'all-reduce', 'collective-permute', 'all-gather']
+    by_comp = {c.computation: c for c in sched}
+    assert by_comp['body'].channel_id == 1
+    assert by_comp['body'].replica_groups == '[2,2]<=[4]'
+    assert by_comp['body'].nbytes == 4 * 8 * 4
+    assert by_comp['branch_a'].replica_groups == '{{0,1},{2,3}}'
+    assert by_comp['helper'].kind == 'collective-permute'
+    ag = by_comp['main_spmd']
+    assert ag.kind == 'all-gather' and ag.channel_id == 3
+    assert ag.nbytes == 16 * 4
+
+
+def test_branch_computations_both_spellings():
+    mod = hlo_comm.parse_hlo_module(MODULE)
+    (cond_op,) = [op for _, op in mod.iter_ops()
+                  if op.opcode == 'conditional']
+    assert cond_op.branch_computations() == ['branch_a', 'branch_b']
+    legacy = hlo_comm.HloOp(
+        result='c', result_type='f32[4]',
+        opcode='conditional',
+        line='%c = f32[4]{0} conditional(pred[] %p, f32[4]{0} %a, '
+             'f32[4]{0} %b), true_computation=%t, false_computation=%f')
+    assert legacy.branch_computations() == ['t', 'f']
+
+
+def test_while_bodies_and_flatten():
+    mod = hlo_comm.parse_hlo_module(MODULE)
+    [(while_op, body)] = mod.while_bodies()
+    assert while_op.opcode == 'while' and body == 'body'
+    kinds = [c.kind for c in mod.flatten_collectives(body)]
+    assert kinds == ['all-reduce']
+
+
+def test_operands_and_metadata():
+    line = ('%ar = f32[4,8]{1,0} all-reduce(f32[4,8]{1,0} %dot), '
+            'channel_id=1, replica_groups={{0,1},{2,3}}, '
+            'use_global_device_ids=true, to_apply=%add, '
+            'metadata={op_name="jit(f)/jit(main)/psi1/dot_general" '
+            'source_file="/x/dgmc_tpu/models/dgmc.py" source_line=42}')
+    op = hlo_comm.HloOp(result='ar', result_type='f32[4,8]{1,0}',
+                        opcode='all-reduce', line=line)
+    assert op.operands() == [('f32', (4, 8), 'dot')]
+    assert op.op_name == 'jit(f)/jit(main)/psi1/dot_general'
+    assert op.source_loc == 'dgmc_tpu/models/dgmc.py:42'
+    assert op.collective_kind == 'all-reduce'
+    # to_apply on a collective is the combiner, not a region to walk.
+    assert op.called_computations() == []
+
+
+def test_collective_table_matches_schedule_counts():
+    t = hlo_comm.collective_table(MODULE)
+    assert t['ops']['all-reduce']['count'] == 2
+    assert t['ops']['all-gather']['count'] == 1
+    assert t['ops']['collective-permute']['count'] == 1
+    assert t['count'] == 4
+
+
+def test_collective_table_stablehlo_spelling():
+    txt = ('%0 = "stablehlo.all_reduce"(%arg0) ... : '
+           '(tensor<4x8xf32>) -> tensor<4x8xf32>\n')
+    t = hlo_comm.collective_table(txt)
+    assert t['ops']['all-reduce'] == {'count': 1, 'bytes': 4 * 8 * 4}
+
+
+def test_hlo_shape_bytes_ignores_layouts():
+    assert hlo_comm.hlo_shape_bytes('f32[128,4]{1,0}') == 128 * 4 * 4
+    assert hlo_comm.hlo_shape_bytes('(s32[], bf16[8,8]{1,0})') == \
+        4 + 8 * 8 * 2
+
+
+@pytest.mark.skipif(len(jax.devices()) < 4, reason='needs 4 devices')
+def test_real_partitioned_program_schedule():
+    """A genuinely GSPMD-partitioned reduction must expose its
+    all-reduce through the structured walker (not fixture text)."""
+    from jax.experimental import mesh_utils
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    mesh = Mesh(mesh_utils.create_device_mesh(
+        (2, 2), devices=np.asarray(jax.devices()[:4])),
+        ('data', 'model'))
+
+    def f(x, w):
+        return (x @ w).sum()
+
+    jf = jax.jit(f, in_shardings=(
+        NamedSharding(mesh, P('data', 'model')),
+        NamedSharding(mesh, P('model', None))))
+    txt = jf.lower(np.ones((8, 8), np.float32),
+                   np.ones((8, 4), np.float32)).compile().as_text()
+    sched = hlo_comm.collective_schedule(txt)
+    assert any(c.kind == 'all-reduce' for c in sched)
+    assert all(c.channel_id is not None for c in sched)
+    # The aggregate table and the schedule must agree on the count.
+    assert hlo_comm.collective_table(txt)['count'] == len(sched)

@@ -33,11 +33,27 @@ def test_list_rules(capsys):
     assert rc == 0
     for rule in RULE_CATALOG:
         assert rule in out
+    assert {'SHD301', 'SHD302', 'SHD303', 'SHD304',
+            'SHD305'} <= set(RULE_CATALOG)
+
+
+def test_rule_reference_page_enumerates_every_rule():
+    """docs/source/modules/lint-rules.rst is the rendered face of the
+    catalog — every TRC/SRC/RCP/SHD rule id must appear on it."""
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    page = os.path.join(repo, 'docs', 'source', 'modules',
+                        'lint-rules.rst')
+    with open(page) as f:
+        rst = f.read()
+    for rule in RULE_CATALOG:
+        assert f'``{rule}``' in rst, f'{rule} missing from lint-rules.rst'
 
 
 def test_json_report_and_fail_on_new(bad_tree, tmp_path, capsys):
     baseline = str(tmp_path / 'bl.json')
     args = ['--json', '--skip-trace', '--skip-recompile',
+            '--skip-sharded',
             '--source-root', bad_tree, '--baseline', baseline]
     rc, out = _run(args + ['--fail-on', 'new'], capsys)
     assert rc == 1
@@ -54,6 +70,7 @@ def test_json_report_and_fail_on_new(bad_tree, tmp_path, capsys):
 def test_baseline_roundtrip_suppresses(bad_tree, tmp_path, capsys):
     baseline = str(tmp_path / 'bl.json')
     args = ['--json', '--skip-trace', '--skip-recompile',
+            '--skip-sharded',
             '--source-root', bad_tree, '--baseline', baseline]
     rc, _ = _run(args + ['--write-baseline'], capsys)
     assert rc == 0
@@ -70,6 +87,7 @@ def test_baseline_roundtrip_suppresses(bad_tree, tmp_path, capsys):
 def test_fail_on_error_ignores_warnings(bad_tree, tmp_path, capsys):
     baseline = str(tmp_path / 'bl.json')
     args = ['--json', '--skip-trace', '--skip-recompile',
+            '--skip-sharded',
             '--source-root', bad_tree, '--baseline', baseline]
     # Only warnings (drop the SRC101 ERROR): rc 0 under --fail-on error.
     rc, _ = _run(args + ['--fail-on', 'error',
@@ -84,6 +102,7 @@ def test_fail_on_error_ignores_warnings(bad_tree, tmp_path, capsys):
 def test_min_severity_filter(bad_tree, tmp_path, capsys):
     baseline = str(tmp_path / 'bl.json')
     args = ['--json', '--skip-trace', '--skip-recompile',
+            '--skip-sharded',
             '--source-root', bad_tree, '--baseline', baseline,
             '--fail-on', 'none']
     rc, out = _run(args + ['--min-severity', 'error'], capsys)
@@ -95,7 +114,7 @@ def test_min_severity_filter(bad_tree, tmp_path, capsys):
 
 def test_unknown_rule_is_a_usage_error(bad_tree, tmp_path, capsys):
     rc, _ = _run(['--json', '--skip-trace', '--skip-recompile',
-                  '--source-root', bad_tree,
+                  '--skip-sharded', '--source-root', bad_tree,
                   '--baseline', str(tmp_path / 'bl.json'),
                   '--rules', 'NOPE999'], capsys)
     assert rc == 2
@@ -121,7 +140,7 @@ def test_write_baseline_preserves_unanalyzed_tiers(bad_tree, tmp_path,
                'message': 'm', 'fingerprint': 'feedfacefeedface'}
     (tmp_path / 'bl.json').write_text(json.dumps(
         {'version': 1, 'findings': [sharded]}))
-    rc, _ = _run(['--skip-trace', '--skip-recompile',
+    rc, _ = _run(['--skip-trace', '--skip-recompile', '--skip-sharded',
                   '--source-root', bad_tree, '--baseline', baseline,
                   '--write-baseline'], capsys)
     assert rc == 0
@@ -129,6 +148,118 @@ def test_write_baseline_preserves_unanalyzed_tiers(bad_tree, tmp_path,
     fps = {e['fingerprint'] for e in entries}
     assert 'feedfacefeedface' in fps, 'skipped-tier entry was dropped'
     assert len(fps) > 1, 'current source findings missing'
+
+
+def test_explain_prints_what_why_fix(capsys):
+    rc, out = _run(['--explain', 'SHD301'], capsys)
+    assert rc == 0
+    assert 'SHD301' in out
+    for section in ('What:', 'Why:', 'Fix:'):
+        assert section in out
+    # Multiple rules, comma-separated, across tiers.
+    rc, out = _run(['--explain', 'TRC004,SHD305'], capsys)
+    assert rc == 0
+    assert 'TRC004' in out and 'SHD305' in out
+
+
+def test_explain_unknown_rule_is_a_usage_error(capsys):
+    assert _run(['--explain', 'SHD999'], capsys)[0] == 2
+
+
+def test_select_and_ignore_filtering(bad_tree, tmp_path, capsys):
+    baseline = str(tmp_path / 'bl.json')
+    args = ['--json', '--skip-trace', '--skip-recompile',
+            '--skip-sharded', '--source-root', bad_tree,
+            '--baseline', baseline, '--fail-on', 'none']
+    rc, out = _run(args + ['--select', 'SRC101,SRC103'], capsys)
+    assert rc == 0
+    rules = {f['rule'] for f in json.loads(out)['findings']}
+    assert rules == {'SRC101', 'SRC103'}
+    rc, out = _run(args + ['--ignore', 'SRC101,SRC103'], capsys)
+    assert rc == 0
+    rules = {f['rule'] for f in json.loads(out)['findings']}
+    assert rules and 'SRC101' not in rules and 'SRC103' not in rules
+    # select and ignore compose (ignore wins on the intersection).
+    rc, out = _run(args + ['--select', 'SRC101,SRC102',
+                           '--ignore', 'SRC101'], capsys)
+    assert {f['rule'] for f in json.loads(out)['findings']} == {'SRC102'}
+    assert _run(args + ['--ignore', 'NOPE1'], capsys)[0] == 2
+
+
+def test_prune_baseline_drops_only_stale_entries(bad_tree, tmp_path,
+                                                 capsys):
+    """--prune-baseline: entries that stopped reproducing go, entries
+    still live stay, entries of un-analyzed tiers are protected — and
+    nothing NEW is ever added (that stays a --write-baseline review)."""
+    baseline = str(tmp_path / 'bl.json')
+    args = ['--skip-trace', '--skip-recompile', '--skip-sharded',
+            '--source-root', bad_tree, '--baseline', baseline]
+    rc, _ = _run(args + ['--write-baseline'], capsys)
+    assert rc == 0
+    entries = json.loads((tmp_path / 'bl.json').read_text())['findings']
+    n_live = len(entries)
+    assert n_live > 0
+    # Seed one stale source entry (will not reproduce) and one TRC
+    # entry (its tier is skipped in this run -> protected).
+    entries.append({'rule': 'SRC103', 'severity': 'warning',
+                    'where': 'pkg/gone.py:1', 'message': 'stale',
+                    'fingerprint': 'deadbeefdeadbeef'})
+    entries.append({'rule': 'TRC005', 'severity': 'info',
+                    'where': 'forward_dense:dgmc_tpu/x.py:1',
+                    'message': 'm', 'fingerprint': 'feedfacefeedface'})
+    (tmp_path / 'bl.json').write_text(json.dumps(
+        {'version': 1, 'tool': 'dgmc-lint', 'findings': entries}))
+    rc, out = _run(args + ['--prune-baseline'], capsys)
+    assert rc == 0
+    assert 'pruned 1 stale entry' in out
+    fps = {e['fingerprint'] for e in json.loads(
+        (tmp_path / 'bl.json').read_text())['findings']}
+    assert 'deadbeefdeadbeef' not in fps, 'stale entry kept'
+    assert 'feedfacefeedface' in fps, 'skipped-tier entry pruned'
+    assert len(fps) == n_live + 1
+    # After the prune, the live findings still suppress cleanly.
+    assert _run(['--json'] + args + ['--fail-on', 'new'], capsys)[0] == 0
+
+
+def test_select_skips_unselected_tiers(bad_tree, tmp_path, capsys):
+    """--select SRC... must not pay the trace/SHD tiers' specimen
+    compiles (the dominant lint cost) for findings the filter would
+    drop anyway."""
+    rc = main(['--select', 'SRC102', '--source-root', bad_tree,
+               '--baseline', str(tmp_path / 'bl.json'),
+               '--fail-on', 'none'])
+    err = capsys.readouterr().err
+    assert rc == 0
+    assert 'source tier' in err
+    assert 'trace ' not in err, 'trace tier ran despite --select SRC102'
+    assert 'sharded-hlo' not in err, 'SHD tier ran despite --select'
+
+
+def test_prune_baseline_ignores_min_severity(bad_tree, tmp_path,
+                                             capsys):
+    """--prune-baseline --min-severity error must not classify
+    still-reproducing warning/info suppressions as stale: severity is a
+    report filter, not an analysis boundary."""
+    baseline = str(tmp_path / 'bl.json')
+    args = ['--skip-trace', '--skip-recompile', '--skip-sharded',
+            '--source-root', bad_tree, '--baseline', baseline]
+    rc, _ = _run(args + ['--write-baseline'], capsys)
+    assert rc == 0
+    entries = json.loads((tmp_path / 'bl.json').read_text())['findings']
+    assert any(e['severity'] != 'error' for e in entries)
+    n_live = len(entries)
+    rc, out = _run(args + ['--prune-baseline',
+                           '--min-severity', 'error'], capsys)
+    assert rc == 0
+    assert 'pruned 0 stale entries' in out
+    kept = json.loads((tmp_path / 'bl.json').read_text())['findings']
+    assert len(kept) == n_live
+
+
+def test_prune_and_write_are_mutually_exclusive(tmp_path, capsys):
+    rc, _ = _run(['--write-baseline', '--prune-baseline',
+                  '--baseline', str(tmp_path / 'bl.json')], capsys)
+    assert rc == 2
 
 
 def test_obs_dir_recompile_crosscheck(tmp_path, capsys):

@@ -1,0 +1,79 @@
+"""Lint runtime: one build/trace/lower/compile per specimen per run.
+
+Before the :class:`SpecimenCache`, the trace tier and every other
+consumer of a specimen each traced and compiled their own copy of the
+program (the donation rule compiled one, ``obs.cost`` lowered another).
+These tests pin the dedup at the ``jax.stages`` boundary: running the
+trace tier AND the sharded tier over the same donating mesh specimen
+costs exactly one ``Traced.lower`` and one ``Lowered.compile``.
+"""
+
+import jax
+import jax.stages
+import pytest
+
+from dgmc_tpu.analysis.registry import (SpecimenCache, default_specimens,
+                                        run_trace_tier)
+from dgmc_tpu.analysis.shd_rules import run_sharded_tier
+
+
+def _specimen(name):
+    (spec,) = [s for s in default_specimens() if s.name == name]
+    return spec
+
+
+@pytest.fixture
+def stage_counters(monkeypatch):
+    calls = {'lower': 0, 'compile': 0}
+    orig_lower = jax.stages.Traced.lower
+    orig_compile = jax.stages.Lowered.compile
+
+    def lower(self, *a, **k):
+        calls['lower'] += 1
+        return orig_lower(self, *a, **k)
+
+    def compile(self, *a, **k):  # noqa: A001 - jax's own method name
+        calls['compile'] += 1
+        return orig_compile(self, *a, **k)
+
+    monkeypatch.setattr(jax.stages.Traced, 'lower', lower)
+    monkeypatch.setattr(jax.stages.Lowered, 'compile', compile)
+    return calls
+
+
+@pytest.mark.skipif(len(jax.devices()) < 2, reason='needs 2 devices')
+def test_trace_and_sharded_tiers_share_one_lowering(stage_counters):
+    """The donating GSPMD train-step specimen crosses BOTH tiers (jaxpr
+    + donation rules, then the SHD communication rules) on a single
+    lowering and a single compile."""
+    spec = _specimen('parallel.sharded_train_step')
+    cache = SpecimenCache()
+    run_trace_tier([spec], cache=cache)
+    run_sharded_tier([spec], cache=cache)
+    assert stage_counters == {'lower': 1, 'compile': 1}
+    assert cache.stats()[spec.name] == {
+        'builds': 1, 'traces': 1, 'lowerings': 1, 'compiles': 1}
+
+
+def test_non_donating_specimen_never_compiles(stage_counters):
+    """A single-device, non-donating specimen needs only its jaxpr —
+    the trace tier must not pay a lowering or a compile for it."""
+    spec = _specimen('ops.masked_softmax')
+    cache = SpecimenCache()
+    run_trace_tier([spec], cache=cache)
+    assert stage_counters == {'lower': 0, 'compile': 0}
+    assert cache.stats()[spec.name] == {
+        'builds': 1, 'traces': 1, 'lowerings': 0, 'compiles': 0}
+
+
+def test_artifacts_are_lazy_and_idempotent():
+    """Repeated artifact pulls return the same objects without
+    re-running any stage."""
+    spec = _specimen('ops.masked_softmax')
+    cache = SpecimenCache()
+    art = cache.artifacts(spec)
+    assert art is cache.artifacts(spec)
+    j1 = art.closed_jaxpr()
+    j2 = art.closed_jaxpr()
+    assert j1 is j2
+    assert art.stats['traces'] == 1

@@ -34,6 +34,30 @@ def test_incomparable_buckets_are_clean():
     assert analyze_buckets(buckets) == []
 
 
+def test_pair_batch_axis_is_not_a_padding_axis():
+    """No RCP201 churn across B in {1, 2}: the --pairs-per-step batch
+    axis is structural (padding B replicates the whole per-pair cost
+    and changes the step's gradient semantics), so same-padding buckets
+    that differ only in B are distinct programs by design."""
+    buckets = [_bucket(1, '32x40', '64x80'),
+               _bucket(2, '32x40', '64x80')]
+    assert analyze_buckets(buckets) == []
+    # ... and they stay distinct signatures for the RCP202 budget.
+    assert (bucket_signature(buckets[0])
+            != bucket_signature(buckets[1]))
+
+
+def test_domination_still_fires_at_equal_pair_batch():
+    """The B-axis carve-out must not blind the rule to real padding
+    churn: smaller node padding at the SAME B is still dominated."""
+    buckets = [_bucket(2, '32x40', '64x80'),
+               _bucket(2, '24x40', '64x80'),
+               _bucket(1, '24x40', '64x80')]
+    findings = analyze_buckets(buckets)
+    assert [f.rule for f in findings] == ['RCP201']
+    assert 'B=2,nodes=24x40' in findings[0].message
+
+
 def test_single_bucket_is_clean():
     assert analyze_buckets([_bucket(8, '32x40', '64x80')]) == []
 

@@ -1,0 +1,343 @@
+"""SHD tier golden fixtures: each rule detected by exactly that rule,
+plus clean controls and the real-specimen drive.
+
+The fixtures are hand-seeded partitioned-HLO programs — the defect
+classes (a branch-divergent collective, an f32->bf16 downcast before a
+reduce) cannot be coaxed out of healthy jax code on purpose, which is
+the point of a static analyzer: it reads what the compiler produced,
+wherever it came from.
+"""
+
+import jax
+import pytest
+
+from dgmc_tpu.analysis.shd_rules import ShardedContext, analyze_sharded_hlo
+
+
+def _rules(findings):
+    return sorted({f.rule for f in findings})
+
+
+# --- SHD301: deliberately-seeded branch-divergent collective ------------
+
+DIVERGENT_COND = (
+    '%add (a: f32[], b: f32[]) -> f32[] {\n'
+    '  %a = f32[] parameter(0)\n'
+    '  %b = f32[] parameter(1)\n'
+    '  ROOT %s = f32[] add(f32[] %a, f32[] %b)\n'
+    '}\n'
+    '\n'
+    '%branch_comm (p0: f32[4]) -> f32[4] {\n'
+    '  %p0 = f32[4]{0} parameter(0)\n'
+    '  ROOT %ar = f32[4]{0} all-reduce(f32[4]{0} %p0), channel_id=2,'
+    ' replica_groups={{0,1},{2,3}}, to_apply=%add\n'
+    '}\n'
+    '\n'
+    '%branch_silent (p1: f32[4]) -> f32[4] {\n'
+    '  ROOT %p1 = f32[4]{0} parameter(0)\n'
+    '}\n'
+    '\n'
+    'ENTRY %main (pred.1: s32[], x: f32[4]) -> f32[4] {\n'
+    '  %pred.1 = s32[] parameter(0)\n'
+    '  %x = f32[4]{0} parameter(1)\n'
+    '  ROOT %c = f32[4]{0} conditional(s32[] %pred.1, f32[4]{0} %x,'
+    ' f32[4]{0} %x),'
+    ' branch_computations={%branch_comm, %branch_silent}\n'
+    '}\n'
+)
+
+CONVERGENT_COND = DIVERGENT_COND.replace(
+    'ROOT %p1 = f32[4]{0} parameter(0)',
+    '%p1 = f32[4]{0} parameter(0)\n'
+    '  ROOT %ar2 = f32[4]{0} all-reduce(f32[4]{0} %p1), channel_id=3, '
+    'replica_groups={{0,1},{2,3}}, to_apply=%add')
+
+
+def test_shd301_branch_divergent_collective():
+    findings = analyze_sharded_hlo(DIVERGENT_COND,
+                                   ShardedContext(specimen='fix'))
+    assert _rules(findings) == ['SHD301']
+    (f,) = findings
+    assert f.severity.name == 'ERROR'
+    assert '[all-reduce] vs []' in f.message
+    assert f.where.startswith('fix:')
+
+
+def test_shd301_matching_branches_are_clean():
+    assert analyze_sharded_hlo(CONVERGENT_COND,
+                               ShardedContext(specimen='fix')) == []
+
+
+# --- SHD302: correspondence-shaped all-gather ---------------------------
+
+CORR_GATHER = (
+    'ENTRY %main (s_shard: f32[2,4,10]) -> f32[2,8,10] {\n'
+    '  %s_shard = f32[2,4,10]{2,1,0} parameter(0)\n'
+    '  ROOT %ag = f32[2,8,10]{2,1,0}'
+    ' all-gather(f32[2,4,10]{2,1,0} %s_shard), channel_id=5,'
+    ' replica_groups={{0,1}}, dimensions={1}, metadata={'
+    'op_name="jit(fwd)/jit(main)/initial_corr/sharding_constraint"'
+    ' source_file="/x/dgmc_tpu/models/dgmc.py" source_line=437}\n'
+    '}\n'
+)
+
+PARAM_GATHER = (
+    'ENTRY %main (w: f32[128]) -> f32[256] {\n'
+    '  %w = f32[128]{0} parameter(0)\n'
+    '  ROOT %ag = f32[256]{0} all-gather(f32[128]{0} %w),'
+    ' channel_id=5, replica_groups={{0,1}}, dimensions={0}\n'
+    '}\n'
+)
+
+
+def test_shd302_corr_shaped_all_gather():
+    ctx = ShardedContext(specimen='fix', corr_bytes=2 * 8 * 10 * 4)
+    findings = analyze_sharded_hlo(CORR_GATHER, ctx)
+    assert _rules(findings) == ['SHD302']
+    (f,) = findings
+    assert f.severity.name == 'ERROR'
+    assert 'f32[2,8,10]' in f.message
+    assert f.where == 'fix:dgmc_tpu/models/dgmc.py:437'
+
+
+def test_shd302_param_gather_is_clean():
+    """A rank-1 parameter gather bigger than corr_bytes must NOT fire:
+    the rule targets correspondence-SHAPED results, not any big
+    gather."""
+    ctx = ShardedContext(specimen='fix', corr_bytes=64)
+    assert analyze_sharded_hlo(PARAM_GATHER, ctx) == []
+
+
+def test_shd302_needs_declared_corr_shape():
+    assert analyze_sharded_hlo(CORR_GATHER,
+                               ShardedContext(specimen='fix')) == []
+
+
+# --- SHD303: resharding churn in the loop body --------------------------
+
+RESHARD_CHURN = (
+    '%body (carry: (s32[], f32[4,8])) -> (s32[], f32[4,8]) {\n'
+    '  %carry = (s32[], f32[4,8]{1,0}) parameter(0)\n'
+    '  %s = f32[4,8]{1,0}'
+    ' get-tuple-element((s32[], f32[4,8]{1,0}) %carry), index=1\n'
+    '  %cp1 = f32[4,8]{1,0} collective-permute(f32[4,8]{1,0} %s),'
+    ' channel_id=1, source_target_pairs={{0,1},{1,0}}\n'
+    '  %neg = f32[4,8]{1,0} negate(f32[4,8]{1,0} %cp1)\n'
+    '  %cp2 = f32[4,8]{1,0} collective-permute(f32[4,8]{1,0} %neg),'
+    ' channel_id=2, source_target_pairs={{1,0},{0,1}}\n'
+    '  %i = s32[] get-tuple-element((s32[], f32[4,8]{1,0}) %carry),'
+    ' index=0\n'
+    '  ROOT %t = (s32[], f32[4,8]{1,0}) tuple(s32[] %i,'
+    ' f32[4,8]{1,0} %cp2)\n'
+    '}\n'
+    '\n'
+    '%cond (c: (s32[], f32[4,8])) -> pred[] {\n'
+    '  %c = (s32[], f32[4,8]{1,0}) parameter(0)\n'
+    '  %i.1 = s32[] get-tuple-element((s32[], f32[4,8]{1,0}) %c),'
+    ' index=0\n'
+    '  %lim = s32[] constant(10)\n'
+    '  ROOT %lt = pred[] compare(s32[] %i.1, s32[] %lim),'
+    ' direction=LT\n'
+    '}\n'
+    '\n'
+    'ENTRY %main (x: f32[4,8], i0: s32[]) -> f32[4,8] {\n'
+    '  %x = f32[4,8]{1,0} parameter(0)\n'
+    '  %i0 = s32[] parameter(1)\n'
+    '  %init = (s32[], f32[4,8]{1,0}) tuple(s32[] %i0,'
+    ' f32[4,8]{1,0} %x)\n'
+    '  %loop = (s32[], f32[4,8]{1,0})'
+    ' while((s32[], f32[4,8]{1,0}) %init), condition=%cond,'
+    ' body=%body, metadata={'
+    'op_name="jit(f)/jit(main)/consensus_iter/while"'
+    ' source_file="/x/dgmc_tpu/models/dgmc.py" source_line=451}\n'
+    '  ROOT %out = f32[4,8]{1,0}'
+    ' get-tuple-element((s32[], f32[4,8]{1,0}) %loop), index=1\n'
+    '}\n'
+)
+
+
+def test_shd303_reshard_churn_in_loop_body():
+    findings = analyze_sharded_hlo(RESHARD_CHURN,
+                                   ShardedContext(specimen='fix'))
+    assert _rules(findings) == ['SHD303']
+    (f,) = findings
+    assert f.severity.name == 'WARNING'
+    assert 'loop body' in f.message
+    assert f.where == 'fix:dgmc_tpu/models/dgmc.py:451'
+
+
+def test_shd303_single_permute_is_clean():
+    one = RESHARD_CHURN.replace(
+        '%cp2 = f32[4,8]{1,0} collective-permute(f32[4,8]{1,0} %neg), '
+        'channel_id=2, source_target_pairs={{1,0},{0,1}}',
+        '%cp2 = f32[4,8]{1,0} negate(f32[4,8]{1,0} %neg)')
+    assert analyze_sharded_hlo(one, ShardedContext(specimen='fix')) == []
+
+
+# --- SHD304: communication budget ---------------------------------------
+
+BIG_COMM = (
+    '%add (a: f32[], b: f32[]) -> f32[] {\n'
+    '  %a = f32[] parameter(0)\n'
+    '  %b = f32[] parameter(1)\n'
+    '  ROOT %s = f32[] add(f32[] %a, f32[] %b)\n'
+    '}\n'
+    '\n'
+    'ENTRY %main (g: f32[1024,64]) -> f32[1024,64] {\n'
+    '  %g = f32[1024,64]{1,0} parameter(0)\n'
+    '  ROOT %ar = f32[1024,64]{1,0}'
+    ' all-reduce(f32[1024,64]{1,0} %g), channel_id=1,'
+    ' replica_groups={{0,1}}, to_apply=%add\n'
+    '}\n'
+)
+
+
+def test_shd304_comm_budget_exceeded():
+    ctx = ShardedContext(specimen='fix', comm_budget_bytes=1024)
+    findings = analyze_sharded_hlo(BIG_COMM, ctx)
+    assert _rules(findings) == ['SHD304']
+    (f,) = findings
+    assert f.severity.name == 'WARNING'
+    assert f.where == 'fix:comm-budget'
+    assert '<= 256 KiB' in f.message        # 1024*64*4 = 256 KiB exactly
+    assert 'all-reduce: 262144 B' in f.detail
+
+
+def test_shd304_within_budget_is_clean():
+    ctx = ShardedContext(specimen='fix', comm_budget_bytes=1 << 20)
+    assert analyze_sharded_hlo(BIG_COMM, ctx) == []
+
+
+def test_shd304_needs_a_budget():
+    assert analyze_sharded_hlo(BIG_COMM,
+                               ShardedContext(specimen='fix')) == []
+
+
+# --- SHD305: f32->bf16 downcast before a reduction ----------------------
+
+DOWNCAST_REDUCE = (
+    '%sum (a: bf16[], b: bf16[]) -> bf16[] {\n'
+    '  %a = bf16[] parameter(0)\n'
+    '  %b = bf16[] parameter(1)\n'
+    '  ROOT %s = bf16[] add(bf16[] %a, bf16[] %b)\n'
+    '}\n'
+    '\n'
+    'ENTRY %main (x: f32[128,128]) -> bf16[128] {\n'
+    '  %x = f32[128,128]{1,0} parameter(0)\n'
+    '  %cast = bf16[128,128]{1,0} convert(f32[128,128]{1,0} %x),'
+    ' metadata={op_name="jit(f)/jit(main)/loss/convert"'
+    ' source_file="/x/dgmc_tpu/train/steps.py" source_line=88}\n'
+    '  %zero = bf16[] constant(0)\n'
+    '  ROOT %r = bf16[128]{0} reduce(bf16[128,128]{1,0} %cast,'
+    ' bf16[] %zero), dimensions={1}, to_apply=%sum, metadata={'
+    'op_name="jit(f)/jit(main)/loss/reduce_sum"'
+    ' source_file="/x/dgmc_tpu/train/steps.py" source_line=90}\n'
+    '}\n'
+)
+
+F32_ACCUM_REDUCE = (
+    '%sum (a: f32[], b: f32[]) -> f32[] {\n'
+    '  %a = f32[] parameter(0)\n'
+    '  %b = f32[] parameter(1)\n'
+    '  ROOT %s = f32[] add(f32[] %a, f32[] %b)\n'
+    '}\n'
+    '\n'
+    'ENTRY %main (x: bf16[128,128]) -> f32[128] {\n'
+    '  %x = bf16[128,128]{1,0} parameter(0)\n'
+    '  %cast = f32[128,128]{1,0} convert(bf16[128,128]{1,0} %x)\n'
+    '  %zero = f32[] constant(0)\n'
+    '  ROOT %r = f32[128]{0} reduce(f32[128,128]{1,0} %cast,'
+    ' f32[] %zero), dimensions={1}, to_apply=%sum\n'
+    '}\n'
+)
+
+BF16_DOT = (
+    'ENTRY %main (a: bf16[8,512], b: bf16[512,8]) -> bf16[8,8] {\n'
+    '  %a = bf16[8,512]{1,0} parameter(0)\n'
+    '  %b = bf16[512,8]{1,0} parameter(1)\n'
+    '  ROOT %d = bf16[8,8]{1,0} dot(bf16[8,512]{1,0} %a,'
+    ' bf16[512,8]{1,0} %b), lhs_contracting_dims={1},'
+    ' rhs_contracting_dims={0}\n'
+    '}\n'
+)
+
+BF16_DOT_F32_OUT = BF16_DOT.replace('-> bf16[8,8]', '-> f32[8,8]').replace(
+    'ROOT %d = bf16[8,8]{1,0} dot', 'ROOT %d = f32[8,8]{1,0} dot')
+
+
+def test_shd305_downcast_before_reduce():
+    findings = analyze_sharded_hlo(DOWNCAST_REDUCE,
+                                   ShardedContext(specimen='fix'))
+    assert _rules(findings) == ['SHD305']
+    (f,) = findings
+    assert f.severity.name == 'ERROR'
+    assert 'f32->bf16 downcast feeds `reduce`' in f.message
+    assert f.where == 'fix:dgmc_tpu/train/steps.py:90'
+    assert '128 element(s)' in f.detail
+
+
+def test_shd305_f32_accumulation_is_clean():
+    assert analyze_sharded_hlo(F32_ACCUM_REDUCE,
+                               ShardedContext(specimen='fix')) == []
+
+
+def test_shd305_bf16_dot_accumulator():
+    findings = analyze_sharded_hlo(BF16_DOT,
+                                   ShardedContext(specimen='fix'))
+    assert _rules(findings) == ['SHD305']
+    assert '`dot` accumulates in bf16' in findings[0].message
+    # No source metadata on this op: the fallback location must be
+    # structural (opcode + ordinal), never the compiler's drifting
+    # computation/result names.
+    assert findings[0].where == 'fix:dot#0'
+
+
+def test_shd305_dot_with_f32_out_is_clean():
+    """preferred_element_type=f32 shows up as an f32 dot result — the
+    contract-compliant spelling must not fire."""
+    assert analyze_sharded_hlo(BF16_DOT_F32_OUT,
+                               ShardedContext(specimen='fix')) == []
+
+
+def test_shd305_short_reduction_is_below_threshold():
+    short = DOWNCAST_REDUCE.replace('128,128', '128,8').replace(
+        'f32[128,128]', 'f32[128,8]')
+    assert analyze_sharded_hlo(short,
+                               ShardedContext(specimen='fix')) == []
+
+
+# --- real specimens through the tier driver -----------------------------
+
+
+@pytest.mark.skipif(len(jax.devices()) < 4, reason='needs 4 devices')
+def test_sharded_tier_runs_clean_on_registered_specimens():
+    """The registered multi-device specimens compile under their meshes
+    and produce ONLY SHD-rule findings (today: none — the repo's
+    sharded programs are communication-clean; any future finding lands
+    in the baseline as a reviewed SHD entry, never as TRC drift)."""
+    from dgmc_tpu.analysis.registry import SpecimenCache
+    from dgmc_tpu.analysis.shd_rules import run_sharded_tier
+    cache = SpecimenCache()
+    findings = run_sharded_tier(cache=cache)
+    assert all(f.rule.startswith('SHD') for f in findings)
+    assert sorted(cache.stats()) == [
+        'parallel.sharded_forward_rows', 'parallel.sharded_topk_cols',
+        'parallel.sharded_train_step',
+        'parallel.sharded_train_step_pairs2']
+
+
+@pytest.mark.skipif(len(jax.devices()) < 2, reason='needs 2 devices')
+def test_distributed_topk_specimen_schedule_has_its_gather():
+    """The parallel/topk.py column-sharded specimen's partitioned HLO
+    exposes the candidate all_gather — and it is (by design) far
+    smaller than the N_s x N_t matrix it avoids, so SHD302 stays
+    quiet."""
+    from dgmc_tpu.analysis.hlo_comm import collective_schedule
+    from dgmc_tpu.analysis.registry import SpecimenCache, default_specimens
+    (spec,) = [s for s in default_specimens()
+               if s.name == 'parallel.sharded_topk_cols']
+    art = SpecimenCache().artifacts(spec)
+    sched = collective_schedule(art.compiled().as_text())
+    gathers = [c for c in sched if c.kind == 'all-gather']
+    assert gathers, 'candidate merge all_gather missing from schedule'
+    assert all(c.nbytes < art.built()['corr_bytes'] for c in gathers)
