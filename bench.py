@@ -120,10 +120,15 @@ SP_K = 10
 # comparable with the stored B=1 baseline; the f32 leg stays B=1 as the
 # baseline-seeding anchor.
 SP_PAIRS = 2
-# Within noise of 1024/4096 in the r03 sweep (18.19/18.09/18.12 ms; the
-# Pallas kernel ignores the block size entirely); kept at 256 for the lower
-# peak tile memory of the scan fallback paths.
-SP_TOPK_BLOCK = 256
+# The ONE measured candidate-search block default (within noise of
+# 1024/4096 in the r03 sweep — 18.19/18.09/18.12 ms; the Pallas kernel
+# ignores the knob entirely; lowest peak tile memory on the scan paths).
+# Threaded from ops/topk.DEFAULT_BLOCK — the same constant the
+# partition-rule config (parallel/rules.DEFAULT_TOPK_BLOCK) hands every
+# sharded callsite — so the bench measures the shipped default, not a
+# bench-local literal (benchmarks/DISPATCH_DEFAULTS.md, block-size
+# section).
+from dgmc_tpu.ops.topk import DEFAULT_BLOCK as SP_TOPK_BLOCK  # noqa: E402
 SP_ITERS = 10
 TOPK_ITERS = 10
 

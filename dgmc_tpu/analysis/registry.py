@@ -302,6 +302,53 @@ def _sharded_train_step_pairs_specimen():
     return build
 
 
+def _streamed_train_step_specimen():
+    """Streamed-S donating train step (ROADMAP item 3's million-entity
+    layout at fixture scale): the partition-rule config from
+    ``parallel/rules.streamed_rules`` — S/shortlist/ψ₂-source rows
+    sharded over ``data``, candidate search streamed over source chunks
+    — compiled on a 4-device data mesh. Its declared ``corr_bytes`` is
+    the full dense ``[B, N_s, N_t]`` S the design must never
+    materialize (SHD302: an all-gather that size is the defeat), and
+    ``comm_budget_bytes`` pins the per-step collective payload (SHD304)
+    so communication growth in the streamed path fails
+    ``--fail-on new``. Budget basis: the compiled fixture program moves
+    ~7.5 KiB of collectives per step — 30 all-reduces (grad psums,
+    7.19 KiB) + 8 shard-boundary collective-permutes (320 B), measured
+    via ``python -m dgmc_tpu.obs.cost --specimens
+    parallel.streamed_train_step``; 64 KiB holds ~8x headroom for
+    layout jitter while still failing on a structural regression (one
+    extra all-gathered activation at fixture scale adds tens of KiB;
+    an S-sized replication additionally trips SHD302)."""
+    def build():
+        import jax
+
+        from dgmc_tpu.models import DGMC, RelCNN
+        from dgmc_tpu.parallel import make_mesh, streamed_rules
+        from dgmc_tpu.parallel.sharding import make_sharded_train_step
+        from dgmc_tpu.train import create_train_state
+        one = _pair_batch(np.random.RandomState(0), n_s=16, e_s=32,
+                          n_t=24, e_t=48)
+        model = DGMC(RelCNN(4, 8, num_layers=1),
+                     RelCNN(4, 4, num_layers=1), num_steps=1, k=4)
+        state = create_train_state(model, jax.random.key(0), one,
+                                   learning_rate=1e-3)
+        mesh = make_mesh(data=4, model=1, devices=jax.devices()[:4])
+        rules = streamed_rules(stream_chunk=8)
+        step = make_sharded_train_step(model, mesh, rules=rules,
+                                       state=state)
+        state_sh, batch_sh = rules.place(state, one, mesh)
+        b, n_s = one.y.shape
+        n_t = one.t.x.shape[1]
+        return {'fn': step,
+                'args': (state_sh, batch_sh, jax.random.key(1)),
+                'prejitted': True,
+                'donate_argnums': (0,),
+                'corr_bytes': b * n_s * n_t * 4,
+                'comm_budget_bytes': 64 << 10}
+    return build
+
+
 def _sharded_topk_cols_specimen():
     """``parallel/topk.py`` distributed top-k, column-sharded: local
     blockwise top-k per shard + one candidate all_gather. Its declared
@@ -353,6 +400,9 @@ def default_specimens() -> List[Specimen]:
                  tiers=('shd',)),
         Specimen('parallel.sharded_train_step_pairs2',
                  _sharded_train_step_pairs_specimen(), min_devices=4,
+                 tiers=('shd',)),
+        Specimen('parallel.streamed_train_step',
+                 _streamed_train_step_specimen(), min_devices=4,
                  tiers=('shd',)),
         Specimen('parallel.sharded_topk_cols',
                  _sharded_topk_cols_specimen(), min_devices=2,

@@ -1,6 +1,7 @@
 from dgmc_tpu.data.transforms import (Compose, Constant, KNNGraph, Delaunay,
                                       FaceToEdge, Cartesian, Distance)
-from dgmc_tpu.data.synthetic import RandomGraphPairs
+from dgmc_tpu.data.synthetic import (RandomGraphPairs, SyntheticKG,
+                                     synthetic_kg_alignment)
 
 __all__ = [
     'Compose',
@@ -11,4 +12,6 @@ __all__ = [
     'Cartesian',
     'Distance',
     'RandomGraphPairs',
+    'SyntheticKG',
+    'synthetic_kg_alignment',
 ]

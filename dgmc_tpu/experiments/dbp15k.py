@@ -97,6 +97,53 @@ def parse_args(argv=None):
     parser.add_argument('--model_shards', type=int, default=0,
                         help='shard correspondence rows over N devices '
                              '(0 = no sharding)')
+    parser.add_argument('--row_shards', type=int, default=0,
+                        help='million-entity layout (parallel/rules.py '
+                             'streamed_rules): row-shard the '
+                             'correspondence matrix, shortlist and ψ₂ '
+                             'source intermediates over N devices on '
+                             'the data axis, with the candidate search '
+                             'streamed over source chunks; the whole '
+                             'sharding config is the declarative '
+                             'partition-rule object, not per-callsite '
+                             'in_shardings. Mutually exclusive with '
+                             '--model_shards')
+    parser.add_argument('--aot_compile', action='store_true',
+                        help='AOT-compile the executed phase/eval steps '
+                             '(lower+compile up front, replacing the '
+                             'lazy jit) and record each executable\'s '
+                             'static per-device memory bound '
+                             '(memory_analysis: argument+output+temp '
+                             'bytes, post-GSPMD so PER DEVICE) into the '
+                             'obs metrics — the peak-HBM evidence for '
+                             'sharded scale runs, usable even where the '
+                             'live allocator publishes nothing (CPU, '
+                             'tunneled platforms)')
+    parser.add_argument('--stream_chunk', type=int, default=0,
+                        help='stream the sparse candidate search over '
+                             'source-node chunks of this many rows, so '
+                             'the N_s x N_t sweep never exists beyond '
+                             'one [chunk, topk_block] tile (0 = off; '
+                             'defaults to 8192 under --row_shards)')
+    parser.add_argument('--blocked_adjacency', dest='blocked_adjacency',
+                        choices=['auto', 'on', 'off'], default='auto',
+                        help='scatter-free MXU aggregation tables '
+                             '(ops/blocked.py): a measured single-chip '
+                             'TPU win at DBP15K scale (sparse step 476 '
+                             '-> ~371 ms), but the padded gather tables '
+                             'scale O(E) and are REPLICATED per device '
+                             '— at 10^6 nodes they dominate the '
+                             'per-device memory budget (r7: psi_1 '
+                             'forward temps 449 vs 52 MiB at 2^17 '
+                             'nodes). "auto" = on, except under the '
+                             'row-sharded/streamed layout '
+                             '(--row_shards/--stream_chunk)')
+    parser.add_argument('--topk_block', type=int, default=0,
+                        help='candidate-search target-axis tile '
+                             '(0 = the one measured library default, '
+                             'parallel/rules.DEFAULT_TOPK_BLOCK; the '
+                             'Pallas kernel ignores it — this tunes the '
+                             'scan/streamed paths only)')
     parser.add_argument('--data_root', type=str,
                         default=os.path.join('..', 'data', 'DBP15K'))
     parser.add_argument('--seed', type=int, default=0)
@@ -131,57 +178,47 @@ def parse_args(argv=None):
     return parser.parse_args(argv)
 
 
-def synthetic_batches(args):
+def use_blocked_adjacency(args):
+    """Resolve the ``--blocked_adjacency`` policy: the blocked tables are
+    a single-chip TPU throughput win but an O(E) replicated memory cost,
+    so 'auto' drops them exactly where memory is the budget — the
+    row-sharded / streamed million-entity layout."""
+    if args.blocked_adjacency == 'on':
+        return True
+    if args.blocked_adjacency == 'off':
+        return False
+    return not (args.row_shards > 1 or args.stream_chunk)
+
+
+def synthetic_batches(args, shapes=None):
     """DBP15K-scale synthetic KG alignment (``--synthetic``).
 
-    A random source KG; the target KG holds an injectively mapped noisy
-    copy of every source entity (``x_t[perm[i]] = x_s[i] + sigma*noise``)
-    plus unaligned distractor entities, with ``syn_rewire`` of the mapped
-    edges rewired and extra distractor edges — the miniature quality
-    gate's construction (tests/models/test_two_phase_quality.py) at full
-    protocol shapes. Seeds follow the reference's 30% split.
+    The pair construction itself lives in
+    :func:`dgmc_tpu.data.synthetic.synthetic_kg_alignment` (shared with
+    the streamed-S scale benchmark); this wrapper applies the CLI's
+    precision policy, blocked-adjacency attachment and pairs-per-step
+    collation. ``shapes`` overrides ``(n_s, n_t, e_s, e_t)`` — used for
+    the tiny init stand-in of a giant pair.
     """
+    from dgmc_tpu.data.synthetic import synthetic_kg_alignment
     from dgmc_tpu.ops.blocked import attach_blocks
     from dgmc_tpu.ops.graph import GraphBatch
     from dgmc_tpu.utils.data import PairBatch
 
     rng = np.random.RandomState(args.seed)
-    n_s, n_t = args.syn_nodes_s, args.syn_nodes_t
-    e_s, e_t = args.syn_edges_s, args.syn_edges_t
+    n_s, n_t, e_s, e_t = shapes or (args.syn_nodes_s, args.syn_nodes_t,
+                                    args.syn_edges_s, args.syn_edges_t)
     c = args.syn_dim
-    assert n_t >= n_s and e_t >= e_s
-
-    # Unit-NORM feature scale (1/sqrt(c) per dim), like the real pipeline's
-    # summed word vectors (O(1) norms): N(0,1)^c features would give the
-    # initial similarity logits a std of ~sqrt(dim)·O(1) ≈ 15+, a
-    # saturated softmax whose escape is seed luck (measured: seed 0 trains,
-    # seed 1 flatlines). With O(1) feature norms the initial softmax is
-    # near-uniform and training takes off for every seed tried.
-    x_s = (rng.randn(n_s, c) / np.sqrt(c)).astype(np.float32)
-    snd = rng.randint(0, n_s, e_s).astype(np.int32)
-    rcv = rng.randint(0, n_s, e_s).astype(np.int32)
-
-    perm = rng.permutation(n_t)[:n_s].astype(np.int32)
-    x_t = (rng.randn(n_t, c) / np.sqrt(c)).astype(np.float32)
-    sigma = rng.uniform(args.syn_noise_min, args.syn_noise,
-                        (n_s, 1)).astype(np.float32)
-    # Variance-preserving blend: corr(x_s, x_t[perm]) = 1/sqrt(1+sigma^2)
-    # per entity while every target row keeps unit feature variance —
-    # un-normalized additive noise gives aligned entities systematically
-    # larger norms, and those rows then dominate every similarity row's
-    # softmax (measured: training never lifts off at full scale).
-    noise = (rng.randn(n_s, c) / np.sqrt(c)).astype(np.float32)
-    x_t[perm] = (x_s + sigma * noise) / np.sqrt(1.0 + sigma ** 2)
-    keep = rng.rand(e_s) >= args.syn_rewire
-    snd_t = np.where(keep, perm[snd], rng.randint(0, n_t, e_s))
-    rcv_t = np.where(keep, perm[rcv], rng.randint(0, n_t, e_s))
-    extra = e_t - e_s
-    snd_t = np.concatenate([snd_t, rng.randint(0, n_t, extra)])
-    rcv_t = np.concatenate([rcv_t, rng.randint(0, n_t, extra)])
+    kg = synthetic_kg_alignment(n_s, n_t, e_s, e_t, c,
+                                noise_min=args.syn_noise_min,
+                                noise_max=args.syn_noise,
+                                rewire=args.syn_rewire,
+                                seed_frac=args.syn_seed_frac, rng=rng)
 
     from dgmc_tpu.models.precision import from_args
     from dgmc_tpu.ops.blocked import repeat_graph
     prec = from_args(args)
+    blocked = use_blocked_adjacency(args)
 
     def side(x, s, r, n):
         g = GraphBatch(x=x[None], senders=s[None].astype(np.int32),
@@ -189,20 +226,20 @@ def synthetic_batches(args):
                        node_mask=np.ones((1, n), bool),
                        edge_mask=np.ones((1, s.shape[0]), bool),
                        edge_attr=None)
-        return attach_blocks(g, gather_dtype=prec)
+        return attach_blocks(g, gather_dtype=prec) if blocked else g
 
     # Train batch at B = pairs_per_step (replicas of the one pair, each
     # drawing its own per-pair indicator noise / negatives on device;
     # blocked ONCE at B=1, replicas tiled); eval keeps B=1 — replicated
     # metrics would just repeat themselves.
     reps = max(1, args.pairs_per_step)
-    e_s1, e_t1 = side(x_s, snd, rcv, n_s), side(x_t, snd_t, rcv_t, n_t)
+    e_s1 = side(kg.x_s, kg.senders_s, kg.receivers_s, n_s)
+    e_t1 = side(kg.x_t, kg.senders_t, kg.receivers_t, n_t)
     g_s, g_t = repeat_graph(e_s1, reps), repeat_graph(e_t1, reps)
-    train_mask = np.zeros(n_s, bool)
-    train_mask[:int(args.syn_seed_frac * n_s)] = True
     y_train = np.repeat(
-        np.where(train_mask, perm, -1).astype(np.int32)[None], reps, 0)
-    y_test = np.where(~train_mask, perm, -1).astype(np.int32)[None]
+        np.where(kg.train_mask, kg.perm, -1).astype(np.int32)[None],
+        reps, 0)
+    y_test = np.where(~kg.train_mask, kg.perm, -1).astype(np.int32)[None]
     return (PairBatch(s=g_s, t=g_t, y=y_train, y_mask=y_train >= 0),
             PairBatch(s=e_s1, t=e_t1, y=y_test, y_mask=y_test >= 0),
             c)
@@ -242,9 +279,12 @@ def load_batches(args):
     # identical in both batches — block them ONCE at B=1 and share; the
     # pairs-per-step train batch tiles the blocked sides (repeat_graph)
     # instead of re-running the host-side blocking per replica. Eval
-    # stays B=1.
-    e_s = attach_blocks(train_b.s, gather_dtype=prec)
-    e_t = attach_blocks(train_b.t, gather_dtype=prec)
+    # stays B=1. Policy gate: see use_blocked_adjacency.
+    if use_blocked_adjacency(args):
+        e_s = attach_blocks(train_b.s, gather_dtype=prec)
+        e_t = attach_blocks(train_b.t, gather_dtype=prec)
+    else:
+        e_s, e_t = train_b.s, train_b.t
     s_b, t_b = repeat_graph(e_s, reps), repeat_graph(e_t, reps)
     y_tr = np.repeat(train_b.y, reps, axis=0)
     m_tr = np.repeat(train_b.y_mask, reps, axis=0)
@@ -275,14 +315,39 @@ def main(argv=None):
                                    args.process_id)
     train_batch, test_batch, in_dim = load_batches(args)
 
+    if args.row_shards > 1 and args.model_shards > 1:
+        raise SystemExit('--row_shards (partition-rule streamed layout) '
+                         'and --model_shards (legacy corr sharding) are '
+                         'mutually exclusive')
     corr_sharding = None
     mesh = None
+    rules = None
     if args.model_shards > 1:
         from dgmc_tpu.parallel import corr_sharding as mk_corr, make_mesh
         mesh = make_mesh(data=1, model=args.model_shards,
                          devices=jax.devices()[:args.model_shards])
         corr_sharding = mk_corr(mesh)
+    elif args.row_shards > 1:
+        # Million-entity layout: ONE declarative config — S rows over the
+        # data axis, shortlist + ψ₂ source intermediates riding along,
+        # candidate search streamed over source chunks — consumed by the
+        # sharded step builders in place of hand-wired in_shardings.
+        from dgmc_tpu.parallel import make_mesh, streamed_rules
+        mesh = make_mesh(data=args.row_shards, model=1,
+                         devices=jax.devices()[:args.row_shards])
+        rules = streamed_rules(
+            **({'stream_chunk': args.stream_chunk}
+               if args.stream_chunk else {}),
+            **({'topk_block': args.topk_block}
+               if args.topk_block else {}))
     if nproc > 1:
+        if rules is not None:
+            raise SystemExit(
+                '--row_shards (the partition-rule streamed layout) is '
+                'single-process only for now: its state/batch placement '
+                'device_puts host arrays onto a process-local mesh. Use '
+                '--model_shards == total device count for multi-host '
+                'runs, or run the streamed layout on one host')
         if mesh is None or args.model_shards < len(jax.devices()):
             raise SystemExit(
                 'multi-process dbp15k requires --model_shards == total '
@@ -299,11 +364,24 @@ def main(argv=None):
     psi_2 = RelCNN(args.rnd_dim, args.rnd_dim, args.num_layers,
                    batch_norm=False, cat=True, lin=True, dropout=0.0,
                    dtype=prec)
+    from dgmc_tpu.parallel.rules import DEFAULT_TOPK_BLOCK
     model = DGMC(psi_1, psi_2, num_steps=args.num_steps, k=args.k,
-                 corr_sharding=corr_sharding, dtype=prec)
+                 corr_sharding=corr_sharding, dtype=prec,
+                 topk_block=args.topk_block or DEFAULT_TOPK_BLOCK,
+                 stream_chunk=(args.stream_chunk or None)
+                 if rules is None else None)
 
+    # A giant synthetic pair must not run its million-row forward EAGERLY
+    # just to infer parameter shapes — parameter values depend only on
+    # feature widths, so a tiny stand-in pair initializes identically
+    # (train/state.create_train_state docs).
+    init_batch = None
+    if args.synthetic and args.syn_nodes_s * args.syn_nodes_t > 1 << 24:
+        init_batch, _, _ = synthetic_batches(
+            args, shapes=(64, 96, 256, 384))
     state = create_train_state(model, jax.random.key(args.seed), train_batch,
-                               learning_rate=args.lr)
+                               learning_rate=args.lr,
+                               init_batch=init_batch)
     guard = args.guard_bad_steps > 0
     if guard:
         # Counters ride the state pytree (and its checkpoints), so the
@@ -312,13 +390,34 @@ def main(argv=None):
         state = with_guard_counters(state)
     # Phase 1: feature matching only. Phase 2: refinement with psi_1 frozen
     # by stop_gradient — the reference's detach=True (dbp15k.py:67-68).
-    phase1 = make_train_step(model, num_steps=0, guard=guard,
-                             fault_nan_step=plan.nan_grads_step)
-    phase2 = make_train_step(model, num_steps=args.num_steps, detach=True,
-                             guard=guard,
-                             fault_nan_step=plan.nan_grads_step)
-    eval1 = make_eval_step(model, hits_ks=(10,), num_steps=0)
-    eval2 = make_eval_step(model, hits_ks=(10,), num_steps=args.num_steps)
+    if rules is not None:
+        # Rules-driven sharded steps: the partition-rule config supplies
+        # state/batch shardings AND the model's activation constraints +
+        # streaming knobs (parallel/sharding._resolve_rules).
+        from dgmc_tpu.parallel import (make_sharded_eval_step,
+                                       make_sharded_train_step)
+        phase1 = make_sharded_train_step(
+            model, mesh, num_steps=0, rules=rules, state=state,
+            guard=guard, fault_nan_step=plan.nan_grads_step)
+        phase2 = make_sharded_train_step(
+            model, mesh, num_steps=args.num_steps, detach=True,
+            rules=rules, state=state, guard=guard,
+            fault_nan_step=plan.nan_grads_step)
+        eval1 = make_sharded_eval_step(model, mesh, hits_ks=(10,),
+                                       num_steps=0, rules=rules,
+                                       state=state)
+        eval2 = make_sharded_eval_step(model, mesh, hits_ks=(10,),
+                                       num_steps=args.num_steps,
+                                       rules=rules, state=state)
+    else:
+        phase1 = make_train_step(model, num_steps=0, guard=guard,
+                                 fault_nan_step=plan.nan_grads_step)
+        phase2 = make_train_step(model, num_steps=args.num_steps,
+                                 detach=True, guard=guard,
+                                 fault_nan_step=plan.nan_grads_step)
+        eval1 = make_eval_step(model, hits_ks=(10,), num_steps=0)
+        eval2 = make_eval_step(model, hits_ks=(10,),
+                               num_steps=args.num_steps)
 
     # Auto-resume: the epoch counter is the checkpoint step, and the
     # two-phase schedule position is a pure function of the epoch, so a
@@ -329,6 +428,13 @@ def main(argv=None):
     ckpt, state, start_epoch = resume_or_init(args.ckpt_dir, state)
     if nproc > 1:
         state = global_batch(state, mesh, replicate=True)
+    if rules is not None:
+        # Rule-matched placement: every state leaf lands with the layout
+        # its regex rule declares; the (replicated) giant pair follows
+        # the config's batch rule.
+        state, train_batch = rules.place(state, train_batch, mesh)
+        test_batch = jax.device_put(test_batch,
+                                    rules.batch_sharding(mesh))
     # Trace the second executed epoch (first is compile-heavy) unless only
     # one epoch will run at all.
     profile_epoch = min(start_epoch + 1, args.epochs)
@@ -348,6 +454,37 @@ def main(argv=None):
                     jax.random.key(args.seed + 2))
     obs.record_cost('train_step', phase2, state, train_batch,
                     jax.random.key(args.seed + 2))
+    if args.aot_compile:
+        # Compile the steps this schedule will actually execute (eval1
+        # only runs on phase-1 epochs divisible by 10) and log each
+        # executable's static per-device memory bound. The compiled
+        # callables replace the lazy-jit ones — one compile either way.
+        from dgmc_tpu.obs.memory import compiled_memory
+
+        def aot(name, fn, *a):
+            c = fn.lower(*a).compile()
+            mem = compiled_memory(c)
+            if mem:
+                obs.log(0, event=f'aot_memory_{name}', **mem)
+                if is_coordinator():
+                    print(f'# {name}: per-device static memory '
+                          f'{mem["total_bytes"] / 2**30:.3f} GiB '
+                          f'(args {mem["argument_bytes"] >> 20} MiB, '
+                          f'temps {mem["temp_bytes"] >> 20} MiB)')
+            return c
+
+        key0 = jax.random.key(args.seed + 3)
+        # Clamp both gates to the epochs that will actually run: phase 1
+        # ends at min(phase1_epochs, epochs), and a fully-resumed run
+        # (start_epoch > epochs) executes nothing.
+        p1_last = min(args.phase1_epochs, args.epochs)
+        if start_epoch <= p1_last:
+            phase1 = aot('phase1_step', phase1, state, train_batch, key0)
+            if any(e % 10 == 0 for e in range(start_epoch, p1_last + 1)):
+                eval1 = aot('eval1_step', eval1, state, test_batch, key0)
+        if args.epochs > args.phase1_epochs and start_epoch <= args.epochs:
+            phase2 = aot('train_step', phase2, state, train_batch, key0)
+            eval2 = aot('eval_step', eval2, state, test_batch, key0)
     prof = start_profile(args.profile_dir)
     if start_epoch > 1:
         logger.log(start_epoch - 1, event='resume')

@@ -137,6 +137,23 @@ class DGMC(nn.Module):
     # so a single huge pair (DBP15K-scale) spreads its activation state
     # across chips. GSPMD propagates the layout through the consensus loop.
     corr_sharding: Optional[object] = None
+    # Named activation shardings beyond S itself (parallel/rules.py sets
+    # all three from one PartitionRules config via apply_to_model):
+    # - topk_sharding constrains the candidate shortlist S_idx [B, N_s, K]
+    #   and drives the shard-embedded distributed search; None falls back
+    #   to corr_sharding (the pre-rules behavior).
+    # - psi2_sharding constrains the psi_2 consensus intermediates that
+    #   live on SOURCE rows (the indicator noise r_s and the stream-packed
+    #   psi_2 source input/output, all [B, N_s, ...]), keeping the
+    #   per-iteration difference tensors row-sharded by propagation.
+    topk_sharding: Optional[object] = None
+    psi2_sharding: Optional[object] = None
+    # Source-node chunk streaming for the sparse candidate search
+    # (ops/topk.streamed_topk; inside the shard-local region when a row
+    # sharding is set): the N_s x N_t sweep only ever exists as one
+    # [chunk, topk_block] score tile, the million-entity prerequisite.
+    # None = unstreamed. Sparse (k >= 1) only.
+    stream_chunk: Optional[int] = None
     # Mixed-precision compute dtype — a raw dtype or a
     # models/precision.Precision policy — for the matching stage itself
     # (the similarity GEMMs, candidate search operands and consensus MLP):
@@ -208,6 +225,29 @@ class DGMC(nn.Module):
             return a
         return jax.lax.with_sharding_constraint(a, self.corr_sharding)
 
+    def _constrain_idx(self, a):
+        """Shortlist constraint: the 'topk' activation rule, falling back
+        to the correspondence rule (S_idx rides with S by default)."""
+        sh = (self.topk_sharding if self.topk_sharding is not None
+              else self.corr_sharding)
+        return a if sh is None else jax.lax.with_sharding_constraint(a, sh)
+
+    def _constrain_psi2(self, a):
+        """Source-row ψ₂ intermediates ([B, N_s, ...]): the 'psi2'
+        activation rule."""
+        if self.psi2_sharding is None:
+            return a
+        return jax.lax.with_sharding_constraint(a, self.psi2_sharding)
+
+    @property
+    def _gspmd_sharded(self):
+        """True when any activation-sharding constraint partitions the
+        program (GSPMD auto-partitioning: Pallas gates must be silenced,
+        except inside explicit shard_map regions)."""
+        return (self.corr_sharding is not None
+                or self.topk_sharding is not None
+                or self.psi2_sharding is not None)
+
     @nn.compact
     def __call__(self, graph_s, graph_t, y=None, y_mask=None, train=False,
                  num_steps=None, detach=None, pair_offset=0):
@@ -236,7 +276,13 @@ class DGMC(nn.Module):
         num_steps = self.num_steps if num_steps is None else num_steps
         detach = self.detach if detach is None else detach
 
-        if self.corr_sharding is not None:
+        if self.stream_chunk is not None and self.k < 1:
+            raise ValueError(
+                'stream_chunk streams the sparse candidate search; the '
+                'dense variant (k=-1) materializes S and cannot stream '
+                '(set k >= 1 or stream_chunk=None)')
+
+        if self._gspmd_sharded:
             # Pallas kernels have no GSPMD partitioning rule. DGMC forces
             # its own kernels off under corr_sharding, auto-dispatched
             # backbone kernels are silenced via the trace-time context
@@ -257,9 +303,9 @@ class DGMC(nn.Module):
                         f'(leave it at None/False for sharded execution)')
 
         def run_psi(m, *args, **kw):
-            """Invoke a backbone; under corr_sharding, silence its
-            auto-dispatched Pallas kernels for the GSPMD program."""
-            if self.corr_sharding is None:
+            """Invoke a backbone; under an activation sharding, silence
+            its auto-dispatched Pallas kernels for the GSPMD program."""
+            if not self._gspmd_sharded:
                 return m(*args, **kw)
             from dgmc_tpu.ops.pallas.dispatch import disable_fused_kernels
             with disable_fused_kernels():
@@ -386,8 +432,9 @@ class DGMC(nn.Module):
 
         def noise(step):
             keys = pair_keys(self.make_rng('noise'))
-            return jax.vmap(
-                lambda k: jax.random.normal(k, (N_s, R_in), h_s.dtype))(keys)
+            return self._constrain_psi2(jax.vmap(
+                lambda k: jax.random.normal(k, (N_s, R_in), h_s.dtype))(
+                    keys))
 
         def prefetch_source(num_steps):
             """Batch the source side of ψ₂ across ALL consensus iterations.
@@ -425,10 +472,14 @@ class DGMC(nn.Module):
             # Channel-packed form: the node tables the edge gathers read
             # become T× wider (1.28 KB rows instead of 128 B at the
             # DBP15K config), so the latency-bound random gathers run
-            # once for all T iterations.
-            x = r_all.transpose(1, 2, 0, 3).reshape(B, N_s, T * R_in)
+            # once for all T iterations. The packed [B, N_s, T*R] tables
+            # are source-row activations — the 'psi2' rule keeps them
+            # row-sharded through the pack/unpack reshapes.
+            x = self._constrain_psi2(
+                r_all.transpose(1, 2, 0, 3).reshape(B, N_s, T * R_in))
             with jax.named_scope('psi2'):
-                o = run_psi(self.psi_2, x, graph_s, train=train, streams=T)
+                o = self._constrain_psi2(
+                    run_psi(self.psi_2, x, graph_s, train=train, streams=T))
             return r_all, o.reshape(B, N_s, T, -1).transpose(2, 0, 1, 3)
 
         if self.k < 1:
@@ -448,7 +499,7 @@ class DGMC(nn.Module):
             # claim a dispatch outcome for code that never executes.
             use_fused = False
             if num_steps > 0 and self.fused_consensus is None:
-                if self.corr_sharding is not None:
+                if self._gspmd_sharded:
                     from dgmc_tpu.ops.pallas.dispatch import record_dispatch
                     record_dispatch('dense_consensus', 'fallback',
                                     'gspmd-silenced')
@@ -529,19 +580,30 @@ class DGMC(nn.Module):
         # inside the embedding; only a ragged batch axis falls back.
         with jax.named_scope('topk'):
             S_idx = None
-            if self.corr_sharding is not None:
+            idx_sharding = (self.topk_sharding
+                            if self.topk_sharding is not None
+                            else self.corr_sharding)
+            if idx_sharding is not None:
                 from dgmc_tpu.parallel.topk import corr_sharded_topk
-                S_idx = corr_sharded_topk(self.corr_sharding, h_s, h_t,
+                S_idx = corr_sharded_topk(idx_sharding, h_s, h_t,
                                           self.k, t_mask,
-                                          block=self.topk_block)
+                                          block=self.topk_block,
+                                          chunk=self.stream_chunk)
+            if S_idx is None and self.stream_chunk is not None:
+                from dgmc_tpu.ops.topk import streamed_topk
+                S_idx = streamed_topk(h_s, h_t, self.k, self.stream_chunk,
+                                      t_mask=t_mask, block=self.topk_block,
+                                      pallas=False if self._gspmd_sharded
+                                      else None,
+                                      dispatch_reason='gspmd-silenced')
             if S_idx is None:
                 S_idx = chunked_topk(h_s, h_t, self.k, t_mask=t_mask,
                                      block=self.topk_block,
                                      pallas=False
-                                     if self.corr_sharding is not None
+                                     if self._gspmd_sharded
                                      else None,
                                      dispatch_reason='gspmd-silenced')
-            S_idx = self._constrain(S_idx)
+            S_idx = self._constrain_idx(S_idx)
 
         # Candidate-slot validity WITHOUT gathering t_mask at S_idx (a
         # ~300k-row bool gather, ~2.4 ms/step at DBP15K scale), by

@@ -9,12 +9,14 @@ row-statistics-carry trick flash-attention uses. HBM footprint is
 ``O(N_s * (k + block))`` instead of ``O(N_s * N_t)``.
 
 Per tile, the k best entries are extracted by **k rounds of (argmax,
-mask-out)** — O(k·block) cheap VPU work — rather than a ``lax.top_k`` sort
-of the whole tile; the tile's k survivors then merge with the running carry
-through one tiny ``top_k`` over ``2k``. Raced on-chip at DBP15K scale
-(15000x20000, C=256, k=10) this is 2.5x the sort formulation: 86 ms vs
-211 ms per call at block=1024 (``benchmarks/topk_tpu.json``,
-``benchmarks/topk_bench.py``).
+mask-out)** on TPU — O(k·block) cheap VPU work — rather than a
+``lax.top_k`` sort of the whole tile; the tile's k survivors then merge
+with the running carry through one tiny ``top_k`` over ``2k``. Raced
+on-chip at DBP15K scale (15000x20000, C=256, k=10) this is 2.5x the sort
+formulation: 86 ms vs 211 ms per call at block=1024
+(``benchmarks/topk_tpu.json``, ``benchmarks/topk_bench.py``). On CPU the
+cost model inverts — the rounds run near-scalar — so the extractor is
+backend-conditional (bit-identical either way; see ``tile_topk``).
 
 Tie-breaking matches the dense path exactly: ``argmax`` takes the *first*
 maximum (lowest target index, the ``lax.top_k`` rule), and the merge
@@ -27,6 +29,21 @@ import functools
 
 import jax
 import jax.numpy as jnp
+
+#: One measured default for every blockwise-scan path: the r03 on-chip
+#: sweep at DBP15K scale timed 17.7 / 21.1 / 24.8 ms at block 256 / 1024 /
+#: 4096 (bench.py ``topk_ms``; benchmarks/DISPATCH_DEFAULTS.md), and the
+#: smaller tile also has the lower peak tile memory. The Pallas kernel
+#: ignores the knob entirely.  ``dgmc_tpu/parallel/rules.py`` re-exports
+#: this as ``DEFAULT_TOPK_BLOCK`` so sharded callsites thread it from the
+#: partition-rule config instead of per-callsite literals.
+DEFAULT_BLOCK = 256
+
+#: Per-tile extractor override: ``None`` = auto by backend (sort form on
+#: CPU, argmax rounds on TPU — see ``tile_topk`` in ``_chunked_topk``);
+#: ``True``/``False`` force one form (tests pin the two forms equal on
+#: the same backend).
+TILE_SORT = None
 
 
 def dense_topk(h_s, h_t, k, t_mask=None):
@@ -43,8 +60,9 @@ def dense_topk(h_s, h_t, k, t_mask=None):
     return jax.lax.top_k(scores, k)[1]
 
 
-def chunked_topk(h_s, h_t, k, t_mask=None, block=256, return_values=False,
-                 pallas=None, dispatch_reason='explicit'):
+def chunked_topk(h_s, h_t, k, t_mask=None, block=DEFAULT_BLOCK,
+                 return_values=False, pallas=None,
+                 dispatch_reason='explicit'):
     """Blockwise running top-k of ``h_s @ h_t^T`` along the target axis.
 
     Produces indices identical to :func:`dense_topk` (including tie order)
@@ -79,6 +97,21 @@ def chunked_topk(h_s, h_t, k, t_mask=None, block=256, return_values=False,
     nested ``jax.jit`` cache would otherwise bake into a cached jaxpr and
     never consult again.
     """
+    pallas = _resolve_dispatch(pallas, k, dispatch_reason)
+    sort_tiles = _tile_sort()
+
+    def core(hs, ht, tm):
+        return _chunked_topk(hs, ht, k, tm, block, return_values, pallas,
+                             sort_tiles)
+
+    return _ad_opaque(core, h_s, h_t, t_mask)
+
+
+def _resolve_dispatch(pallas, k, dispatch_reason):
+    """Shared Pallas dispatch resolution for the search wrappers: the
+    auto decision (trace-time contextvar) or the caller's explicit flag,
+    recorded in the dispatch ledger with the reason that actually
+    applies. Resolved OUTSIDE the jit (see chunked_topk docstring)."""
     from dgmc_tpu.ops.pallas import dispatch
     from dgmc_tpu.ops.pallas.topk import BLOCK_T
     if pallas is None:
@@ -94,13 +127,59 @@ def chunked_topk(h_s, h_t, k, t_mask=None, block=256, return_values=False,
         dispatch.record_dispatch(
             'topk', 'pallas' if taken else 'fallback',
             dispatch_reason if taken == bool(pallas) else f'k>{BLOCK_T}')
-    return _chunked_topk(h_s, h_t, k, t_mask, block, return_values,
-                         bool(pallas))
+    return bool(pallas)
+
+
+def _tile_sort():
+    """Resolve the per-tile extractor OUTSIDE the jit (the override /
+    backend check must not be baked into a cached jaxpr — same rule as
+    the Pallas dispatch contextvar above)."""
+    import jax as _jax
+    return (_jax.default_backend() != 'tpu' if TILE_SORT is None
+            else bool(TILE_SORT))
+
+
+def _ad_opaque(core, *args):
+    """Run the search as an AD-opaque primitive: the JVP returns the
+    primal with (symbolic-float0 / zero) tangents WITHOUT tracing into
+    the scan.
+
+    The search is pure selection and non-differentiable by design (its
+    inputs are stop_gradient'ed internally), but under ``value_and_grad``
+    jax still *linearizes* the blockwise scan — and through the nested
+    ``jit`` boundary the partial-eval conservatively stacks the tile
+    select masks as loop residuals: a ``pred[num_blocks, B, rows,
+    block]`` tensor, 2 GiB PER DEVICE at the streamed 10⁶-target shape
+    (r7 buffer-assignment dump) backing a search whose real state is the
+    ``[B, rows, k]`` carry. ``custom_jvp`` makes the non-differentiability
+    structural, so no linearization of the scan exists to save."""
+    import numpy as _np
+    f = jax.custom_jvp(core)
+
+    @f.defjvp
+    def _jvp(primals, tangents):
+        out = core(*primals)
+        zeros = jax.tree.map(
+            lambda o: (jnp.zeros_like(o)
+                       if jnp.issubdtype(o.dtype, jnp.floating)
+                       else _np.zeros(o.shape, jax.dtypes.float0)), out)
+        return out, zeros
+
+    # Belt and braces: sever the tangents BEFORE the call too. With live
+    # tangents entering, jax 0.4.37 still routes the call through the
+    # jvp machinery and the nested-jit partial-eval stages the scan
+    # conservatively (the residual-stacking this wrapper exists to
+    # prevent); with stop_gradient'ed operands the custom call is pure
+    # primal and the scan is never linearized. Gradients were never
+    # meant to flow here — the search stop_gradients internally anyway.
+    return f(*jax.tree.map(jax.lax.stop_gradient, args))
 
 
 @functools.partial(jax.jit,
-                   static_argnames=('k', 'block', 'return_values', 'pallas'))
-def _chunked_topk(h_s, h_t, k, t_mask, block, return_values, pallas):
+                   static_argnames=('k', 'block', 'return_values', 'pallas',
+                                    'sort_tiles'))
+def _chunked_topk(h_s, h_t, k, t_mask, block, return_values, pallas,
+                  sort_tiles):
     h_s = jax.lax.stop_gradient(h_s)
     h_t = jax.lax.stop_gradient(h_t)
     B, N_s, C = h_s.shape
@@ -138,11 +217,24 @@ def _chunked_topk(h_s, h_t, k, t_mask, block, return_values, pallas):
 
     kk = min(k, block)
     cols = jnp.arange(block, dtype=jnp.int32)
+    # Per-tile extractor, backend-conditional at trace time. The two forms
+    # are BIT-IDENTICAL (the rounds form reproduces lax.top_k's
+    # sorted-desc, lowest-index-tie order by construction) — only the
+    # cost model differs, and it differs in opposite directions:
+    # - TPU: k rounds of (argmax, mask-out) measured 2.5x the sort form
+    #   (86 vs 211 ms at 15000x20000 k=10, benchmarks/topk_tpu.json) —
+    #   O(k*block) cheap VPU work beats a tile sort.
+    # - CPU (the fallback/virtual-device mesh path, where the streamed
+    #   million-row sweep actually runs in CI and the scale bench): the
+    #   argmax rounds run near-scalar and lose ~8x to one lax.top_k pass
+    #   (40.1 vs 4.7 s for a 2048-row chunk against 2^20 targets at k=4,
+    #   r7) — at 10^6x10^6 that is the difference between a 5-hour and a
+    #   40-minute single-device sweep.
 
     def tile_topk(scores):
-        """k rounds of (argmax, mask-out): the tile's k best, sorted desc
-        with lowest-index tie preference (exactly lax.top_k's order) at
-        O(k*block) VPU cost instead of a sort."""
+        if sort_tiles:
+            return jax.lax.top_k(scores, kk)
+
         def one(s, _):
             p = jnp.argmax(s, axis=-1)
             v = jnp.take_along_axis(s, p[..., None], axis=-1)[..., 0]
@@ -173,3 +265,62 @@ def _chunked_topk(h_s, h_t, k, t_mask, block, return_values, pallas):
     if return_values:
         return vals, idx
     return idx
+
+
+def streamed_topk(h_s, h_t, k, chunk, t_mask=None, block=DEFAULT_BLOCK,
+                  return_values=False, pallas=None,
+                  dispatch_reason='explicit'):
+    """Source-node chunk-streamed top-k: :func:`chunked_topk` run as a
+    ``lax.scan`` over chunks of source rows (``ops/blocked.py``-style).
+
+    :func:`chunked_topk` streams the *target* axis but still computes all
+    ``N_s`` rows per tile, so its peak score tile is ``[B, N_s, block]``
+    — 4 GiB at ``N_s = 10⁶`` with the default block. Streaming the
+    source axis too bounds it at ``[B, chunk, block]``, the
+    million-entity prerequisite (ROADMAP item 3): the ``N_s × N_t``
+    sweep only ever exists as one ``chunk × block`` tile, whatever the
+    pair size. Rows are independent, so each chunk's running top-k
+    (with the same per-tile merge) is already its rows' global answer
+    and the results are **bit-identical** to :func:`chunked_topk` —
+    tie-breaking included (``tests/ops/test_topk.py``).
+
+    Same dispatch contract as :func:`chunked_topk`: the auto Pallas
+    decision resolves here (un-jitted) and streams chunk-by-chunk
+    through the kernel when taken.
+    """
+    pallas = _resolve_dispatch(pallas, k, dispatch_reason)
+    sort_tiles = _tile_sort()
+    chunk = int(chunk)
+
+    def core(hs, ht, tm):
+        return _streamed_topk(hs, ht, k, tm, chunk, block, return_values,
+                              pallas, sort_tiles)
+
+    return _ad_opaque(core, h_s, h_t, t_mask)
+
+
+@functools.partial(jax.jit, static_argnames=('k', 'chunk', 'block',
+                                             'return_values', 'pallas',
+                                             'sort_tiles'))
+def _streamed_topk(h_s, h_t, k, t_mask, chunk, block, return_values,
+                   pallas, sort_tiles):
+    B, N_s, C = h_s.shape
+    pad = (-N_s) % chunk
+    if pad:
+        # Padded rows are discarded work, exactly like the padded target
+        # columns of the inner scan.
+        h_s = jnp.pad(h_s, ((0, 0), (0, pad), (0, 0)))
+    n_chunks = h_s.shape[1] // chunk
+    chunks = h_s.reshape(B, n_chunks, chunk, C).transpose(1, 0, 2, 3)
+
+    def body(_, h_chunk):
+        return None, _chunked_topk(h_chunk, h_t, k, t_mask, block, True,
+                                   pallas, sort_tiles)
+
+    _, (vals, idx) = jax.lax.scan(body, None, chunks)
+    # [n_chunks, B, chunk, k] -> [B, N_s, k]
+    merge = lambda a: a.transpose(1, 0, 2, 3).reshape(  # noqa: E731
+        B, n_chunks * chunk, k)[:, :N_s]
+    if return_values:
+        return merge(vals), merge(idx)
+    return merge(idx)

@@ -1,5 +1,9 @@
 from dgmc_tpu.parallel.mesh import (DATA_AXIS, MODEL_AXIS, make_mesh,
                                     batch_spec, corr_spec, corr_sharding)
+from dgmc_tpu.parallel.rules import (DEFAULT_TOPK_BLOCK, PartitionRules,
+                                     corr_row_rules, match_partition_rules,
+                                     replicated_rules, shard_tree,
+                                     streamed_rules, tree_shardings)
 from dgmc_tpu.parallel.sharding import (replicate, shard_batch,
                                         make_sharded_train_step,
                                         make_sharded_eval_step)
@@ -21,6 +25,14 @@ __all__ = [
     'batch_spec',
     'corr_spec',
     'corr_sharding',
+    'DEFAULT_TOPK_BLOCK',
+    'PartitionRules',
+    'match_partition_rules',
+    'tree_shardings',
+    'shard_tree',
+    'replicated_rules',
+    'corr_row_rules',
+    'streamed_rules',
     'replicate',
     'shard_batch',
     'make_sharded_train_step',

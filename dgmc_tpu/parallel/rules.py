@@ -1,0 +1,245 @@
+"""Partition rules: declarative regex → PartitionSpec sharding config.
+
+Sharding decisions were previously hand-wired per callsite: every
+``jax.jit(in_shardings=...)`` spelled out replicated-vs-batched trees,
+and the correspondence layout lived in ad-hoc ``corr_sharding`` plumbing
+through the CLIs. This module makes sharding a *config object* in the
+``match_partition_rules`` style (SNIPPETS.md [3]): an ordered list of
+``(regex, PartitionSpec)`` rules is matched against the '/'-joined pytree
+path of every leaf of the train state (params AND optimizer state AND
+guard counters — the whole :class:`~dgmc_tpu.train.state.TrainState` /
+``GuardedTrainState`` pytree), plus *named activation rules* for the
+arrays that dominate memory at DBP15K-and-beyond scale:
+
+- ``'corr'``   — the correspondence matrix ``S`` (``S_hat``/``S_0``/
+  ``S_L``: dense ``[B, N_s, N_t]`` or sparse ``[B, N_s, K]``),
+- ``'topk'``   — the top-k candidate shortlist ``S_idx [B, N_s, K]``
+  (defaults to the ``'corr'`` rule when absent),
+- ``'psi2'``   — the ψ₂ consensus intermediates living on source rows
+  (the indicator noise ``r_s`` and consensus colourings ``o_s``,
+  ``[B, N_s, R]`` / ``[num_steps, B, N_s, R]`` when stream-packed).
+
+:func:`~dgmc_tpu.parallel.sharding.make_sharded_train_step` /
+``make_sharded_eval_step`` consume a :class:`PartitionRules` in place of
+their hand-wired ``in_shardings``; :class:`~dgmc_tpu.models.DGMC` consumes
+the activation rules through its ``corr_sharding`` / ``topk_sharding`` /
+``psi2_sharding`` constraint fields, all set at once by
+:meth:`PartitionRules.apply_to_model`.
+
+Matching semantics (pinned by ``tests/parallel/test_rules.py``):
+
+- rules apply **first-match-wins**, in declaration order;
+- scalar leaves (rank 0 or one element) are never partitioned — they get
+  ``P()`` without consulting the rules (optimizer ``count``, ``step``,
+  guard ledgers);
+- a non-scalar leaf no rule matches **raises**, naming the leaf path —
+  a silent default would replicate terabyte-scale state without anyone
+  deciding that.
+
+The config also owns the knobs the sharded execution threads through the
+model instead of per-callsite literals:
+
+- ``topk_block`` — the target-axis tile of the blockwise candidate
+  search. One default for every path: **256**, the measured optimum of
+  the r03 on-chip sweep at DBP15K scale (bench.py ``topk_ms`` 17.7 /
+  21.1 / 24.8 ms at 256 / 1024 / 4096 — the Pallas kernel ignores the
+  knob entirely, so the block size only matters on the scan paths,
+  where smaller tiles also mean lower peak tile memory).
+  ``parallel/topk.py`` previously defaulted 1024 in one function and
+  256 in another; both now share :data:`DEFAULT_TOPK_BLOCK`.
+- ``stream_chunk`` — source-node chunk streaming for the candidate
+  search (``ops/topk.streamed_topk``): the ``[rows, block]`` score
+  tile only ever covers ``stream_chunk`` rows, so a 10⁶×10⁶ pair's
+  search peaks at ``O(chunk × block)`` per device instead of
+  ``O(N_s × block)``.
+"""
+
+import dataclasses
+import re
+from typing import Mapping, Optional, Tuple
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from dgmc_tpu.ops.topk import DEFAULT_BLOCK as DEFAULT_TOPK_BLOCK
+from dgmc_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
+
+#: Default source-chunk length for streamed candidate search: 8192 rows
+#: keeps the per-chunk score tile at 8192 x 256 x 4 B = 8 MiB while the
+#: per-tile GEMM stays MXU-sized.
+DEFAULT_STREAM_CHUNK = 8192
+
+
+def leaf_path_str(path) -> str:
+    """Render a ``tree_flatten_with_path`` key path as ``a/b/0/c``."""
+    parts = []
+    for k in path:
+        if hasattr(k, 'key'):          # DictKey
+            parts.append(str(k.key))
+        elif hasattr(k, 'name'):       # GetAttrKey (struct/NamedTuple)
+            parts.append(str(k.name))
+        elif hasattr(k, 'idx'):        # SequenceKey
+            parts.append(str(k.idx))
+        else:                          # FlattenedIndexKey and friends
+            parts.append(str(getattr(k, 'index', k)).strip('[].'))
+    return '/'.join(parts)
+
+
+def _is_scalar(leaf) -> bool:
+    shape = getattr(leaf, 'shape', ())
+    size = 1
+    for d in shape:
+        size *= d
+    return len(shape) == 0 or size == 1
+
+
+def match_partition_rules(rules, tree):
+    """Return a pytree of :class:`PartitionSpec` matching ``tree``.
+
+    ``rules`` is an ordered iterable of ``(regex, PartitionSpec)``;
+    ``re.search`` runs against each leaf's '/'-joined path and the FIRST
+    matching rule wins. Scalar leaves (rank 0, or a single element) get
+    ``P()`` without consulting the rules. A non-scalar leaf that no rule
+    matches raises :class:`ValueError` naming the leaf path — add a rule
+    (a final ``('.*', P())`` replicates the remainder explicitly).
+    """
+    rules = tuple(rules)
+
+    def spec_for(path, leaf):
+        name = leaf_path_str(path)
+        if _is_scalar(leaf):
+            return P()
+        for pattern, spec in rules:
+            if re.search(pattern, name) is not None:
+                return spec
+        raise ValueError(
+            f'no partition rule matches leaf {name!r} '
+            f'(shape {getattr(leaf, "shape", None)}); rules tried: '
+            f'{[r for r, _ in rules]!r} — append (".*", P()) to '
+            f'replicate unmatched leaves explicitly')
+
+    return jax.tree_util.tree_map_with_path(spec_for, tree)
+
+
+def tree_shardings(rules, tree, mesh: Mesh):
+    """``match_partition_rules`` result as a :class:`NamedSharding`
+    pytree over ``mesh`` (the form ``jax.jit(in_shardings=...)`` and
+    ``jax.device_put`` take)."""
+    return jax.tree.map(lambda spec: NamedSharding(mesh, spec),
+                        match_partition_rules(rules, tree),
+                        is_leaf=lambda x: isinstance(x, P))
+
+
+def shard_tree(tree, rules, mesh: Mesh):
+    """Place ``tree`` on ``mesh`` with every leaf laid out per its
+    matched rule."""
+    return jax.device_put(tree, tree_shardings(rules, tree, mesh))
+
+
+@dataclasses.dataclass(frozen=True)
+class PartitionRules:
+    """One declarative sharding config for a training setup.
+
+    Args:
+        state: ordered ``(regex, PartitionSpec)`` rules over the train
+            state pytree — params, optimizer state, batch stats, guard
+            counters. First match wins; see
+            :func:`match_partition_rules`.
+        batch: PartitionSpec for the pair batch's leading ``B`` axis
+            (``P(DATA_AXIS)`` for data parallelism, ``P()``/``None``
+            for a replicated single giant pair).
+        activations: named activation rules — ``'corr'``, ``'topk'``,
+            ``'psi2'`` (module docstring). Missing names mean "no
+            constraint" (``'topk'`` falls back to ``'corr'``).
+        topk_block: target-axis tile for the blockwise candidate
+            search, threaded to every consumer in place of per-callsite
+            literals.
+        stream_chunk: when set, the candidate search streams source
+            rows in chunks of this many (``ops/topk.streamed_topk`` /
+            the shard-local scan inside
+            :func:`~dgmc_tpu.parallel.topk.corr_sharded_topk`).
+    """
+    state: Tuple[Tuple[str, P], ...] = (('.*', P()),)
+    batch: Optional[P] = None
+    activations: Mapping[str, P] = dataclasses.field(default_factory=dict)
+    topk_block: int = DEFAULT_TOPK_BLOCK
+    stream_chunk: Optional[int] = None
+
+    # -- pytree placement ---------------------------------------------------
+
+    def state_shardings(self, state, mesh: Mesh):
+        """NamedSharding pytree for the train-state pytree."""
+        return tree_shardings(self.state, state, mesh)
+
+    def batch_sharding(self, mesh: Mesh) -> NamedSharding:
+        return NamedSharding(mesh, self.batch if self.batch is not None
+                             else P())
+
+    def place(self, state, batch, mesh: Mesh):
+        """Device-put ``(state, batch)`` per this config."""
+        return (shard_tree(state, self.state, mesh),
+                jax.device_put(batch, self.batch_sharding(mesh)))
+
+    # -- named activations --------------------------------------------------
+
+    def activation_spec(self, name: str) -> Optional[P]:
+        spec = self.activations.get(name)
+        if spec is None and name == 'topk':
+            spec = self.activations.get('corr')
+        return spec
+
+    def activation_sharding(self, name: str,
+                            mesh: Mesh) -> Optional[NamedSharding]:
+        spec = self.activation_spec(name)
+        return None if spec is None else NamedSharding(mesh, spec)
+
+    def apply_to_model(self, model, mesh: Mesh):
+        """Clone a :class:`~dgmc_tpu.models.DGMC` with every knob this
+        config owns: the three activation constraints, the streaming
+        chunk, and the candidate-search block size."""
+        return model.clone(
+            corr_sharding=self.activation_sharding('corr', mesh),
+            topk_sharding=self.activation_sharding('topk', mesh),
+            psi2_sharding=self.activation_sharding('psi2', mesh),
+            stream_chunk=self.stream_chunk,
+            topk_block=self.topk_block)
+
+
+def replicated_rules(batch_axis: Optional[str] = DATA_AXIS,
+                     **kw) -> PartitionRules:
+    """The classic data-parallel config ``make_sharded_train_step``
+    hand-wired before this module existed: state replicated, pair batch
+    split over ``batch_axis``, no activation constraints."""
+    return PartitionRules(
+        state=(('.*', P()),),
+        batch=None if batch_axis is None else P(batch_axis), **kw)
+
+
+def corr_row_rules(batch_axis: Optional[str] = DATA_AXIS,
+                   row_axis: str = MODEL_AXIS, **kw) -> PartitionRules:
+    """The ``--model_shards`` layout: batch over ``data``,
+    correspondence rows over ``model`` (``parallel/mesh.corr_spec``)."""
+    corr = P(batch_axis, row_axis)
+    return PartitionRules(
+        state=(('.*', P()),),
+        batch=None if batch_axis is None else P(batch_axis),
+        activations={'corr': corr, 'psi2': corr}, **kw)
+
+
+def streamed_rules(row_axis: str = DATA_AXIS,
+                   stream_chunk: Optional[int] = DEFAULT_STREAM_CHUNK,
+                   **kw) -> PartitionRules:
+    """Million-entity single-pair config (ROADMAP item 3): one giant
+    ``B=1`` pair replicated, the correspondence matrix row-sharded over
+    ``row_axis`` (the ``data`` axis — for this workload the source rows
+    ARE the data), the shortlist and ψ₂ source-row intermediates
+    following it, and the candidate search streaming ``stream_chunk``
+    source rows at a time so peak memory is
+    ``O(chunk × block)`` + ``O(N_s/devices × K)`` per device — never
+    ``O(N_s × N_t)`` anywhere."""
+    row = P(None, row_axis)
+    return PartitionRules(
+        state=(('.*', P()),),
+        batch=None,
+        activations={'corr': row, 'topk': row, 'psi2': row},
+        stream_chunk=stream_chunk, **kw)

@@ -83,31 +83,63 @@ def shard_batch(batch, mesh, axis=DATA_AXIS):
     return jax.device_put(batch, s)
 
 
+def _resolve_rules(model, mesh, rules, state, batch_axis):
+    """Shared rules plumbing for the step builders.
+
+    With ``rules`` (a :class:`~dgmc_tpu.parallel.rules.PartitionRules`),
+    the model is cloned with the config's activation constraints /
+    streaming knobs and the in/out shardings come from the declarative
+    rule match — the replacement for the hand-wired
+    ``in_shardings=(repl, batched, repl)`` wiring. ``state`` (an example
+    train-state pytree, e.g. the host-side one about to be placed) gives
+    the rule matcher the exact pytree to type; without it the state is
+    replicated wholesale (identical to the legacy behavior, since the
+    default rules replicate everything anyway).
+    """
+    repl = NamedSharding(mesh, P())
+    if rules is None:
+        return model, NamedSharding(mesh, P(batch_axis)), repl
+    model = rules.apply_to_model(model, mesh)
+    state_sh = (rules.state_shardings(state, mesh) if state is not None
+                else repl)
+    return model, rules.batch_sharding(mesh), state_sh
+
+
 def make_sharded_train_step(model, mesh, loss_on_s0=False, num_steps=None,
-                            detach=None, hits_ks=(), batch_axis=DATA_AXIS):
+                            detach=None, hits_ks=(), batch_axis=DATA_AXIS,
+                            rules=None, state=None, guard=False,
+                            fault_nan_step=None):
     """Jit a train step with explicit mesh shardings.
 
     Same contract as :func:`dgmc_tpu.train.make_train_step` — call it with a
     state placed by :func:`replicate` and a batch placed by
-    :func:`shard_batch`.
+    :func:`shard_batch`; or, with ``rules``, a state/batch placed by
+    :meth:`PartitionRules.place <dgmc_tpu.parallel.rules.PartitionRules>`
+    (``state`` supplies the example pytree the regex rules are matched
+    against — params, optimizer state and guard counters all type from
+    one declarative config instead of hand-wired ``in_shardings``).
     """
+    model, batched, state_sh = _resolve_rules(model, mesh, rules, state,
+                                              batch_axis)
     step = _steps.make_train_step(model, loss_on_s0=loss_on_s0,
                                   num_steps=num_steps, detach=detach,
-                                  hits_ks=hits_ks, jit=False)
+                                  hits_ks=hits_ks, jit=False, guard=guard,
+                                  fault_nan_step=fault_nan_step)
     repl = NamedSharding(mesh, P())
-    batched = NamedSharding(mesh, P(batch_axis))
     return jax.jit(_gspmd_safe(step, mesh, model),
-                   in_shardings=(repl, batched, repl),
-                   out_shardings=(repl, repl),
+                   in_shardings=(state_sh, batched, repl),
+                   out_shardings=(state_sh, repl),
                    donate_argnums=(0,))
 
 
 def make_sharded_eval_step(model, mesh, hits_ks=(1,), num_steps=None,
-                           detach=None, batch_axis=DATA_AXIS):
+                           detach=None, batch_axis=DATA_AXIS,
+                           rules=None, state=None):
+    model, batched, state_sh = _resolve_rules(model, mesh, rules, state,
+                                              batch_axis)
     step = _steps.make_eval_step(model, hits_ks=hits_ks, num_steps=num_steps,
                                  detach=detach, jit=False)
     repl = NamedSharding(mesh, P())
-    batched = NamedSharding(mesh, P(batch_axis))
     return jax.jit(_gspmd_safe(step, mesh, model),
-                   in_shardings=(repl, batched, repl),
+                   in_shardings=(state_sh, batched, repl),
                    out_shardings=repl)

@@ -24,15 +24,26 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
-from dgmc_tpu.ops.topk import chunked_topk
+from dgmc_tpu.ops.topk import chunked_topk, streamed_topk
+# Both sharded searches take the ONE measured block default (256; the
+# r03 sweep — see DEFAULT_BLOCK in ops/topk.py and the DISPATCH_DEFAULTS
+# table) threaded through the partition-rule config: callers built from a
+# PartitionRules pass rules.topk_block, and a bare call inherits the same
+# constant instead of the per-callsite 1024/256 literals this module used
+# to carry.
+from dgmc_tpu.parallel.rules import DEFAULT_TOPK_BLOCK
 from dgmc_tpu.parallel.compat import shard_map
 from dgmc_tpu.parallel.mesh import MODEL_AXIS
 
 
-def sharded_topk_rows(mesh, h_s, h_t, k, t_mask=None, block=1024,
-                      axis=MODEL_AXIS):
+def sharded_topk_rows(mesh, h_s, h_t, k, t_mask=None,
+                      block=DEFAULT_TOPK_BLOCK, axis=MODEL_AXIS,
+                      chunk=None):
     """Top-k with source rows sharded over ``axis``. ``N_s`` must divide by
-    the axis size (pad rows host-side; padded rows are just extra work)."""
+    the axis size (pad rows host-side; padded rows are just extra work).
+    ``chunk`` additionally streams each shard's rows ``chunk`` at a time
+    (``ops/topk.streamed_topk``) so the per-device score tile is
+    ``[chunk, block]`` regardless of the shard's row count."""
     if t_mask is None:
         t_mask = jnp.ones((h_t.shape[0], h_t.shape[1]), bool)
 
@@ -41,12 +52,16 @@ def sharded_topk_rows(mesh, h_s, h_t, k, t_mask=None, block=1024,
         in_specs=(P(None, axis, None), P(), P()),
         out_specs=P(None, axis, None))
     def inner(h_s_l, h_t_l, t_mask_l):
+        if chunk:
+            return streamed_topk(h_s_l, h_t_l, k, chunk, t_mask=t_mask_l,
+                                 block=block)
         return chunked_topk(h_s_l, h_t_l, k, t_mask=t_mask_l, block=block)
 
     return inner(h_s, h_t, t_mask)
 
 
-def corr_sharded_topk(sharding, h_s, h_t, k, t_mask, block=256):
+def corr_sharded_topk(sharding, h_s, h_t, k, t_mask,
+                      block=DEFAULT_TOPK_BLOCK, chunk=None):
     """Top-k under a correspondence sharding, INSIDE a GSPMD program.
 
     ``sharding`` is the ``corr_sharding`` NamedSharding for
@@ -59,6 +74,13 @@ def corr_sharded_topk(sharding, h_s, h_t, k, t_mask, block=256):
     slower scan. Ragged row counts are padded up to the mesh tile (padded
     rows are discarded work); only a ragged *batch* axis returns ``None``
     (caller falls back).
+
+    ``chunk`` streams each shard's local rows ``chunk`` at a time
+    (``ops/topk.streamed_topk`` inside the shard-local region): the
+    distributed shortlisting of the million-entity layout, where even one
+    device's ``N_s/n_dev`` row block is too many rows to score against
+    every target at once — peak per-device search memory becomes
+    ``O(chunk × block)``.
     """
     mesh, spec = sharding.mesh, sharding.spec
     b_ax = spec[0] if len(spec) > 0 else None
@@ -105,20 +127,34 @@ def corr_sharded_topk(sharding, h_s, h_t, k, t_mask, block=256):
         else ('embedded-disabled' if jax.default_backend() == 'tpu'
               else f'backend={jax.default_backend()}'))
 
+    # AD opacity (`_ad_opaque`) sits OUTSIDE the shard_map: the search is
+    # non-differentiable by design, and on jax 0.4.37
+    # grad-over-shard_map-over-custom_jvp asserts in pjit — so the
+    # shard-local body calls the plain jitted cores and the custom_jvp
+    # wraps the whole sharded call. Without it, linearizing the embedded
+    # scan stacks per-tile select masks as loop residuals
+    # (pred[num_blocks, rows, block] per device — see ops/topk._ad_opaque).
+    from dgmc_tpu.ops.topk import (_ad_opaque, _chunked_topk,
+                                   _streamed_topk, _tile_sort)
+    sort_tiles = _tile_sort()
+
     @functools.partial(
         shard_map, mesh=mesh,
         in_specs=(P(b_ax, s_ax, None), P(b_ax, None, None), P(b_ax, None)),
         out_specs=P(b_ax, s_ax, None))
     def local(hs, ht, tm):
-        return chunked_topk(hs, ht, k, t_mask=tm, block=block,
-                            pallas=use_kernel)
+        if chunk:
+            return _streamed_topk(hs, ht, k, tm, int(chunk), block, False,
+                                  use_kernel, sort_tiles)
+        return _chunked_topk(hs, ht, k, tm, block, False, use_kernel,
+                             sort_tiles)
 
-    out = local(h_s, h_t, t_mask)
+    out = _ad_opaque(local, h_s, h_t, t_mask)
     return out[:, :N_s] if pad_s else out
 
 
-def sharded_topk_cols(mesh, h_s, h_t, k, t_mask=None, block=1024,
-                      axis=MODEL_AXIS):
+def sharded_topk_cols(mesh, h_s, h_t, k, t_mask=None,
+                      block=DEFAULT_TOPK_BLOCK, axis=MODEL_AXIS):
     """Top-k with target columns sharded over ``axis``; one all_gather of
     per-shard candidates merges local winners into the global top-k."""
     B, N_t = h_t.shape[0], h_t.shape[1]

@@ -68,15 +68,24 @@ def init_variables(model, key, batch, num_steps=None):
 
 
 def create_train_state(model, key, batch, tx=None, learning_rate=1e-3,
-                       num_steps=None):
+                       num_steps=None, init_batch=None):
     """Build a :class:`TrainState` for ``model`` from a sample batch.
 
     ``tx`` defaults to plain Adam at ``learning_rate`` — the optimizer every
     reference experiment uses (e.g. reference ``examples/dbp15k.py:34``).
+
+    ``init_batch`` substitutes a smaller batch for the shape-inference
+    forward: parameter shapes (and therefore values — each initializer
+    draws from its own fold of ``key`` keyed on the param's shape) depend
+    only on feature widths, never on node/edge counts, so a giant pair
+    (the 10⁶-node streamed-S workload) can initialize on a tiny stand-in
+    instead of tracing a million-row forward eagerly.
     """
     if tx is None:
         tx = optax.adam(learning_rate)
-    variables = init_variables(model, key, batch, num_steps=num_steps)
+    variables = init_variables(model, key,
+                               batch if init_batch is None else init_batch,
+                               num_steps=num_steps)
     return TrainState.create(
         apply_fn=model.apply,
         params=variables['params'],

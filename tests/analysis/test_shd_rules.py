@@ -323,7 +323,8 @@ def test_sharded_tier_runs_clean_on_registered_specimens():
     assert sorted(cache.stats()) == [
         'parallel.sharded_forward_rows', 'parallel.sharded_topk_cols',
         'parallel.sharded_train_step',
-        'parallel.sharded_train_step_pairs2']
+        'parallel.sharded_train_step_pairs2',
+        'parallel.streamed_train_step']
 
 
 @pytest.mark.skipif(len(jax.devices()) < 2, reason='needs 2 devices')

@@ -58,3 +58,51 @@ def test_auto_gate_resolved_per_call_not_cached(monkeypatch):
     chunked_topk(h_s, h_t, 2, block=4)
     chunked_topk(h_s, h_t, 2, block=4)  # same shapes: jit cache hit inside
     assert len(calls) == 2
+
+
+def test_streamed_matches_chunked_bit_identical():
+    """Source-chunk streaming (streamed_topk) returns bit-identical
+    indices AND values to the unstreamed scan — rows are independent, so
+    chunking the source axis is pure scheduling (ragged chunk included)."""
+    from dgmc_tpu.ops.topk import streamed_topk
+    key = jax.random.PRNGKey(4)
+    k1, k2, k3 = jax.random.split(key, 3)
+    h_s = jax.random.normal(k1, (2, 37, 8))
+    h_t = jax.random.normal(k2, (2, 53, 8))
+    t_mask = jax.random.bernoulli(k3, 0.8, (2, 53))
+    va, ia = chunked_topk(h_s, h_t, 5, t_mask=t_mask, block=16,
+                          pallas=False, return_values=True)
+    vb, ib = streamed_topk(h_s, h_t, 5, 8, t_mask=t_mask, block=16,
+                           pallas=False, return_values=True)
+    np.testing.assert_array_equal(np.asarray(ia), np.asarray(ib))
+    np.testing.assert_array_equal(np.asarray(va), np.asarray(vb))
+
+
+def test_tile_extractor_forms_identical():
+    """The backend-conditional per-tile extractors — one lax.top_k sort
+    pass (CPU) vs k rounds of argmax+mask (TPU) — are bit-identical,
+    duplicate scores and masked columns included, so the r7 cost-model
+    inversion swaps them freely."""
+    import dgmc_tpu.ops.topk as T
+    rng = np.random.RandomState(3)
+    h_s = jnp.asarray(rng.randn(2, 19, 8).astype(np.float32))
+    base = rng.randn(2, 16, 8).astype(np.float32)
+    # Duplicated target rows force score ties across tiles.
+    h_t = jnp.asarray(np.concatenate([base, base], axis=1))
+    tm = jnp.asarray(rng.rand(2, 32) > 0.3)
+    old = T.TILE_SORT
+    try:
+        T.TILE_SORT = True
+        a = np.asarray(T.chunked_topk(h_s, h_t, 6, t_mask=tm, block=8,
+                                      pallas=False))
+        s = np.asarray(T.streamed_topk(h_s, h_t, 6, 4, t_mask=tm, block=8,
+                                       pallas=False))
+        T.TILE_SORT = False
+        b = np.asarray(T.chunked_topk(h_s, h_t, 6, t_mask=tm, block=8,
+                                      pallas=False))
+    finally:
+        T.TILE_SORT = old
+    np.testing.assert_array_equal(a, b)
+    np.testing.assert_array_equal(a, s)
+    np.testing.assert_array_equal(
+        a, np.asarray(dense_topk(h_s, h_t, 6, t_mask=tm)))

@@ -1,0 +1,187 @@
+"""Partition-rule layer (``dgmc_tpu/parallel/rules.py``): regex →
+PartitionSpec matching semantics, the GuardedTrainState round-trip
+(params AND optimizer state AND guard counters typed by one rule list),
+and the streamed-S execution path pinned numerically against the
+unsharded reference at the ``test_dense_sparse_equivalence``
+tolerances."""
+
+import jax
+import numpy as np
+import optax
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from dgmc_tpu.parallel import (PartitionRules, make_mesh,
+                               make_sharded_eval_step,
+                               make_sharded_train_step,
+                               match_partition_rules, replicated_rules,
+                               streamed_rules, tree_shardings)
+from dgmc_tpu.parallel.rules import leaf_path_str
+from dgmc_tpu.train import create_train_state, make_eval_step, \
+    make_train_step, with_guard_counters
+
+from tests.train.test_steps import tiny_loader, tiny_model
+
+
+# ---------------------------------------------------------------------------
+# Rule matcher
+# ---------------------------------------------------------------------------
+
+
+def test_first_match_wins():
+    tree = {'params': {'psi_1': {'kernel': np.ones((4, 8))},
+                       'psi_2': {'kernel': np.ones((4, 8))}}}
+    specs = match_partition_rules(
+        ((r'psi_1/kernel', P('data')),
+         (r'kernel', P('model')),   # would also match psi_1's — must lose
+         (r'.*', P())), tree)
+    assert specs['params']['psi_1']['kernel'] == P('data')
+    assert specs['params']['psi_2']['kernel'] == P('model')
+
+
+def test_unmatched_leaf_raises_with_path():
+    tree = {'params': {'deep': {'odd_name': np.ones((4, 8))}}}
+    with pytest.raises(ValueError, match=r'params/deep/odd_name'):
+        match_partition_rules(((r'kernel', P()),), tree)
+
+
+def test_scalars_never_partitioned():
+    """Rank-0 / single-element leaves get P() without consulting rules —
+    even rules that would otherwise shard them."""
+    tree = {'count': np.int32(3), 'one': np.ones((1,)),
+            'vec': np.ones((8,))}
+    specs = match_partition_rules(((r'.*', P('data')),), tree)
+    assert specs['count'] == P()
+    assert specs['one'] == P()
+    assert specs['vec'] == P('data')
+
+
+def test_guarded_train_state_round_trip():
+    """One rule list types the ENTIRE GuardedTrainState pytree: the spec
+    tree has the state's exact structure, optimizer moments follow their
+    parameters' rule, and every counter (step, adam count, guard
+    ledgers) stays replicated scalar."""
+    model = tiny_model(k=4)
+    batch = next(iter(tiny_loader(batch_size=2)))
+    state = with_guard_counters(
+        create_train_state(model, jax.random.key(0), batch,
+                           tx=optax.adam(1e-3)))
+    # mlp_hidden_kernel is [R_out, R_out] = [8, 8] — the one weight in
+    # the tiny model whose trailing axis tiles an 8-way mesh axis.
+    rules = ((r'hidden_kernel$', P(None, 'model')), (r'.*', P()))
+    specs = match_partition_rules(rules, state)
+
+    # Same treedef — the spec tree types every leaf of the state.
+    assert (jax.tree_util.tree_structure(jax.tree.map(lambda x: 0, state))
+            == jax.tree_util.tree_structure(
+                jax.tree.map(lambda s: 0, specs,
+                             is_leaf=lambda x: isinstance(x, P))))
+
+    flat, _ = jax.tree_util.tree_flatten_with_path(
+        specs, is_leaf=lambda x: isinstance(x, P))
+    by_path = {leaf_path_str(p): s for p, s in flat}
+    kernels = [n for n in by_path if n.endswith('hidden_kernel')]
+    assert kernels, by_path
+    # Optimizer mu/nu moments carry their parameter's rule.
+    assert any(n.startswith('opt_state') for n in kernels)
+    for n in kernels:
+        assert by_path[n] == P(None, 'model'), (n, by_path[n])
+    for counter in ('step', 'skip_count', 'consec_bad'):
+        assert by_path[counter] == P(), (counter, by_path[counter])
+    assert by_path['opt_state/0/count'] == P()
+
+    # Placement round-trip on a real mesh: every leaf lands with its
+    # matched sharding and values survive bit-exactly.
+    mesh = make_mesh(data=1, model=8)
+    cfg = PartitionRules(state=rules)
+    placed, _ = cfg.place(state, batch, mesh)
+    shardings = tree_shardings(rules, state, mesh)
+    for (pth, leaf), sh in zip(
+            jax.tree_util.tree_flatten_with_path(placed)[0],
+            jax.tree.leaves(shardings,
+                            is_leaf=lambda x: isinstance(x, NamedSharding))):
+        if hasattr(leaf, 'sharding'):
+            assert leaf.sharding.is_equivalent_to(sh, leaf.ndim), pth
+    for a, b in zip(jax.tree.leaves(state), jax.tree.leaves(placed)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_replicated_rules_match_legacy_behavior():
+    rules = replicated_rules()
+    assert rules.batch == P('data')
+    assert rules.activation_spec('corr') is None
+    st = streamed_rules(stream_chunk=64)
+    assert st.activation_spec('corr') == P(None, 'data')
+    # 'topk' falls back to 'corr' when not separately ruled.
+    assert PartitionRules(
+        activations={'corr': P(None, 'data')}).activation_spec('topk') \
+        == P(None, 'data')
+
+
+# ---------------------------------------------------------------------------
+# Streamed-S execution, pinned against the unsharded reference
+# ---------------------------------------------------------------------------
+
+
+def test_stream_chunk_matches_unstreamed_forward():
+    """Source-chunk streaming is a pure scheduling change: S_0/S_L must
+    match the unstreamed sparse forward at the dense≡sparse equivalence
+    tolerances (the shortlist is bit-identical, so the downstream math
+    is too)."""
+    base = tiny_model(k=4)
+    streamed = base.clone(stream_chunk=5)  # ragged vs N_s=12: pads
+    batch = next(iter(tiny_loader(batch_size=2)))
+    rngs = {'noise': jax.random.PRNGKey(7),
+            'negatives': jax.random.PRNGKey(8)}
+    variables = base.init({'params': jax.random.PRNGKey(0), **rngs},
+                          batch.s, batch.t)
+    (S0_a, SL_a) = base.apply(variables, batch.s, batch.t, rngs=rngs)
+    (S0_b, SL_b) = streamed.apply(variables, batch.s, batch.t, rngs=rngs)
+    np.testing.assert_array_equal(np.asarray(S0_a.idx),
+                                  np.asarray(S0_b.idx))
+    np.testing.assert_allclose(S0_a.val, S0_b.val, atol=1e-6)
+    np.testing.assert_allclose(SL_a.val, SL_b.val, atol=1e-6)
+
+
+def test_streamed_dense_rejected():
+    with pytest.raises(ValueError, match='stream_chunk'):
+        tiny_model(k=-1).clone(stream_chunk=8).apply(
+            {}, None, None)  # raises before touching args
+
+
+def test_streamed_rules_train_eval_match_reference():
+    """The full rules-driven path (S row-sharded over ``data``, streamed
+    shortlisting, rule-typed state in/out shardings) against the
+    unsharded step on a small pair — the million-entity layout's
+    correctness pin (tolerances follow the existing sharded tests: the
+    partitioned program may re-order f32 reductions)."""
+    mesh = make_mesh(data=8, model=1)
+    base = tiny_model(k=4)
+    rules = streamed_rules(stream_chunk=4)
+    loader = tiny_loader(batch_size=1)
+    batch = next(iter(loader))
+    state = create_train_state(base, jax.random.key(0), batch,
+                               tx=optax.sgd(1e-2))
+    key = jax.random.key(2)
+
+    ref_step = make_train_step(base, jit=False)
+    sh_step = make_sharded_train_step(base, mesh, rules=rules, state=state)
+
+    _, ref_out = ref_step(state, batch, key)
+    state_sh, batch_sh = rules.place(jax.tree.map(np.asarray, state),
+                                     batch, mesh)
+    state_sh, sh_out = sh_step(state_sh, batch_sh, key)
+    assert float(sh_out['loss']) == pytest.approx(float(ref_out['loss']),
+                                                  rel=1e-4)
+    assert float(sh_out['acc']) == pytest.approx(float(ref_out['acc']),
+                                                 abs=1e-6)
+
+    ref_eval = make_eval_step(base, hits_ks=(1,))
+    sh_eval = make_sharded_eval_step(base, mesh, hits_ks=(1,),
+                                     rules=rules, state=state)
+    ev_ref = ref_eval(state, batch, key)
+    ev_sh = sh_eval(rules.place(jax.tree.map(np.asarray, state),
+                                batch, mesh)[0], batch_sh, key)
+    assert float(ev_sh['correct']) == pytest.approx(
+        float(ev_ref['correct']), abs=1e-6)
+    assert float(ev_sh['count']) == float(ev_ref['count'])
