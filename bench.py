@@ -611,6 +611,7 @@ def main(argv=None):
         # watchdog arms SIGTERM only.
         _OBS = RunObserver(args.obs_dir, probes=args.probes,
                            watchdog_deadline_s=args.watchdog_deadline,
+                           fence_deadline_s=args.fence_deadline,
                            watchdog_signals=(signal.SIGTERM,))
     prof = start_profile(args.profile_dir)
 

@@ -302,17 +302,35 @@ def main(argv=None):
         from dgmc_tpu.resilience.supervisor import supervise_cli
         raise SystemExit(supervise_cli(
             'dgmc_tpu.experiments.dbp15k', args, argv))
-    from dgmc_tpu.resilience import FaultPlan, RollbackGuard
+    from dgmc_tpu.resilience import FaultPlan, HostChannel, RollbackGuard
+    from dgmc_tpu.resilience.distributed_guard import control_dir
     from dgmc_tpu.resilience.faults import ledger_dir
     plan = FaultPlan.from_args(
-        args, state_dir=ledger_dir(args.ckpt_dir, args.obs_dir))
+        args, state_dir=ledger_dir(args.ckpt_dir, args.obs_dir),
+        control_dir=control_dir(args.obs_dir) if args.obs_dir else None)
     # Multi-host bring-up before any backend touch (no-op single-process).
     # jax.devices() then spans every host, so --model_shards can spread the
     # correspondence activations across hosts' chips over DCN/ICI.
+    # Under --fence-deadline the (C-level, unkillable-from-Python)
+    # barrier runs guarded: one absent host becomes a hang_report.json +
+    # FENCE_TIMEOUT_RC exit instead of every host hanging forever.
     from dgmc_tpu.parallel import (global_batch, host_obs_dir,
                                    initialize_distributed, is_coordinator)
-    nproc = initialize_distributed(args.coordinator, args.num_processes,
-                                   args.process_id)
+    nproc = initialize_distributed(
+        args.coordinator, args.num_processes, args.process_id,
+        deadline_s=args.fence_deadline,
+        hang_report_path=(os.path.join(args.obs_dir, 'hang_report.json')
+                          if args.obs_dir else None))
+    # Control-plane heartbeats (<obs>/control/host_<i>.json): each host
+    # advertises liveness + its last completed fence; peers and the
+    # supervisor read them for peer-death/straggler detection and for
+    # naming the missing host in fence hang reports.
+    channel = None
+    if args.obs_dir:
+        plan.host_index = jax.process_index()
+        channel = HostChannel(args.obs_dir,
+                              host_index=jax.process_index(),
+                              num_hosts=nproc, fault_plan=plan).start()
     train_batch, test_batch, in_dim = load_batches(args)
 
     if args.row_shards > 1 and args.model_shards > 1:
@@ -425,7 +443,13 @@ def main(argv=None):
     # Orbax save/restore is a COLLECTIVE over global arrays: every process
     # must participate (ckpt_dir must be a shared filesystem multi-host);
     # only metric/stdout writes are coordinator-gated.
-    ckpt, state, start_epoch = resume_or_init(args.ckpt_dir, state)
+    # Passing the mesh re-derives the target shardings on the CURRENT
+    # mesh before restoring, so a checkpoint saved on a larger mesh
+    # resumes RESHARDED — the supervisor's elastic mesh-shrink path
+    # (8 devices die down to 4; the run continues).
+    ckpt, state, start_epoch = resume_or_init(
+        args.ckpt_dir, state, mesh=mesh if nproc == 1 else None,
+        rules=rules)
     if nproc > 1:
         state = global_batch(state, mesh, replicate=True)
     if rules is not None:
@@ -444,7 +468,12 @@ def main(argv=None):
     # solo): every host records — the straggling host is the evidence —
     # and `python -m dgmc_tpu.obs.aggregate <obs-dir>` merges them.
     obs = RunObserver(host_obs_dir(args.obs_dir), probes=args.probes,
-                      watchdog_deadline_s=args.watchdog_deadline)
+                      watchdog_deadline_s=args.watchdog_deadline,
+                      fence_deadline_s=args.fence_deadline,
+                      host_channel=channel)
+    # collective-stall@N fires INSIDE the fence guard, where a wedged
+    # collective would actually block.
+    obs.fence_hook = plan.before_fence
     guard_mon = RollbackGuard(args.guard_bad_steps, obs=obs) \
         if guard else None
     # Cost/MFU attribution for both phase programs (one extra trace
@@ -503,9 +532,12 @@ def main(argv=None):
             continue
         if epoch == args.phase1_epochs + 1 and is_coordinator():
             print('Refine correspondence matrix...')
-        # Armed host-side faults (raise/sigterm/sigkill/stall) fire here
-        # — on EXECUTED epochs only, and once across supervised restarts
-        # (the ledger in ckpt/obs dir survives the kill).
+        # Armed host-side faults (raise/sigterm/sigkill/stall/
+        # peer-death/straggler/coord-partition) fire here — on EXECUTED
+        # epochs only, and once across supervised restarts (the ledger
+        # in ckpt/obs dir survives the kill).
+        if channel is not None:
+            channel.beat('epoch', epoch)
         plan.before_step(epoch)
         step = phase2 if refine else phase1
         with trace(args.profile if epoch == profile_epoch else None), \
@@ -523,8 +555,10 @@ def main(argv=None):
             key, sub = jax.random.split(key)
             ev = (eval2 if refine else eval1)(state, test_batch, sub)
             # Per-device completion probe on an epoch that fetches
-            # anyway: the straggler/skew series for obs.aggregate.
-            obs.fence_devices(out['loss'])
+            # anyway: the straggler/skew series for obs.aggregate —
+            # and the run's collective fence, deadline-guarded under
+            # --fence-deadline (tag = the epoch a hang report names).
+            obs.fence_devices(out['loss'], tag=epoch)
             # One batched fetch for loss + all eval metrics. This also
             # drains every epoch queued since the last print, so the
             # reported time is the average over that span.
@@ -574,6 +608,8 @@ def main(argv=None):
     prof.close()
     logger.close()
     obs.close()
+    if channel is not None:
+        channel.close()
     return state
 
 

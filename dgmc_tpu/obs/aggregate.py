@@ -169,11 +169,53 @@ def aggregate(root):
         'hung_hosts': [name for name, p in per_host.items()
                        if 'hang_report' in p],
     }
+    attribution = {
+        name: _attribute_hang(root, name, per_host[name]['hang_report'])
+        for name in out['hung_hosts']}
+    if attribution:
+        out['hang_attribution'] = attribution
     out['skew'] = {
         'step_time_ratio': (step_spread or {}).get('ratio_max_over_median'),
         'memory_ratio': (mem_spread or {}).get('ratio_max_over_median'),
         'wall_ratio': (wall_spread or {}).get('ratio_max_over_median'),
     }
+    return out
+
+
+def _attribute_hang(root, host_name, hang_summary):
+    """Attribute a hung host to its last completed fence/phase.
+
+    "Hung" alone is not actionable; the attribution names (a) what the
+    host was inside when it stalled (the hang report's in-flight span —
+    for a fence-deadline report that names the fence phase/step and the
+    missing peers), (b) the last span it COMPLETED, and (c) its last
+    completed collective fence from the control-plane heartbeat
+    (``<root>/control/host_<i>.json``) when one exists — the phase every
+    surviving peer agrees this host reached.
+    """
+    out = {'reason': hang_summary.get('reason')}
+    inf = hang_summary.get('in_flight') or {}
+    if inf:
+        out['in_flight'] = {k: inf.get(k) for k in ('phase', 'name')
+                            if inf.get(k) is not None}
+    if hang_summary.get('last_completed'):
+        out['last_completed'] = hang_summary['last_completed']
+    m = _HOST_DIR.match(host_name)
+    if m is not None:
+        # jax-free on purpose (module contract): read the control file
+        # directly rather than through the resilience channel object.
+        path = os.path.join(root, 'control', f'host_{m.group(1)}.json')
+        try:
+            with open(path) as f:
+                beat = json.load(f)
+        except (OSError, ValueError):
+            beat = None
+        if beat:
+            out['last_heartbeat'] = {
+                k: beat.get(k) for k in ('phase', 'step', 'time')
+                if beat.get(k) is not None}
+            if beat.get('last_fence'):
+                out['last_fence'] = beat['last_fence']
     return out
 
 
@@ -247,6 +289,18 @@ def render(summary):
     if summary.get('hung_hosts'):
         lines.append(f'  HUNG HOSTS: {summary["hung_hosts"]} '
                      f'(see their hang_report.json)')
+        for name, att in (summary.get('hang_attribution') or {}).items():
+            inf = att.get('in_flight') or {}
+            fence = att.get('last_fence') or {}
+            done = att.get('last_completed') or {}
+            lines.append(
+                f'    {name}: stuck in '
+                f'{inf.get("phase", "?")}:{inf.get("name", "?")}'
+                + (f', last completed '
+                   f'{done.get("phase")}:{done.get("name")}'
+                   if done else '')
+                + (f', last fence {fence.get("phase")}@{fence.get("step")}'
+                   if fence else ''))
     return '\n'.join(lines)
 
 

@@ -39,6 +39,10 @@ regression thresholds:
   baseline plus ``--max-restarts-regression`` fails — a newly flaky path
   is a regression even when the final attempt's metrics look fine — and
   a candidate whose supervisor **gave up** fails unconditionally.
+- **elastic shrinks** — a candidate whose supervisor performed more
+  elastic mesh shrinks than the baseline fails: the run survived, but
+  on fewer devices than it asked for, which invalidates every scaling
+  number the surviving metrics report.
 - **MFU** — relative decrease of the headline MFU
   (``efficiency.json``) above ``--max-mfu-regression`` fails, as does
   an MFU the baseline had but the candidate lost.
@@ -177,6 +181,18 @@ def diff_runs(a, b, thresholds=None, allow_kernel_fallback=False):
              extra > thr['restarts'],
              ('degraded: ' + ','.join(rb['degradations'])
               if rb.get('degradations') else ''))
+        # Elastic-event gate: a candidate whose supervisor had to SHRINK
+        # THE MESH survived, but on fewer devices than the run asked for
+        # — throughput, memory headroom and every scaling claim changed
+        # out from under the surviving metrics. More shrinks than the
+        # baseline fails (0 for an un-shrunk baseline).
+        ea = len(ra.get('elastic') or [])
+        eb = len(rb.get('elastic') or [])
+        if ea or eb:
+            detail = '; '.join(e.get('detail') or '?'
+                               for e in (rb.get('elastic') or []))
+            gate('elastic_shrinks', ea, eb, eb - ea, 0, eb > ea,
+                 detail or 'baseline shrank; candidate did not')
     elif ra:
         rows.append(_row('restarts', ra.get('restarts', 0), None, None,
                          thr['restarts'], 'skipped',

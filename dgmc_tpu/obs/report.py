@@ -262,6 +262,7 @@ def summarize(run):
             'restarts': rec.get('restarts', 0),
             'degradations': [d.get('rung')
                              for d in rec.get('degradations', [])],
+            'elastic': rec.get('elastic', []),
             'attempts': [
                 {'attempt': at.get('attempt'),
                  'reason': at.get('reason'),
@@ -337,6 +338,10 @@ def render(run):
         if rec.get('degradations'):
             lines.append('  degradations     '
                          + ' -> '.join(rec['degradations']))
+        for ev in rec.get('elastic') or []:
+            lines.append(f'  elastic shrink   {ev.get("detail")} '
+                         f'after {ev.get("reason")} '
+                         f'(attempt {ev.get("attempt")})')
         for at in rec.get('attempts', []):
             dur = at.get('duration_s')
             steps_done = at.get('steps_completed')

@@ -77,6 +77,17 @@ def add_obs_flag(parser):
              'SIGTERM/SIGALRM, dump <obs-dir>/hang_report.json '
              '(all-thread tracebacks, the in-flight activity, the last-'
              'completed span) so an rc:124 run is diagnosable')
+    parser.add_argument(
+        '--fence-deadline', '--fence_deadline',
+        dest='fence_deadline', type=float, default=None, metavar='SEC',
+        help='deadline on each collective device fence (the epoch-'
+             'boundary per-device completion probe): a fence that does '
+             'not complete within SEC seconds dumps '
+             '<obs-dir>/hang_report.json naming the fence phase/step '
+             'and the hosts that never reached it, then exits with '
+             'rc 67 (FENCE_TIMEOUT_RC) so the supervisor restarts '
+             'elastically instead of the run hanging to rc:124. '
+             '--supervise arms it automatically; 0 opts out')
     return parser
 
 
@@ -103,9 +114,26 @@ class RunObserver:
     """
 
     def __init__(self, obs_dir, probes=False, watchdog_deadline_s=None,
-                 watchdog_signals=None):
+                 watchdog_signals=None, fence_deadline_s=None,
+                 host_channel=None):
         self.dir = obs_dir
         self.enabled = bool(obs_dir)
+        #: Collective-fence deadline (``--fence-deadline``): every
+        #: :meth:`fence_devices` fetch runs under a
+        #: :class:`~dgmc_tpu.resilience.distributed_guard.FenceGuard`
+        #: that converts a wedged fence into hang_report.json + a
+        #: FENCE_TIMEOUT_RC exit instead of an rc:124 hang.
+        self.fence_deadline_s = fence_deadline_s or None
+        #: Optional :class:`~dgmc_tpu.resilience.distributed_guard.
+        #: HostChannel`: completed fences are recorded on it (the
+        #: attribution a peer's hang report needs) and its peer table
+        #: names the missing hosts when THIS host's fence times out.
+        self.host_channel = host_channel
+        #: Optional hook called inside the fence guard with the fence's
+        #: tag — the injection point of the ``collective-stall@N``
+        #: fault (``FaultPlan.before_fence``), kept as a plain callable
+        #: so obs does not import the resilience package.
+        self.fence_hook = None
         self.timer = StepTimer()
         self._t_start = time.time()
         self._snapshots = []
@@ -189,7 +217,7 @@ class RunObserver:
             if self.watchdog is not None:
                 self.watchdog.done()
 
-    def fence_devices(self, value):
+    def fence_devices(self, value, tag=None, phase='epoch-fence'):
         """Per-device step-completion probe for straggler/skew analysis.
 
         ``value`` is a jax array from the step's outputs (typically the
@@ -203,6 +231,17 @@ class RunObserver:
         aggregates land in ``timings.json`` (``device_steps``) and one
         record per fence in ``metrics.jsonl``.
 
+        This is also the run's **collective fence**: in a sharded
+        program the fetch drains cross-device collectives, so a dead or
+        wedged peer blocks it forever — the rc:124 shape. With
+        ``fence_deadline_s`` armed the fetch runs under a
+        :class:`~dgmc_tpu.resilience.distributed_guard.FenceGuard`
+        (miss → ``hang_report.json`` naming this fence and the missing
+        hosts → exit ``FENCE_TIMEOUT_RC``), and a completed fence is
+        recorded on the host channel so *peers'* reports can name this
+        host as arrived. ``tag`` labels the fence (the CLI's epoch
+        counter; defaults to the observer's step index).
+
         Each fetch is a device->host round trip, so call this where the
         loop already fetches (an epoch/eval boundary), not every step on
         a tunneled platform.
@@ -210,6 +249,7 @@ class RunObserver:
         if not self.enabled:
             return None
         import numpy as np
+        tag = self._step_index if tag is None else tag
         t0 = self.timer.last_start
         if t0 is None:
             t0 = time.perf_counter()
@@ -219,16 +259,35 @@ class RunObserver:
                             key=lambda s: s.device.id)
         except AttributeError:   # non-jax input: nothing to fence
             return None
-        for shard in shards:
-            np.asarray(shard.data)   # blocks until this device is done
-            times[str(shard.device.id)] = round(
-                time.perf_counter() - t0, 6)
+        if self.watchdog is not None:
+            self.watchdog.beat('fence', f'{phase}@{tag}')
+        guard = contextlib.nullcontext()
+        if self.fence_deadline_s:
+            from dgmc_tpu.resilience.distributed_guard import FenceGuard
+            guard = FenceGuard(
+                os.path.join(self.dir, 'hang_report.json'),
+                self.fence_deadline_s, phase=phase, step=tag,
+                channel=self.host_channel,
+                context_fn=self._watchdog_context)
+        with guard:
+            if self.fence_hook is not None:
+                # collective-stall@N injection point: the stall happens
+                # INSIDE the deadline guard, exactly like a wedged
+                # collective would.
+                self.fence_hook(tag)
+            for shard in shards:
+                np.asarray(shard.data)  # blocks until device is done
+                times[str(shard.device.id)] = round(
+                    time.perf_counter() - t0, 6)
+        if self.host_channel is not None:
+            self.host_channel.record_fence(phase, tag)
         for dev, dt in times.items():
             self._device_times.setdefault(dev, []).append(dt)
         self._fence_records.append((time.time(), times))
         with self._probe_lock:
             self._metrics.log(self._step_index, device_fence=times)
         if self.watchdog is not None:
+            self.watchdog.done()
             self.watchdog.beat('idle')
         return times
 

@@ -57,7 +57,9 @@ _BENIGN_AUTO = ('only be called once', 'called more than once',
 
 def initialize_distributed(coordinator_address: Optional[str] = None,
                            num_processes: Optional[int] = None,
-                           process_id: Optional[int] = None) -> int:
+                           process_id: Optional[int] = None,
+                           deadline_s: Optional[float] = None,
+                           hang_report_path: Optional[str] = None) -> int:
     """Bring up the JAX distributed runtime (idempotent).
 
     Best called before any JAX backend initialization. With no arguments,
@@ -66,32 +68,48 @@ def initialize_distributed(coordinator_address: Optional[str] = None,
     detection fails and this becomes a no-op returning 1, so scripts can
     call it unconditionally. Safe to call when a launcher already
     initialized the runtime. Returns the process count.
+
+    ``deadline_s`` + ``hang_report_path`` put the bring-up under a
+    :class:`~dgmc_tpu.resilience.distributed_guard.FenceGuard`:
+    ``jax.distributed.initialize`` blocks in C until every process of
+    the declared mesh joins, so one absent host hangs ALL hosts with no
+    Python-level recourse — the guard converts that into a
+    ``hang_report.json`` (phase ``distributed-init``) and a
+    ``FENCE_TIMEOUT_RC`` exit the supervisor can classify and restart
+    elastically on a smaller mesh.
     """
     global _initialized
     if _initialized or _already_initialized():
         _initialized = True
         return jax.process_count()
+    guard = None
+    if deadline_s and hang_report_path:
+        from dgmc_tpu.resilience.distributed_guard import FenceGuard
+        guard = FenceGuard(hang_report_path, deadline_s,
+                           phase='distributed-init')
+    import contextlib
     explicit = (coordinator_address is not None
                 or num_processes not in (None, 1)
                 or process_id is not None)
-    if explicit:
-        try:
-            jax.distributed.initialize(
-                coordinator_address=coordinator_address,
-                num_processes=num_processes,
-                process_id=process_id)
-        except RuntimeError as e:
-            if not any(m in str(e) for m in _BENIGN_ALWAYS):
-                raise
-    else:
-        try:
-            jax.distributed.initialize()
-        except ValueError:
-            # No cluster environment detected: single-process launch.
-            pass
-        except RuntimeError as e:
-            if not any(m in str(e) for m in _BENIGN_AUTO):
-                raise
+    with guard or contextlib.nullcontext():
+        if explicit:
+            try:
+                jax.distributed.initialize(
+                    coordinator_address=coordinator_address,
+                    num_processes=num_processes,
+                    process_id=process_id)
+            except RuntimeError as e:
+                if not any(m in str(e) for m in _BENIGN_ALWAYS):
+                    raise
+        else:
+            try:
+                jax.distributed.initialize()
+            except ValueError:
+                # No cluster environment detected: single-process launch.
+                pass
+            except RuntimeError as e:
+                if not any(m in str(e) for m in _BENIGN_AUTO):
+                    raise
     _initialized = True
     return jax.process_count()
 

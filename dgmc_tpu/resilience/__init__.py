@@ -18,12 +18,23 @@ correspondences (detect, correct, keep iterating):
   failures) so every recovery path is exercised by tests.
 - :mod:`~dgmc_tpu.resilience.guard` — host-side rollback policy over
   the in-graph non-finite guard of ``make_train_step(guard=True)``.
+- :mod:`~dgmc_tpu.resilience.distributed_guard` — the multi-host
+  control plane: per-host heartbeat files, peer-death/straggler
+  detection, the host-0 recovery ledger, and collective fences with
+  deadlines (a wedged fence dumps ``hang_report.json`` naming the
+  missing host/phase and exits ``FENCE_TIMEOUT_RC`` instead of hanging
+  forever). The supervisor turns its evidence into **elastic
+  restarts**: shrink the mesh, reshard the checkpoint, resume.
 
-``faults`` and ``supervisor`` are jax-free (importable anywhere, even
-while a backend is wedged); ``guard`` touches jax only when a rollback
-actually fires.
+``faults``, ``supervisor`` and ``distributed_guard`` are jax-free
+(importable anywhere, even while a backend is wedged); ``guard``
+touches jax only when a rollback actually fires.
 """
 
+from dgmc_tpu.resilience.distributed_guard import (FENCE_TIMEOUT_RC,
+                                                   FenceGuard,
+                                                   HostChannel,
+                                                   RecoveryLedger)
 from dgmc_tpu.resilience.faults import (FaultInjected, FaultPlan,
                                         FaultSpec, add_fault_args,
                                         arm_download_faults,
@@ -36,9 +47,13 @@ from dgmc_tpu.resilience.supervisor import (Supervisor,
                                             supervise_cli)
 
 __all__ = [
+    'FENCE_TIMEOUT_RC',
     'FaultInjected',
     'FaultPlan',
     'FaultSpec',
+    'FenceGuard',
+    'HostChannel',
+    'RecoveryLedger',
     'add_fault_args',
     'arm_download_faults',
     'consume_download_fault',

@@ -26,6 +26,23 @@ fire at exact, reproducible points:
 ``download-fail:K``    error (``datasets/download.py`` must retry past
                        them); also armable via the
                        ``DGMC_TPU_FAULT_DOWNLOADS=K`` env var
+``peer-death@N`` /     a peer host dies at step N: write a control-plane
+``peer-death@N:H``     tombstone for host H (default: this host's index)
+                       then ``SIGKILL`` self — the supervisor must
+                       classify it as a *distributed* failure and
+                       perform an elastic mesh-shrinking restart
+``straggler@N:MS``     sleep MS milliseconds before every step >= N —
+                       a persistently slow host the skew/straggler
+                       detection must surface (a *condition*, so it
+                       deliberately re-fires every step, unledgered)
+``coord-partition@N``  from step N on, stop writing control-plane
+                       heartbeats: the host looks dead to its peers
+                       while still running (a coordination-service
+                       partition); heals on restart (ledgered)
+``collective-stall@N``/ sleep S seconds (default 3600) INSIDE the next
+``collective-stall@N:S`` device fence at step N — the wedged-collective
+                       stand-in the fence deadline must convert into a
+                       ``hang_report.json`` + ``FENCE_TIMEOUT_RC`` exit
 =====================  ==================================================
 
 **Fire-once semantics across restarts.** A supervised run replays its
@@ -56,9 +73,16 @@ __all__ = ['FaultInjected', 'FaultSpec', 'FaultPlan', 'add_fault_args',
 FIRED_LEDGER = 'faults_fired.json'
 
 #: Host-side fault kinds that fire in the training loop, once.
-_STEP_KINDS = ('raise', 'sigterm', 'sigkill', 'stall')
+_STEP_KINDS = ('raise', 'sigterm', 'sigkill', 'stall', 'peer-death',
+               'coord-partition')
 _CKPT_KINDS = ('ckpt-truncate', 'ckpt-corrupt')
-KINDS = _STEP_KINDS + _CKPT_KINDS + ('nan-grads', 'download-fail')
+#: Fence-scoped kinds (fire inside the device-fence guard, once).
+_FENCE_KINDS = ('collective-stall',)
+#: Condition kinds: persistent states, not events — unledgered, re-fire
+#: deliberately (a straggler is slow on EVERY step, including replays).
+_CONDITION_KINDS = ('straggler',)
+KINDS = _STEP_KINDS + _CKPT_KINDS + _FENCE_KINDS + _CONDITION_KINDS + \
+    ('nan-grads', 'download-fail')
 
 
 class FaultInjected(RuntimeError):
@@ -102,9 +126,12 @@ def parse_spec(text):
         raise ValueError(f'{text!r}: {kind} needs a step (e.g. {kind}@3)')
     step = int(step)
     if arg is not None:
-        arg = float(arg)
-    elif kind == 'stall':
+        # peer-death's arg is a host INDEX, not a duration.
+        arg = int(arg) if kind == 'peer-death' else float(arg)
+    elif kind in ('stall', 'collective-stall'):
         arg = 3600.0
+    elif kind == 'straggler':
+        arg = 1000.0   # milliseconds of injected per-step lag
     return FaultSpec(kind, step=step, arg=arg)
 
 
@@ -115,10 +142,12 @@ def add_fault_args(parser):
         action='append', default=[], metavar='SPEC',
         help='deterministic fault injection (repeatable): raise@N, '
              'sigterm@N, sigkill@N, stall@N[:SEC], nan-grads@N, '
-             'ckpt-truncate@N, ckpt-corrupt@N, download-fail[:K]. '
-             'Process-killing faults fire ONCE across supervised '
-             'restarts (ledger in the checkpoint/obs dir); nan-grads '
-             'replays deterministically. See '
+             'ckpt-truncate@N, ckpt-corrupt@N, download-fail[:K], '
+             'peer-death@N[:HOST], straggler@N:MS, coord-partition@N, '
+             'collective-stall@N[:SEC]. Process-killing faults fire '
+             'ONCE across supervised restarts (ledger in the '
+             'checkpoint/obs dir); nan-grads replays deterministically; '
+             'straggler re-fires every step by design. See '
              'dgmc_tpu/resilience/faults.py.')
     return parser
 
@@ -155,21 +184,40 @@ class FaultPlan:
             checkpoint dir (survives supervised restarts) or the obs
             ROOT dir. ``None`` disables the ledger (every fault can
             re-fire; fine for single-shot tests).
+        control_dir: the control-plane directory
+            (``distributed_guard.control_dir(obs_dir)``) where
+            ``peer-death`` writes its tombstone; defaults to
+            ``<state_dir>/control`` when a ledger dir exists.
+        host_index: this process's host index — the default tombstone
+            target of ``peer-death@N`` and the identity
+            ``coord-partition`` silences.
     """
 
-    def __init__(self, specs=(), state_dir=None):
+    def __init__(self, specs=(), state_dir=None, control_dir=None,
+                 host_index=0):
         self.specs = [s if isinstance(s, FaultSpec) else parse_spec(s)
                       for s in (specs or ())]
         self._state_dir = state_dir
+        self._control_dir = control_dir or (
+            os.path.join(state_dir, 'control') if state_dir else None)
+        self.host_index = int(host_index)
+        #: Set once ``coord-partition`` fires; :class:`HostChannel`
+        #: checks it before every heartbeat write. Always starts False:
+        #: a ledgered coord-partition does not re-fire after a restart,
+        #: so the restart "heals" the partition by design (the restart
+        #: IS the recovery under test).
+        self.coord_partitioned = False
         self._fired = set(self._load_ledger())
         for spec in self.specs:
             if spec.kind == 'download-fail':
                 arm_download_faults(spec.arg)
 
     @classmethod
-    def from_args(cls, args, state_dir=None):
+    def from_args(cls, args, state_dir=None, control_dir=None,
+                  host_index=0):
         return cls(getattr(args, 'inject_fault', ()) or (),
-                   state_dir=state_dir)
+                   state_dir=state_dir, control_dir=control_dir,
+                   host_index=host_index)
 
     def __bool__(self):
         return bool(self.specs)
@@ -212,7 +260,12 @@ class FaultPlan:
     def before_step(self, step):
         """Fire any armed host-side fault scheduled for ``step``
         (1-based step/epoch counter). The ledger is written BEFORE the
-        fault delivers, so a killed-and-restarted run does not re-fire."""
+        fault delivers, so a killed-and-restarted run does not re-fire.
+        Condition kinds (``straggler``) re-fire on every step >= N by
+        design — a slow host is slow on replays too."""
+        for spec in self.specs:
+            if spec.kind == 'straggler' and spec.step <= step:
+                time.sleep(spec.arg / 1000.0)
         for spec in self.specs:
             if spec.kind not in _STEP_KINDS or spec.step != step \
                     or spec.key in self._fired:
@@ -224,6 +277,21 @@ class FaultPlan:
                 raise FaultInjected(f'injected fault {spec.key}')
             if spec.kind == 'stall':
                 time.sleep(spec.arg)
+            elif spec.kind == 'coord-partition':
+                # From here on this host writes no heartbeats: it looks
+                # dead to its peers while still computing.
+                self.coord_partitioned = True
+            elif spec.kind == 'peer-death':
+                host = self.host_index if spec.arg is None \
+                    else int(spec.arg)
+                if self._control_dir:
+                    from dgmc_tpu.resilience.distributed_guard import \
+                        write_tombstone
+                    write_tombstone(self._control_dir, host, step=step)
+                os.kill(os.getpid(), signal.SIGKILL)
+                time.sleep(30)
+                raise FaultInjected(
+                    f'{spec.key} delivered but the process survived')
             else:
                 os.kill(os.getpid(), signal.SIGTERM
                         if spec.kind == 'sigterm' else signal.SIGKILL)
@@ -233,6 +301,21 @@ class FaultPlan:
                 time.sleep(30)
                 raise FaultInjected(
                     f'{spec.key} delivered but the process survived')
+
+    def before_fence(self, step):
+        """Fire any armed fence-scoped fault for ``step`` — called by
+        :meth:`RunObserver.fence_devices
+        <dgmc_tpu.obs.run.RunObserver.fence_devices>` INSIDE its
+        deadline guard, so a ``collective-stall`` is seen by exactly the
+        machinery that must convert it into a ``hang_report.json``."""
+        for spec in self.specs:
+            if spec.kind not in _FENCE_KINDS or spec.step != step \
+                    or spec.key in self._fired:
+                continue
+            self._mark_fired(spec)
+            print(f'[faults] firing {spec.key} inside the step-{step} '
+                  f'fence', file=sys.stderr, flush=True)
+            time.sleep(spec.arg)
 
     def after_checkpoint(self, ckpt, step):
         """Corrupt the just-saved checkpoint when a ``ckpt-*@step`` fault

@@ -23,6 +23,16 @@ CLI command in a child process and closes the detection→recovery loop:
   ``--model_shards`` (shrink the mesh) — so a run that keeps dying in
   the same place trades speed for survival instead of burning its whole
   restart budget on one suspect kernel/policy/topology.
+- **Distributed failure → elastic restart**: a dead peer host (stale
+  control-plane heartbeat or a ``peer-death`` tombstone — see
+  :mod:`~dgmc_tpu.resilience.distributed_guard`), a collective fence
+  that exited ``FENCE_TIMEOUT_RC``, or a watchdog-caught hang means the
+  MESH broke, not the program. Instead of retrying into the same wedged
+  collective, the supervisor immediately halves the mesh flags
+  (``--model_shards`` / ``--row_shards``), publishes attempt number and
+  new mesh size to the host-0 recovery ledger so every host rejoins in
+  agreement, and restarts — the checkpoint layer reshards the restored
+  state onto the smaller mesh. ``--no-elastic`` opts out.
 - **Budget**: ``--max-restarts`` bounds the loop; exhausting it records
   ``outcome: gave-up`` and exits nonzero with the last failure's
   evidence on disk.
@@ -51,12 +61,18 @@ from dgmc_tpu.utils.io import write_json_atomic
 
 __all__ = ['Supervisor', 'add_supervisor_args', 'strip_supervisor_args',
            'supervise_cli', 'DEFAULT_MAX_RESTARTS',
-           'DEFAULT_HANG_DEADLINE_S']
+           'DEFAULT_HANG_DEADLINE_S', 'DEFAULT_PEER_STALE_S']
 
 DEFAULT_MAX_RESTARTS = 5
 #: Watchdog deadline injected into supervised children that have an obs
 #: dir but no explicit ``--watchdog-deadline`` of their own.
 DEFAULT_HANG_DEADLINE_S = 600.0
+#: How stale a PEER host's control-plane heartbeat may go before the
+#: supervisor declares that host dead and elastically restarts. The
+#: heartbeat refresher writes every ~1 s while its process lives, so
+#: this only needs to outlast filesystem jitter — it is NOT a progress
+#: deadline (that is the watchdog's and the fence guard's job).
+DEFAULT_PEER_STALE_S = 15.0
 RECOVERY_FILE = 'recovery.json'
 #: The per-attempt obs subdirectory naming contract. The supervisor
 #: writes these; ``faults.ledger_dir`` (fire-once ledger placement) and
@@ -82,6 +98,7 @@ _OWN_FLAGS = {
     '--supervise': 0,
     '--max-restarts': 1, '--max_restarts': 1,
     '--restart-backoff': 1, '--restart_backoff': 1,
+    '--no-elastic': 0, '--no_elastic': 0,
 }
 
 
@@ -108,6 +125,16 @@ def add_supervisor_args(parser):
         type=float, default=1.0, metavar='SEC',
         help='base of the exponential restart backoff (default '
              '%(default)s s, doubling per restart, capped at 60 s)')
+    parser.add_argument(
+        '--no-elastic', '--no_elastic', dest='elastic',
+        action='store_false', default=True,
+        help='disable elastic restarts under --supervise: by default a '
+             'DISTRIBUTED failure (peer death, stale peer heartbeat, '
+             'fence-deadline exit, watchdog hang) immediately shrinks '
+             'the mesh (--model_shards / --row_shards halved), records '
+             'the decision in the control-plane ledger, and resumes '
+             'from the latest checkpoint resharded onto the smaller '
+             'mesh')
     return parser
 
 
@@ -177,14 +204,31 @@ def _rung_force_f32(argv, env):
     return argv + ['--f32'], env, '--f32 (bf16 policy off)'
 
 
+#: Mesh-size flag families the shrink rung (and the elastic restart)
+#: knows how to halve — the legacy correspondence sharding and the
+#: partition-rule streamed layout.
+_MESH_FLAGS = (('--model_shards', '--model-shards'),
+               ('--row_shards', '--row-shards'))
+
+
 def _rung_shrink_mesh(argv, env):
-    cur = _flag_value(argv, ('--model_shards', '--model-shards'))
-    if cur is None or int(cur) <= 1:
-        return argv, env, None
-    new = max(1, int(cur) // 2)
-    argv = _replace_flag_value(argv, ('--model_shards', '--model-shards'),
-                               new)
-    return argv, env, f'--model_shards {cur} -> {new} (shrink the mesh)'
+    for names in _MESH_FLAGS:
+        cur = _flag_value(argv, names)
+        if cur is None or int(cur) <= 1:
+            continue
+        new = max(1, int(cur) // 2)
+        argv = _replace_flag_value(argv, names, new)
+        return argv, env, f'{names[0]} {cur} -> {new} (shrink the mesh)'
+    return argv, env, None
+
+
+def mesh_size(argv):
+    """The current mesh-shard count named by ``argv`` (or ``None``)."""
+    for names in _MESH_FLAGS:
+        cur = _flag_value(argv, names)
+        if cur is not None:
+            return int(cur)
+    return None
 
 
 #: name -> rewrite(argv, env) -> (argv, env, description-or-None).
@@ -226,13 +270,31 @@ class Supervisor:
         ladder: rung names from :data:`LADDER_RUNGS`, applied one per
             escalation after ``same_step_threshold`` failures at the
             same step.
+        elastic: perform an **elastic restart** on a *distributed*
+            failure (peer death, stale peer heartbeat, fence-deadline
+            exit, watchdog hang): immediately halve the mesh flags
+            (``--model_shards`` / ``--row_shards``), publish the
+            decision to the control-plane ledger (host-0 leadership —
+            see :mod:`~dgmc_tpu.resilience.distributed_guard`), and
+            resume from the latest checkpoint, which
+            ``train/checkpoint.py`` reshards onto the smaller mesh.
+        host_index: this supervisor's host index. Host 0 leads: it
+            writes the recovery ledger; followers wait for its decision
+            before restarting so every host rejoins with the same
+            attempt number and mesh size.
+        peer_stale_s: staleness bound on PEER control-plane heartbeats
+            (``<attempt>/control/host_<i>.json``) before a peer counts
+            as dead and the child (wedged in a collective with it) is
+            killed.
     """
 
     def __init__(self, cmd, argv, *, obs_dir=None, ckpt_dir=None,
                  max_restarts=DEFAULT_MAX_RESTARTS, backoff_s=1.0,
                  backoff_max_s=60.0, grace_s=10.0, hang_deadline_s=None,
                  first_heartbeat_s=None, ladder=DEFAULT_LADDER,
-                 same_step_threshold=2, poll_s=0.5, env=None):
+                 same_step_threshold=2, poll_s=0.5, env=None,
+                 elastic=True, host_index=0,
+                 peer_stale_s=DEFAULT_PEER_STALE_S):
         self.cmd = list(cmd)
         self.argv = list(argv)
         self.obs_dir = obs_dir
@@ -256,9 +318,24 @@ class Supervisor:
         self._base_env.setdefault(
             'DGMC_TPU_FAULT_LEDGER_DIR',
             os.path.dirname(os.path.abspath(self.recovery_path)))
+        self.elastic = bool(elastic)
+        self.host_index = int(host_index)
+        self.peer_stale_s = float(peer_stale_s)
+        #: How long a FOLLOWER supervisor waits for the leader's ledger
+        #: decision before restarting on its own terms (a follower that
+        #: can't see the leader must still make progress eventually).
+        self.ledger_wait_s = 30.0
+        self._t_created = time.time()
+        self._ledger = None
+        if obs_dir:
+            from dgmc_tpu.resilience.distributed_guard import (
+                RecoveryLedger, control_dir)
+            self._ledger = RecoveryLedger(control_dir(obs_dir),
+                                          host_index=self.host_index)
         self.events = []
         self.attempts = []
         self.degradations = []
+        self.elastic_events = []
         self.restarts = 0
         self.outcome = 'running'
         self._stop_signal = None
@@ -284,6 +361,7 @@ class Supervisor:
             'outcome': self.outcome,
             'restarts': self.restarts,
             'degradations': self.degradations,
+            'elastic': self.elastic_events,
             'attempts': self.attempts,
             'events': self.events,
         }
@@ -295,10 +373,12 @@ class Supervisor:
 
     def _attempt_dirs(self, k):
         if not self.obs_dir:
-            return None, None, None
+            return None, None, None, None
         adir = os.path.join(self.obs_dir, attempt_dirname(k))
+        from dgmc_tpu.resilience.distributed_guard import control_dir
         return (adir, os.path.join(adir, 'heartbeat.json'),
-                os.path.join(adir, 'hang_report.json'))
+                os.path.join(adir, 'hang_report.json'),
+                control_dir(adir))
 
     @staticmethod
     def _candidate_paths(path):
@@ -336,6 +416,34 @@ class Supervisor:
                 except OSError:
                     pass
 
+    def _clear_control_dir(self, cdir):
+        """Control-plane liveness (host heartbeats, tombstones) is
+        per-attempt like the watchdog heartbeat: a PREVIOUS session's
+        files in a reused attempt dir would read as instantly-dead
+        peers and kill a healthy child on the first poll. Only files
+        older than this supervisor session are cleared: on a shared
+        obs filesystem a faster host's supervisor reaches the attempt
+        first and its child may already have written THIS attempt's
+        heartbeats or tombstones — wiping those would hide exactly the
+        peer-death evidence this session must classify on."""
+        if not cdir:
+            return
+        try:
+            names = os.listdir(cdir)
+        except OSError:
+            return
+        for name in names:
+            p = os.path.join(cdir, name)
+            try:
+                # 2 s slack: sandboxed filesystems truncate mtimes, and
+                # a file another host wrote a moment before this
+                # supervisor started must survive; genuinely stale
+                # evidence is minutes-to-hours old.
+                if os.path.getmtime(p) < self._t_created - 2.0:
+                    os.remove(p)
+            except OSError:
+                pass
+
     def _child_argv(self, attempt_dir):
         argv = list(self.argv)
         if attempt_dir:
@@ -372,9 +480,17 @@ class Supervisor:
                  for hb in map(self._read_heartbeat,
                                self._candidate_paths(heartbeat_path))
                  if hb and hb.get('steps_completed') is not None]
+        ck = self._latest_ckpt_step()
         if steps:
-            return (start_step or 0) + min(steps)
-        return self._latest_ckpt_step()
+            derived = (start_step or 0) + min(steps)
+            # The heartbeat samples at the watchdog's poll cadence and
+            # can lag fast steps; a COMMITTED checkpoint is proof of
+            # progress at least that far, so it floors the estimate
+            # (matters for the same-step ladder: a run that died right
+            # after checkpointing step N must not read as stuck at the
+            # stale heartbeat's step).
+            return derived if ck is None else max(derived, ck)
+        return ck
 
     def _kill(self, proc, reason):
         """SIGTERM (lets the child watchdog dump its report), grace,
@@ -392,7 +508,85 @@ class Supervisor:
         except OSError:
             pass
 
-    def _watch(self, proc, heartbeat_path, hang_report_path):
+    def _dead_peer(self, cdir):
+        """A peer host the control plane says is dead: tombstoned, or
+        its heartbeat refresher stopped (the process died with it).
+        Returns ``'host_<i>'`` or ``None``. Hosts that never wrote a
+        heartbeat are absent (still importing), not dead — and this
+        host's OWN child is excluded from the staleness scan: its
+        liveness is the watchdog heartbeat's job, and a delayed write
+        from it must not read as a dead *peer* and shrink a healthy
+        mesh (a tombstone for it still counts — tombstones are written
+        deliberately)."""
+        if not cdir:
+            return None
+        from dgmc_tpu.resilience.distributed_guard import (
+            read_heartbeats, read_tombstones)
+        tombs = read_tombstones(cdir)
+        if tombs:
+            return f'host_{min(tombs)}'
+        beats = read_heartbeats(cdir)
+        if len(beats) < 2:
+            return None
+        now = time.time()
+        stale = [h for h, rec in beats.items()
+                 if h != self.host_index
+                 and now - rec.get('time', 0) > self.peer_stale_s]
+        if stale and len(stale) < len(beats):
+            return f'host_{min(stale)}'
+        return None
+
+    def _dead_peer_tombstone(self, cdir):
+        """Post-mortem tombstone check (``'host_<i>'`` or ``None``)."""
+        if not cdir:
+            return None
+        from dgmc_tpu.resilience.distributed_guard import read_tombstones
+        tombs = read_tombstones(cdir)
+        return f'host_{min(tombs)}' if tombs else None
+
+    def _is_distributed_failure(self, reason):
+        """Failures that mean the MESH broke, not the program: a dead
+        or partitioned peer, a fence that missed its deadline, or a
+        wedged collective the watchdog/heartbeat layer caught. These
+        trigger the elastic restart; ordinary crashes just retry.
+        ``no-first-heartbeat`` is deliberately NOT here: a slow first
+        compile looks identical to a distributed-init wedge from this
+        vantage point, and permanently halving a healthy mesh for slow
+        compilation is the worse error — the init wedge gets its crisp
+        signal from the fence-guarded ``initialize_distributed``
+        (``exit:FENCE_TIMEOUT_RC``), and failing that, the same-step
+        ladder still reaches the shrink rung."""
+        from dgmc_tpu.resilience.distributed_guard import FENCE_TIMEOUT_RC
+        return (reason.startswith(('peer-death', 'hang-report',
+                                   'heartbeat-stale'))
+                or reason == f'exit:{FENCE_TIMEOUT_RC}')
+
+    def _adopt_ledger_mesh(self, argv, attempt):
+        """Follower path: block (bounded) for the leader's decision on
+        ``attempt`` and rewrite this host's mesh flag to the decided
+        size. Returns the (possibly rewritten) argv."""
+        led = self._ledger.wait_for_attempt(
+            attempt, timeout_s=self.ledger_wait_s,
+            poll_s=min(self.poll_s, 0.2))
+        if led is None:
+            self._event('ledger-timeout', attempt=attempt,
+                        waited_s=self.ledger_wait_s)
+            return argv
+        shards = (led.get('mesh') or {}).get('shards')
+        cur = mesh_size(argv)
+        if not shards or cur is None or shards == cur:
+            return argv
+        for names in _MESH_FLAGS:
+            if _flag_value(argv, names) is not None:
+                argv = _replace_flag_value(argv, names, shards)
+                self._event('ledger-adopt', attempt=attempt,
+                            detail=f'{names[0]} {cur} -> {shards} '
+                                   f'(leader decision)')
+                break
+        return argv
+
+    def _watch(self, proc, heartbeat_path, hang_report_path,
+               control_dir=None):
         """Wait for child exit; return a hang reason if WE killed it."""
         stale_after = (2.0 * self.hang_deadline_s
                        if self.hang_deadline_s else None)
@@ -409,13 +603,23 @@ class Supervisor:
                 return None
             except subprocess.TimeoutExpired:
                 pass
+            dead = self._dead_peer(control_dir)
+            if dead is not None:
+                # The surviving child is (or soon will be) wedged in a
+                # collective its dead peer can never join: kill it now
+                # and let the elastic restart shrink the mesh.
+                self._kill(proc, f'peer-death:{dead}')
+                return f'peer-death:{dead}'
             for path in self._candidate_paths(hang_report_path):
                 if not os.path.exists(path):
                     continue
                 rep = self._read_heartbeat(path) or {}
                 # The watchdog re-dumps on SIGTERM during shutdown too;
-                # only a DEADLINE dump means "wedged, kill me".
-                if str(rep.get('reason', '')).startswith('deadline'):
+                # only a DEADLINE dump (the watchdog's staleness dump or
+                # a fence-deadline dump whose process somehow survived)
+                # means "wedged, kill me".
+                if str(rep.get('reason', '')).startswith(
+                        ('deadline', 'fence-deadline')):
                     self._kill(proc, 'hang-report')
                     return 'hang-report'
             if stale_after and heartbeat_path:
@@ -472,10 +676,12 @@ class Supervisor:
         rung_idx, same_step_fails, last_fail_step = 0, 0, _NO_FAILURE
         attempt = 0
         while True:
-            attempt_dir, hb_path, hang_path = self._attempt_dirs(attempt)
+            attempt_dir, hb_path, hang_path, ctrl_dir = \
+                self._attempt_dirs(attempt)
             if attempt_dir:
                 os.makedirs(attempt_dir, exist_ok=True)
                 self._clear_stale_evidence(hb_path, hang_path)
+                self._clear_control_dir(ctrl_dir)
             start_step = self._latest_ckpt_step()
             child_argv = self._child_argv(attempt_dir)
             rec = {'attempt': attempt,
@@ -498,7 +704,8 @@ class Supervisor:
                 spawn_failure = f'spawn-failed:{type(e).__name__}: {e}'
             else:
                 spawn_failure = None
-                hang_reason = self._watch(proc, hb_path, hang_path)
+                hang_reason = self._watch(proc, hb_path, hang_path,
+                                          ctrl_dir)
                 if hang_reason and hang_reason.startswith('preempted'):
                     # Reap the child BEFORE recording: the attempt's rc
                     # and final step evidence only exist once it is dead.
@@ -524,6 +731,15 @@ class Supervisor:
             reason = spawn_failure or hang_reason or (
                 f'signal:{signal.Signals(-proc.returncode).name}'
                 if proc.returncode < 0 else f'exit:{proc.returncode}')
+            # A child that died by its own hand can still carry
+            # distributed evidence the poll loop never saw: a
+            # peer-death tombstone (the injected fault SIGKILLs
+            # immediately after writing it) means a HOST died, not the
+            # run — reclassify so the elastic path fires.
+            if not reason.startswith('peer-death'):
+                dead = self._dead_peer_tombstone(ctrl_dir)
+                if dead is not None:
+                    reason = f'peer-death:{dead} ({reason})'
             rec['reason'] = reason
             self._event('failure', reason=reason,
                         steps_completed=rec['steps_completed'])
@@ -536,28 +752,73 @@ class Supervisor:
                 return proc.returncode if proc and proc.returncode \
                     and proc.returncode > 0 else 1
 
+            # Elastic restart: a DISTRIBUTED failure (a peer died, a
+            # fence timed out, a collective wedged) is not a bug to
+            # retry harder against — the mesh itself must shrink. Fires
+            # immediately, without waiting for the same-step ladder:
+            # restarting on the same mesh would wedge the same
+            # collective again.
+            elastically_shrunk = False
+            if self.elastic and self._is_distributed_failure(reason):
+                new_argv, new_env, desc = _rung_shrink_mesh(argv, env)
+                if desc:
+                    argv, env = new_argv, new_env
+                    self.argv = argv
+                    self.elastic_events.append(
+                        {'attempt': attempt, 'reason': reason,
+                         'detail': desc, 'mesh_after': mesh_size(argv)})
+                    self._event('elastic-shrink', reason=reason,
+                                detail=desc)
+                    elastically_shrunk = True
+                    # The mesh changed; old same-step evidence is moot.
+                    same_step_fails, last_fail_step = 0, _NO_FAILURE
+
             # Same-step escalation: repeated death at one step (or with
             # no progress evidence at all) means retrying harder won't
             # help — degrade instead.
-            step = rec['steps_completed']
-            if step == last_fail_step:
-                same_step_fails += 1
-            else:
-                same_step_fails = 0
-            last_fail_step = step
-            if same_step_fails >= self.same_step_threshold - 1:
-                while rung_idx < len(self.ladder):
-                    rung = self.ladder[rung_idx]
-                    rung_idx += 1
-                    argv, env, desc = LADDER_RUNGS[rung](argv, env)
+            if not elastically_shrunk:
+                step = rec['steps_completed']
+                if step == last_fail_step:
+                    same_step_fails += 1
+                else:
+                    same_step_fails = 0
+                last_fail_step = step
+                if same_step_fails >= self.same_step_threshold - 1:
+                    while rung_idx < len(self.ladder):
+                        rung = self.ladder[rung_idx]
+                        rung_idx += 1
+                        argv, env, desc = LADDER_RUNGS[rung](argv, env)
+                        self.argv = argv
+                        if desc:
+                            self.degradations.append(
+                                {'rung': rung, 'attempt': attempt,
+                                 'detail': desc})
+                            self._event('degrade', rung=rung, detail=desc)
+                            break
+                    same_step_fails = 0
+
+            # Publish the next attempt's terms before any child can
+            # start it: with host-0 leadership every host's supervisor
+            # restarts onto the SAME attempt number and mesh size. A
+            # FOLLOWER waits for the leader's decision and ADOPTS its
+            # mesh size — two hosts restarting with different
+            # --model_shards would wedge the very first collective
+            # again. A follower that cannot see a decision within
+            # ledger_wait_s proceeds on its own terms (progress beats
+            # a monitor deadlocked on a dead leader).
+            if self._ledger is not None:
+                if self._ledger.is_leader:
+                    try:
+                        self._ledger.decide(
+                            attempt + 1, reason,
+                            mesh={'shards': mesh_size(argv)},
+                            detail=(self.elastic_events[-1]['detail']
+                                    if elastically_shrunk else None))
+                    except OSError:
+                        pass  # the ledger never takes the monitor down
+                else:
+                    argv = self._adopt_ledger_mesh(argv, attempt + 1)
                     self.argv = argv
-                    if desc:
-                        self.degradations.append(
-                            {'rung': rung, 'attempt': attempt,
-                             'detail': desc})
-                        self._event('degrade', rung=rung, detail=desc)
-                        break
-                same_step_fails = 0
 
             delay = min(self.backoff_max_s,
                         self.backoff_s * (2 ** (self.restarts - 1)))
@@ -606,6 +867,15 @@ def supervise_cli(module, args, argv=None, *,
         child_argv = child_argv + ['--watchdog-deadline', str(deadline)]
     elif not deadline:
         deadline = None
+    if obs_dir and deadline \
+            and getattr(args, 'fence_deadline', None) is None:
+        # Arm the collective-fence deadline alongside the watchdog: a
+        # fence that misses it exits FENCE_TIMEOUT_RC with a
+        # hang_report.json naming the missing host/phase — prompt,
+        # attributable evidence instead of waiting out the heartbeat
+        # staleness. Same opt-out contract: --fence-deadline 0 is
+        # honored.
+        child_argv = child_argv + ['--fence-deadline', str(deadline)]
     if not obs_dir:
         print('[supervisor] no --obs-dir: hang detection disabled '
               '(crash/preemption recovery only)', file=sys.stderr)
@@ -618,5 +888,10 @@ def supervise_cli(module, args, argv=None, *,
         obs_dir=obs_dir, ckpt_dir=ckpt_dir,
         max_restarts=getattr(args, 'max_restarts', DEFAULT_MAX_RESTARTS),
         backoff_s=getattr(args, 'restart_backoff', 1.0),
-        hang_deadline_s=deadline, ladder=ladder)
+        hang_deadline_s=deadline, ladder=ladder,
+        elastic=getattr(args, 'elastic', True),
+        # Multi-host launchers run one supervisor per host (same
+        # command, shared obs filesystem); the env var names this
+        # host's index so exactly one supervisor leads the ledger.
+        host_index=int(os.environ.get('DGMC_TPU_HOST_INDEX', '0') or 0))
     return sup.run()

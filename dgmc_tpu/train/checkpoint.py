@@ -240,12 +240,32 @@ class Checkpointer:
         self.finalize_manifests()
 
     def _restore_one(self, step: int, state):
-        abstract = jax.tree.map(
-            lambda x: jax.ShapeDtypeStruct(
-                x.shape, x.dtype, sharding=getattr(x, 'sharding', None))
-            if hasattr(x, 'shape') else x, state)
+        import numpy as np
+
+        def abstract(x):
+            if not hasattr(x, 'shape'):
+                return x
+            sharding = getattr(x, 'sharding', None)
+            if sharding is not None:
+                # A target leaf carrying a sharding restores straight
+                # onto it — including a DIFFERENT mesh than the one the
+                # checkpoint was saved under (orbax reshards from file);
+                # this is the elastic mesh-shrink restore path.
+                return jax.ShapeDtypeStruct(x.shape, x.dtype,
+                                            sharding=sharding)
+            # A target leaf with NO sharding (host numpy state, a bare
+            # ShapeDtypeStruct) restores to host numpy. Passing
+            # sharding=None instead would make orbax fall back to the
+            # sharding RECORDED in the checkpoint, which names devices
+            # that no longer exist after a mesh shrink (8->4 restore) —
+            # and that placement failure then masquerades as
+            # corruption in the fallback walk.
+            return np.broadcast_to(np.zeros((), np.dtype(x.dtype)),
+                                   x.shape)
+
         return self._mgr.restore(
-            step, args=self._ocp.args.StandardRestore(abstract))
+            step, args=self._ocp.args.StandardRestore(
+                jax.tree.map(abstract, state)))
 
     def restore(self, state, step: Optional[int] = None,
                 fallback: Optional[bool] = None, structures=None):
@@ -360,7 +380,23 @@ def _toggle_guard_structure(state):
     return with_guard_counters(state)
 
 
-def resume_or_init(ckpt_dir, state):
+def _place_for_mesh(state, mesh, rules):
+    """Restore/initial placement for a mesh workload: every leaf lands
+    with the layout the partition rules declare on the CURRENT mesh
+    (plain replication when no rules). Restoring into these shardings
+    is what makes the elastic mesh-shrink rung real: a checkpoint saved
+    on the 8-device mesh deserializes directly onto the 4-device one,
+    without bouncing the whole state through a single device — and
+    without tripping the committed-single-device vs mesh-constraint
+    placement error a bare restore hits."""
+    if rules is not None:
+        from dgmc_tpu.parallel.rules import shard_tree
+        return shard_tree(state, rules.state, mesh)
+    from jax.sharding import NamedSharding, PartitionSpec
+    return jax.device_put(state, NamedSharding(mesh, PartitionSpec()))
+
+
+def resume_or_init(ckpt_dir, state, mesh=None, rules=None):
     """Shared workload resume glue: open a :class:`Checkpointer` under
     ``ckpt_dir`` (``None`` -> no checkpointing) and restore the latest saved
     state if one exists — falling back past corrupt/torn checkpoints (see
@@ -371,6 +407,14 @@ def resume_or_init(ckpt_dir, state):
     directory is a fresh start; a directory where every checkpoint is
     corrupt raises :class:`CheckpointCorruptError` with instructions
     rather than silently retraining from scratch.
+
+    ``mesh`` (optionally with ``rules``, a
+    :class:`~dgmc_tpu.parallel.rules.PartitionRules`) re-derives the
+    target shardings on the CURRENT mesh before restoring, so a
+    checkpoint written under a different (larger) mesh restores
+    **resharded** — the elastic-restart path. Single-process only: a
+    multi-process run must keep host-side state here and go through
+    ``parallel.global_batch`` after.
 
     Toggling ``--guard-bad-steps`` between runs changes the state PYTREE
     STRUCTURE (``TrainState`` <-> ``GuardedTrainState``), and a structure
@@ -384,6 +428,8 @@ def resume_or_init(ckpt_dir, state):
     step instead of silently sliding back to an older same-structure
     one.
     """
+    if mesh is not None:
+        state = _place_for_mesh(state, mesh, rules)
     if not ckpt_dir:
         return None, state, 1
     ckpt = Checkpointer(ckpt_dir)

@@ -95,6 +95,40 @@ def test_hung_host_is_flagged(tmp_path):
     assert 'hang_report' in s['per_host']['host_1']
 
 
+def test_hung_host_attributed_to_fence_and_phase(tmp_path):
+    """'Hung' alone is not actionable: the aggregate must say what the
+    host was inside (the hang report's in-flight span), what it last
+    completed, and — from the control-plane heartbeat — the last fence
+    every peer agrees it reached."""
+    _host(tmp_path, 'host_0', device_means=(0.1,))
+    _host(tmp_path, 'host_1', device_means=(0.1,),
+          hang={'reason': 'fence-deadline: epoch-fence incomplete '
+                          'after 30.0s',
+                'in_flight': {'phase': 'fence', 'name': 'epoch-fence'},
+                'last_completed': {'phase': 'step', 'name': 11,
+                                   'duration_s': 0.4},
+                'stalled_for_s': 31.0})
+    cdir = os.path.join(str(tmp_path), 'control')
+    os.makedirs(cdir)
+    with open(os.path.join(cdir, 'host_1.json'), 'w') as f:
+        json.dump({'host': 1, 'time': 123.0, 'phase': 'epoch',
+                   'step': 12,
+                   'last_fence': {'phase': 'epoch-fence', 'step': 10,
+                                  'time': 120.0}}, f)
+    s = agg_mod.aggregate(str(tmp_path))
+    assert s['hung_hosts'] == ['host_1']
+    att = s['hang_attribution']['host_1']
+    assert att['reason'].startswith('fence-deadline')
+    assert att['in_flight'] == {'phase': 'fence', 'name': 'epoch-fence'}
+    assert att['last_completed']['name'] == 11
+    assert att['last_fence'] == {'phase': 'epoch-fence', 'step': 10,
+                                 'time': 120.0}
+    assert att['last_heartbeat']['step'] == 12
+    text = agg_mod.render(s)
+    assert 'stuck in fence:epoch-fence' in text
+    assert 'last fence epoch-fence@10' in text
+
+
 def test_non_coordinator_hang_reaches_root_summary_and_diff(tmp_path):
     """A hang on host_2 with a clean host_0 must surface as the ROOT
     run's hang (and therefore fail the diff's hung-candidate gate) —

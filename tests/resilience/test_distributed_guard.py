@@ -1,0 +1,268 @@
+"""The distributed control plane in isolation, jax-free on both sides:
+heartbeat channels (peer-death staleness, tombstones, stragglers), the
+host-0 recovery ledger (leadership, follower wait), and the fence guard
+(deadline miss → hang_report naming the missing host/phase; clean exit
+→ no report; exit path → FENCE_TIMEOUT_RC in a subprocess).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from dgmc_tpu.resilience.distributed_guard import (
+    FENCE_TIMEOUT_RC, FenceGuard, HostChannel, LedgerError,
+    RecoveryLedger, control_dir, control_root, read_heartbeats,
+    read_tombstones, write_tombstone)
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+# -- HostChannel -----------------------------------------------------------
+
+def test_channel_beat_and_peer_roundtrip(tmp_path):
+    obs = str(tmp_path / 'obs')
+    a = HostChannel(obs, host_index=0, num_hosts=2)
+    b = HostChannel(obs, host_index=1, num_hosts=2)
+    a.beat('epoch', step=3)
+    b.beat('epoch', step=2)
+    peers = a.peers()
+    assert sorted(peers) == [0, 1]
+    assert peers[0]['phase'] == 'epoch' and peers[0]['step'] == 3
+    assert peers[1]['step'] == 2
+    assert peers[1]['mesh'] == {'hosts': 2}
+    assert peers[1]['pid'] == os.getpid()
+
+
+def test_channel_record_fence_lands_in_heartbeat(tmp_path):
+    obs = str(tmp_path / 'obs')
+    a = HostChannel(obs, host_index=0)
+    a.record_fence('epoch-fence', 5)
+    rec = a.peers()[0]
+    assert rec['last_fence']['phase'] == 'epoch-fence'
+    assert rec['last_fence']['step'] == 5
+    assert rec['step'] == 5
+
+
+def test_dead_peer_by_staleness_and_tombstone(tmp_path):
+    obs = str(tmp_path / 'obs')
+    a = HostChannel(obs, host_index=0, num_hosts=3)
+    b = HostChannel(obs, host_index=1, num_hosts=3)
+    a.beat('epoch', 1)
+    b.beat('epoch', 1)
+    # Nobody is stale yet.
+    assert a.dead_peers(stale_s=30.0) == {}
+    # Host 1's heartbeat is old news from the future's point of view.
+    dead = a.dead_peers(stale_s=0.5, now=time.time() + 10)
+    assert 1 in dead and dead[1]['stale_s'] > 0.5
+    # A host that never wrote (host 2) is absent, NOT dead.
+    assert 2 not in dead
+    # Tombstones are definitive, no staleness argument needed.
+    write_tombstone(a.dir, 2, step=4)
+    dead = a.dead_peers(stale_s=30.0)
+    assert 2 in dead and dead[2]['step'] == 4
+    assert read_tombstones(a.dir)[2]['reason'] == 'peer-death'
+
+
+def test_straggler_detection(tmp_path):
+    obs = str(tmp_path / 'obs')
+    a = HostChannel(obs, host_index=0)
+    b = HostChannel(obs, host_index=1)
+    a.beat('epoch', step=10)
+    b.beat('epoch', step=7)
+    lag = a.stragglers(behind_steps=2)
+    assert list(lag) == [1] and lag[1]['behind'] == 3
+    # Within the allowance: no straggler.
+    b.beat('epoch', step=9)
+    assert a.stragglers(behind_steps=2) == {}
+    # A single host can't lag itself.
+    solo = HostChannel(str(tmp_path / 'solo'), host_index=0)
+    solo.beat('epoch', 1)
+    assert solo.stragglers() == {}
+
+
+def test_refresher_thread_keeps_heartbeat_fresh_until_close(tmp_path):
+    obs = str(tmp_path / 'obs')
+    ch = HostChannel(obs, host_index=0, interval_s=0.05)
+    with ch:
+        ch.beat('epoch', 1)
+        t0 = ch.peers()[0]['time']
+        time.sleep(0.3)
+        assert ch.peers()[0]['time'] > t0  # refreshed without a beat
+    t1 = ch.peers()[0]['time']
+    time.sleep(0.2)
+    assert ch.peers()[0]['time'] == t1    # closed: goes stale
+
+
+def test_coord_partition_suppresses_writes(tmp_path):
+    """Once the coord-partition fault fires, the host stops writing —
+    it LOOKS dead to its peers while still running."""
+    from dgmc_tpu.resilience.faults import FaultPlan
+    obs = str(tmp_path / 'obs')
+    plan = FaultPlan(['coord-partition@2'])
+    ch = HostChannel(obs, host_index=0, fault_plan=plan)
+    ch.beat('epoch', 1)
+    t0 = ch.peers()[0]['time']
+    plan.before_step(2)
+    assert plan.coord_partitioned
+    ch.beat('epoch', 2)
+    rec = ch.peers()[0]
+    assert rec['time'] == t0 and rec['step'] == 1  # write suppressed
+
+
+def test_control_root_strips_attempt_suffix(tmp_path):
+    root = str(tmp_path / 'obs')
+    assert control_root(root) == control_dir(root)
+    assert control_root(os.path.join(root, 'attempt_3')) == \
+        control_dir(root)
+
+
+def test_read_heartbeats_ignores_junk(tmp_path):
+    cdir = tmp_path / 'control'
+    os.makedirs(cdir)
+    (cdir / 'host_0.json').write_text('{"host": 0, "time": 1}')
+    (cdir / 'host_x.json').write_text('{}')          # non-numeric
+    (cdir / 'host_1.json').write_text('{not json')   # torn write
+    (cdir / 'ledger.json').write_text('{}')          # not a heartbeat
+    assert list(read_heartbeats(str(cdir))) == [0]
+
+
+# -- RecoveryLedger --------------------------------------------------------
+
+def test_ledger_leader_decides_followers_read(tmp_path):
+    cdir = str(tmp_path / 'control')
+    os.makedirs(cdir)
+    leader = RecoveryLedger(cdir, host_index=0)
+    follower = RecoveryLedger(cdir, host_index=1)
+    assert leader.is_leader and not follower.is_leader
+    assert follower.read()['attempt'] is None
+
+    leader.decide(1, 'peer-death:host_1', mesh={'shards': 4},
+                  dead_hosts=[1], detail='--model_shards 8 -> 4')
+    got = follower.read()
+    assert got['attempt'] == 1
+    assert got['mesh'] == {'shards': 4}
+    assert got['decisions'][0]['dead_hosts'] == [1]
+
+    with pytest.raises(LedgerError):
+        follower.decide(2, 'nope')
+
+
+def test_ledger_follower_wait_for_attempt(tmp_path):
+    cdir = str(tmp_path / 'control')
+    os.makedirs(cdir)
+    leader = RecoveryLedger(cdir, host_index=0)
+    follower = RecoveryLedger(cdir, host_index=1)
+    assert follower.wait_for_attempt(1, timeout_s=0.2, poll_s=0.05) \
+        is None
+    t = threading.Timer(0.15, lambda: leader.decide(1, 'hang-report',
+                                                    mesh={'shards': 2}))
+    t.start()
+    try:
+        got = follower.wait_for_attempt(1, timeout_s=5.0, poll_s=0.02)
+    finally:
+        t.join()
+    assert got is not None and got['mesh'] == {'shards': 2}
+
+
+def test_ledger_decisions_accumulate(tmp_path):
+    cdir = str(tmp_path / 'control')
+    os.makedirs(cdir)
+    led = RecoveryLedger(cdir, host_index=0)
+    led.decide(1, 'exit:3')
+    led.decide(2, 'peer-death:host_2', mesh={'shards': 2})
+    got = led.read()
+    assert got['attempt'] == 2
+    assert [d['reason'] for d in got['decisions']] == \
+        ['exit:3', 'peer-death:host_2']
+
+
+# -- FenceGuard ------------------------------------------------------------
+
+def test_fence_guard_clean_exit_writes_nothing(tmp_path):
+    report = str(tmp_path / 'hang_report.json')
+    with FenceGuard(report, deadline_s=5.0, phase='epoch-fence',
+                    step=1, on_timeout='report') as g:
+        pass
+    time.sleep(0.1)
+    assert not g.fired and not os.path.exists(report)
+
+
+def test_fence_guard_deadline_names_missing_hosts(tmp_path):
+    obs = str(tmp_path / 'obs')
+    report = str(tmp_path / 'hang_report.json')
+    me = HostChannel(obs, host_index=0, num_hosts=3)
+    peer = HostChannel(obs, host_index=1, num_hosts=3)
+    me.record_fence('epoch-fence', 4)
+    peer.record_fence('epoch-fence', 3)   # one fence behind
+    write_tombstone(me.dir, 2, step=2)    # and one dead outright
+    with FenceGuard(report, deadline_s=0.1, phase='epoch-fence', step=4,
+                    channel=me, on_timeout='report') as g:
+        time.sleep(0.5)                   # the "wedged collective"
+    assert g.fired
+    rep = json.load(open(report))
+    assert rep['reason'].startswith('fence-deadline')
+    assert rep['fence'] == {'phase': 'epoch-fence', 'step': 4}
+    missing = {m['host']: m for m in rep['missing_hosts']}
+    assert 1 in missing                    # behind this fence
+    assert missing[1]['last_fence']['step'] == 3
+    assert missing[2].get('dead') is True  # tombstoned
+    assert rep['threads']                  # stacks for the post-mortem
+
+
+def test_fence_guard_peer_that_reached_fence_is_not_missing(tmp_path):
+    obs = str(tmp_path / 'obs')
+    report = str(tmp_path / 'hang_report.json')
+    me = HostChannel(obs, host_index=0, num_hosts=2)
+    peer = HostChannel(obs, host_index=1, num_hosts=2)
+    peer.record_fence('epoch-fence', 4)   # arrived (same fence)
+    with FenceGuard(report, deadline_s=0.1, phase='epoch-fence', step=4,
+                    channel=me, on_timeout='report') as g:
+        time.sleep(0.4)
+    assert g.fired
+    rep = json.load(open(report))
+    assert rep['missing_hosts'] == []
+
+
+def test_fence_guard_completed_flag_beats_late_timer(tmp_path):
+    """Timer.cancel() is a no-op once the callback has started: a fence
+    completing right at the deadline must not be reported dead (and
+    must not os._exit a healthy run). The completed flag set by
+    __exit__ wins the race."""
+    report = str(tmp_path / 'hang_report.json')
+    g = FenceGuard(report, deadline_s=60.0, phase='epoch-fence', step=1,
+                   on_timeout='exit')   # exit mode: a bug here would
+    with g:                             # kill pytest, loudly
+        pass
+    g._fire()                           # the "timer fired anyway" race
+    assert not g.fired
+    assert not os.path.exists(report)
+
+
+def test_fence_guard_rejects_unknown_on_timeout(tmp_path):
+    with pytest.raises(ValueError):
+        FenceGuard('r.json', 1.0, phase='x', on_timeout='explode')
+
+
+def test_fence_guard_exit_path_rc(tmp_path):
+    """The production mode: a missed fence deadline EXITS with the
+    documented rc (attributable death, not an rc:124 hang). Needs a
+    subprocess — os._exit would take pytest down with it."""
+    report = str(tmp_path / 'hang_report.json')
+    code = f'''
+import time
+from dgmc_tpu.resilience.distributed_guard import FenceGuard
+with FenceGuard({report!r}, deadline_s=0.1, phase='epoch-fence',
+                step=7):
+    time.sleep(30)
+'''
+    proc = subprocess.run([sys.executable, '-c', code], cwd=REPO,
+                          timeout=120, capture_output=True)
+    assert proc.returncode == FENCE_TIMEOUT_RC, proc.stderr[-2000:]
+    rep = json.load(open(report))
+    assert rep['fence']['step'] == 7

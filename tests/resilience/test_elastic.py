@@ -1,0 +1,468 @@
+"""Elastic recovery: distributed failures shrink the mesh.
+
+Fast half (jax-free): scriptable toy children + hand-written control
+files drive the supervisor's distributed-failure classification, the
+elastic shrink, the ledger decision, and the 2-process simulated-host
+peer-death path.
+
+Slow half (real dbp15k CLI, synthetic data): ``peer-death@N`` under
+``--supervise`` recovers on a shrunk mesh from a RESHARDED checkpoint
+and reaches exact final-state parity with an uninterrupted shrunk-mesh
+run; ``collective-stall@N`` under ``--fence-deadline`` exits
+``FENCE_TIMEOUT_RC`` with a ``hang_report.json`` attributing the fence
+— instead of the historical rc:124 silence.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from dgmc_tpu.resilience.distributed_guard import FENCE_TIMEOUT_RC
+from dgmc_tpu.resilience.supervisor import Supervisor, _flag_value
+
+from tests.resilience.test_supervisor import _evidence, _supervise
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _ledger(obs):
+    return json.load(open(obs / 'control' / 'ledger.json'))
+
+
+# -- fast: classification + shrink + ledger --------------------------------
+
+def test_hang_triggers_elastic_shrink(tmp_path):
+    """A stale-heartbeat hang is a DISTRIBUTED failure: the mesh flag
+    is halved immediately (no same-step ladder wait), the event lands
+    in recovery.json, and the leader publishes the decision."""
+    rc, rec, obs = _supervise(
+        tmp_path, [{'action': 'hang'}, {'action': 'ok'}],
+        argv=['--model_shards', '4'], hang_deadline_s=0.3)
+    assert rc == 0
+    assert rec['outcome'] == 'completed'
+    assert rec['attempts'][0]['reason'] == 'heartbeat-stale'
+    assert [e['detail'] for e in rec['elastic']] == \
+        ['--model_shards 4 -> 2 (shrink the mesh)']
+    assert _flag_value(_evidence(obs, 1)['argv'],
+                       ('--model_shards',)) == '2'
+    led = _ledger(obs)
+    assert led['attempt'] == 1 and led['mesh'] == {'shards': 2}
+    assert led['decisions'][0]['reason'] == 'heartbeat-stale'
+
+
+def test_row_shards_spelling_shrinks_too(tmp_path):
+    rc, rec, obs = _supervise(
+        tmp_path, [{'action': 'hang'}, {'action': 'ok'}],
+        argv=['--row_shards', '8'], hang_deadline_s=0.3)
+    assert rc == 0
+    assert rec['elastic'][0]['detail'] == \
+        '--row_shards 8 -> 4 (shrink the mesh)'
+    assert _flag_value(_evidence(obs, 1)['argv'],
+                       ('--row_shards',)) == '4'
+
+
+def test_fence_timeout_rc_is_distributed(tmp_path):
+    """A child that exited FENCE_TIMEOUT_RC (its fence guard fired) is
+    classified as a distributed failure and shrinks the mesh."""
+    rc, rec, obs = _supervise(
+        tmp_path,
+        [{'action': 'crash', 'rc': FENCE_TIMEOUT_RC}, {'action': 'ok'}],
+        argv=['--model_shards', '2'])
+    assert rc == 0
+    assert rec['attempts'][0]['reason'] == f'exit:{FENCE_TIMEOUT_RC}'
+    assert rec['elastic'][0]['detail'] == \
+        '--model_shards 2 -> 1 (shrink the mesh)'
+
+
+def test_plain_crash_does_not_shrink(tmp_path):
+    """An ordinary crash retries on the SAME mesh: elastic restarts are
+    reserved for failures that mean the mesh itself broke."""
+    rc, rec, obs = _supervise(
+        tmp_path, [{'action': 'crash'}, {'action': 'ok'}],
+        argv=['--model_shards', '4'])
+    assert rc == 0
+    assert rec['elastic'] == []
+    assert _flag_value(_evidence(obs, 1)['argv'],
+                       ('--model_shards',)) == '4'
+
+
+def test_no_elastic_opt_out(tmp_path):
+    rc, rec, _obs = _supervise(
+        tmp_path, [{'action': 'hang'}, {'action': 'ok'}],
+        argv=['--model_shards', '4'], hang_deadline_s=0.3,
+        elastic=False)
+    assert rc == 0
+    assert rec['elastic'] == []
+
+
+def test_unshrinkable_mesh_falls_through_to_retry(tmp_path):
+    """No mesh flag (or already 1 shard): a distributed failure still
+    just restarts — there is nothing to shrink."""
+    rc, rec, _obs = _supervise(
+        tmp_path, [{'action': 'hang'}, {'action': 'ok'}],
+        argv=['--model_shards', '1'], hang_deadline_s=0.3)
+    assert rc == 0
+    assert rec['outcome'] == 'completed'
+    assert rec['elastic'] == []
+
+
+def test_peer_death_tombstone_reclassifies_sigkill(tmp_path):
+    """The injected peer-death fault SIGKILLs right after writing its
+    tombstone; the supervisor must read the tombstone post-mortem and
+    classify the death as a peer's, not the run's."""
+    # A dedicated toy: beat as host 0, tombstone host 1, die by SIGKILL.
+    child = tmp_path / 'child.py'
+    child.write_text(r'''
+import json, os, signal, sys, time
+argv = sys.argv[1:]
+obs_dir = argv[argv.index('--obs-dir') + 1]
+k_path = os.path.join(os.path.dirname(obs_dir.rstrip('/')), 'k.json')
+k = 0
+if os.path.exists(k_path):
+    k = json.load(open(k_path))['k'] + 1
+json.dump({'k': k}, open(k_path, 'w'))
+os.makedirs(obs_dir, exist_ok=True)
+json.dump({'argv': argv}, open(os.path.join(obs_dir, 'evidence.json'),
+                               'w'))
+cdir = os.path.join(obs_dir, 'control')
+os.makedirs(cdir, exist_ok=True)
+json.dump({'host': 0, 'pid': os.getpid(), 'time': time.time(),
+           'phase': 'step', 'step': 3},
+          open(os.path.join(cdir, 'host_0.json'), 'w'))
+if k == 0:
+    json.dump({'host': 1, 'time': time.time(), 'step': 3,
+               'reason': 'peer-death'},
+              open(os.path.join(cdir, 'host_1.tombstone.json'), 'w'))
+    os.kill(os.getpid(), signal.SIGKILL)
+sys.exit(0)
+''')
+    obs = tmp_path / 'obs'
+    sup = Supervisor([sys.executable, str(child)],
+                     ['--obs-dir', str(obs), '--model_shards', '8'],
+                     obs_dir=str(obs), backoff_s=0.05, poll_s=0.05,
+                     grace_s=2.0)
+    rc = sup.run()
+    rec = json.load(open(obs / 'recovery.json'))
+    assert rc == 0
+    # Two valid classification orders: the live poll can spot the
+    # tombstone before the child's exit is reaped ('peer-death:host_1')
+    # or the post-mortem check reclassifies the SIGKILL
+    # ('peer-death:host_1 (signal:SIGKILL)') — both are peer deaths.
+    assert rec['attempts'][0]['reason'].startswith('peer-death:host_1')
+    assert rec['elastic'][0]['detail'] == \
+        '--model_shards 8 -> 4 (shrink the mesh)'
+    assert _flag_value(_evidence(obs, 1)['argv'],
+                       ('--model_shards',)) == '4'
+
+
+def test_two_process_simulated_hosts_peer_death(tmp_path):
+    """The 2-host simulation: the supervised child is host 0 (beating
+    its control heartbeat); an INDEPENDENT host-1 process beats for a
+    while and dies. Host 0's supervisor must detect the stale peer,
+    kill its own (soon-to-wedge) child, shrink the mesh, and the
+    restarted child completes on the smaller mesh."""
+    child = tmp_path / 'child.py'
+    child.write_text(r'''
+import json, os, sys, time
+argv = sys.argv[1:]
+obs_dir = argv[argv.index('--obs-dir') + 1]
+k_path = os.path.join(os.path.dirname(obs_dir.rstrip('/')), 'k.json')
+k = 0
+if os.path.exists(k_path):
+    k = json.load(open(k_path))['k'] + 1
+json.dump({'k': k}, open(k_path, 'w'))
+os.makedirs(obs_dir, exist_ok=True)
+json.dump({'argv': argv}, open(os.path.join(obs_dir, 'evidence.json'),
+                               'w'))
+cdir = os.path.join(obs_dir, 'control')
+os.makedirs(cdir, exist_ok=True)
+
+def beat(step):
+    p = os.path.join(cdir, 'host_0.json')
+    json.dump({'host': 0, 'pid': os.getpid(), 'time': time.time(),
+               'phase': 'step', 'step': step}, open(p + '.tmp', 'w'))
+    os.replace(p + '.tmp', p)
+
+if k == 0:
+    open(os.path.join(obs_dir, 'ready'), 'w').close()
+    for step in range(1, 10000):   # runs until the supervisor kills us
+        beat(step)
+        time.sleep(0.05)
+beat(1)
+sys.exit(0)
+''')
+    host1 = tmp_path / 'host1.py'
+    host1.write_text(r'''
+import json, os, sys, time
+cdir, beats = sys.argv[1], int(sys.argv[2])
+os.makedirs(cdir, exist_ok=True)
+for step in range(1, beats + 1):
+    p = os.path.join(cdir, 'host_1.json')
+    json.dump({'host': 1, 'pid': os.getpid(), 'time': time.time(),
+               'phase': 'step', 'step': step}, open(p + '.tmp', 'w'))
+    os.replace(p + '.tmp', p)
+    time.sleep(0.05)
+# ...and dies here, mid-"epoch": the heartbeat goes stale.
+''')
+    obs = tmp_path / 'obs'
+    sup = Supervisor([sys.executable, str(child)],
+                     ['--obs-dir', str(obs), '--model_shards', '2'],
+                     obs_dir=str(obs), backoff_s=0.05, poll_s=0.05,
+                     grace_s=2.0, peer_stale_s=0.6)
+    result = {}
+
+    def run():
+        result['rc'] = sup.run()
+
+    t = threading.Thread(target=run)
+    t.start()
+    try:
+        # Wait for attempt 0's child to be up and beating...
+        deadline = time.time() + 30
+        while time.time() < deadline and not (
+                obs / 'attempt_0' / 'ready').exists():
+            time.sleep(0.02)
+        assert (obs / 'attempt_0' / 'ready').exists()
+        # ...then run host 1 beside it for ~0.5 s, after which it dies.
+        subprocess.run(
+            [sys.executable, str(host1),
+             str(obs / 'attempt_0' / 'control'), '10'],
+            timeout=60, check=True)
+    finally:
+        t.join(timeout=120)
+    assert not t.is_alive()
+    assert result['rc'] == 0
+    rec = json.load(open(obs / 'recovery.json'))
+    assert rec['outcome'] == 'completed'
+    assert rec['attempts'][0]['reason'] == 'peer-death:host_1'
+    assert rec['elastic'][0]['detail'] == \
+        '--model_shards 2 -> 1 (shrink the mesh)'
+    led = _ledger(obs)
+    assert led['mesh'] == {'shards': 1}
+    assert _flag_value(_evidence(obs, 1)['argv'],
+                       ('--model_shards',)) == '1'
+
+
+def test_no_first_heartbeat_does_not_shrink(tmp_path):
+    """A child killed before its first heartbeat may just have been
+    compiling slowly — permanently halving a healthy mesh for that is
+    the worse error, so no-first-heartbeat restarts on the SAME mesh
+    (the distributed-init wedge gets its crisp signal from the fence
+    guard's rc instead)."""
+    rc, rec, obs = _supervise(
+        tmp_path, [{'action': 'wedge-early'}, {'action': 'ok'}],
+        argv=['--model_shards', '4'], hang_deadline_s=0.3,
+        first_heartbeat_s=1.0)
+    assert rc == 0
+    assert rec['attempts'][0]['reason'] == 'no-first-heartbeat'
+    assert rec['elastic'] == []
+    assert _flag_value(_evidence(obs, 1)['argv'],
+                       ('--model_shards',)) == '4'
+
+
+def test_own_child_staleness_is_not_peer_death(tmp_path):
+    """This host's own control heartbeat going stale (a delayed write,
+    an overloaded child) is the watchdog layer's business — it must not
+    read as a dead PEER and shrink a healthy mesh."""
+    sup = Supervisor(['true'], [], obs_dir=str(tmp_path / 'obs'),
+                     host_index=0, peer_stale_s=0.5)
+    cdir = str(tmp_path / 'cdir')
+    os.makedirs(cdir)
+    now = time.time()
+    with open(os.path.join(cdir, 'host_0.json'), 'w') as f:
+        json.dump({'host': 0, 'time': now - 60}, f)   # self: very stale
+    with open(os.path.join(cdir, 'host_1.json'), 'w') as f:
+        json.dump({'host': 1, 'time': now}, f)        # peer: fresh
+    assert sup._dead_peer(cdir) is None
+    # The symmetric case — the PEER stale, self fresh — still detects.
+    with open(os.path.join(cdir, 'host_0.json'), 'w') as f:
+        json.dump({'host': 0, 'time': now}, f)
+    with open(os.path.join(cdir, 'host_1.json'), 'w') as f:
+        json.dump({'host': 1, 'time': now - 60}, f)
+    assert sup._dead_peer(cdir) == 'host_1'
+
+
+def test_clear_control_dir_spares_current_session_files(tmp_path):
+    """On a shared obs filesystem a faster host's child may have
+    written THIS attempt's control files before this supervisor reaches
+    the attempt: only files predating the supervisor session (a reused
+    obs dir) are cleared."""
+    sup = Supervisor(['true'], [], obs_dir=str(tmp_path / 'obs'))
+    cdir = tmp_path / 'cdir'
+    os.makedirs(cdir)
+    old = cdir / 'host_1.json'
+    old.write_text('{"host": 1, "time": 1}')
+    os.utime(old, (time.time() - 3600, time.time() - 3600))
+    fresh = cdir / 'host_0.tombstone.json'
+    fresh.write_text('{"host": 0, "time": 1}')   # mtime = now
+    sup._clear_control_dir(str(cdir))
+    assert not old.exists()
+    assert fresh.exists()
+
+
+def test_follower_adopts_leader_mesh_decision(tmp_path):
+    """A follower supervisor (host_index > 0) must restart on the
+    LEADER's decided mesh size, not its own guess — two hosts rejoining
+    with different --model_shards would wedge the first collective."""
+    from dgmc_tpu.resilience.distributed_guard import (RecoveryLedger,
+                                                       control_dir)
+    obs = tmp_path / 'obs'
+    # The leader (running elsewhere) has already decided attempt 1.
+    os.makedirs(control_dir(str(obs)))
+    RecoveryLedger(control_dir(str(obs)), host_index=0).decide(
+        1, 'peer-death:host_2', mesh={'shards': 2})
+    rc, rec, obs_dir = _supervise(
+        tmp_path, [{'action': 'crash'}, {'action': 'ok'}],
+        argv=['--model_shards', '8'], host_index=1, elastic=False)
+    assert rc == 0
+    assert any(e['event'] == 'ledger-adopt' for e in rec['events'])
+    assert _flag_value(_evidence(obs_dir, 1)['argv'],
+                       ('--model_shards',)) == '2'
+
+
+# -- slow: the real CLI ----------------------------------------------------
+
+#: ckpt_every 2 + the kill at epoch 4 is deliberate: checkpoint saves
+#: are ASYNC, so a fault adjacent to a save races its commit (a torn
+#: latest step makes the restart resume one step earlier — correct
+#: behavior, but a different epoch→mesh schedule than the control run).
+#: Killing two epochs after the last save keeps the resume point
+#: deterministic, which is what makes the parity assertion EXACT.
+SYN = ['--synthetic', '--syn_nodes_s', '48', '--syn_nodes_t', '64',
+       '--syn_edges_s', '160', '--syn_edges_t', '224', '--syn_dim', '16',
+       '--dim', '16', '--rnd_dim', '8', '--num_layers', '1',
+       '--num_steps', '2', '--k', '5', '--phase1_epochs', '2',
+       '--ckpt_every', '2', '--seed', '11']
+
+
+def _run_cli(tmp_path, tag, extra, timeout=900, expect_rc=0):
+    env = dict(os.environ, JAX_PLATFORMS='cpu',
+               JAX_ENABLE_COMPILATION_CACHE='false')
+    log = tmp_path / f'{tag}.log'
+    with open(log, 'w') as fh:
+        proc = subprocess.run(
+            [sys.executable, '-m', 'dgmc_tpu.experiments.dbp15k']
+            + SYN + extra,
+            cwd=REPO, env=env, stdout=fh, stderr=subprocess.STDOUT,
+            timeout=timeout)
+    out = log.read_text()
+    assert proc.returncode == expect_rc, (tag, proc.returncode,
+                                          out[-3000:])
+    return out
+
+
+def _final_leaves(ckpt_dir):
+    import numpy as np
+    import orbax.checkpoint as ocp
+    import jax
+    mgr = ocp.CheckpointManager(str(ckpt_dir))
+    step = mgr.latest_step()
+    tree = mgr.restore(step, args=ocp.args.StandardRestore())
+    mgr.close()
+    return step, [np.asarray(x) for x in jax.tree.leaves(tree)]
+
+
+@pytest.mark.slow
+def test_peer_death_elastic_recovery_parity(tmp_path):
+    """The acceptance criterion: peer-death@4 on the 8-shard mesh under
+    --supervise → elastic shrink to 4 shards → resume from the epoch-2
+    checkpoint RESHARDED onto the smaller mesh → final state exactly
+    equal to an uninterrupted run that switched to the 4-shard mesh at
+    the same epoch (same epochs on same meshes, same PRNG stream —
+    determinism is positional, so parity is exact)."""
+    import numpy as np
+    ck_control = tmp_path / 'ck_control'
+    # Control leg 1: epochs 1-2 on the 8-shard mesh (what the chaos run
+    # durably completed before the peer died — the epoch-3 work it did
+    # on the 8-shard mesh is discarded with the unreached checkpoint).
+    _run_cli(tmp_path, 'control8',
+             ['--epochs', '2', '--model_shards', '8',
+              '--ckpt_dir', str(ck_control)])
+    # Control leg 2: the uninterrupted shrunk-mesh run — resumes the
+    # 8-shard checkpoint on the 4-shard mesh (itself exercising the
+    # resharded restore) and runs epochs 3-6 without incident.
+    _run_cli(tmp_path, 'control4',
+             ['--epochs', '6', '--model_shards', '4',
+              '--ckpt_dir', str(ck_control)])
+
+    ck_chaos = tmp_path / 'ck_chaos'
+    obs = tmp_path / 'obs'
+    out = _run_cli(tmp_path, 'chaos',
+                   ['--epochs', '6', '--model_shards', '8',
+                    '--ckpt_dir', str(ck_chaos),
+                    '--obs-dir', str(obs),
+                    '--inject-fault', 'peer-death@4',
+                    '--supervise', '--max-restarts', '3',
+                    '--restart-backoff', '0.1'])
+    assert 'firing peer-death@4' in out
+    assert 'elastic-shrink' in out
+    # The resume point must be the committed epoch-2 checkpoint (see
+    # the SYN comment) or the parity below compares different mesh
+    # schedules.
+    assert 'at epoch 2.' in out
+
+    rec = json.load(open(obs / 'recovery.json'))
+    assert rec['outcome'] == 'completed'
+    assert rec['restarts'] == 1
+    assert rec['attempts'][0]['reason'].startswith('peer-death:host_0')
+    assert rec['elastic'][0]['detail'] == \
+        '--model_shards 8 -> 4 (shrink the mesh)'
+    led = json.load(open(obs / 'control' / 'ledger.json'))
+    assert led['mesh'] == {'shards': 4}
+
+    step_a, leaves_a = _final_leaves(ck_control)
+    step_b, leaves_b = _final_leaves(ck_chaos)
+    assert step_a == step_b == 6
+    assert len(leaves_a) == len(leaves_b)
+    for x, y in zip(leaves_a, leaves_b):
+        np.testing.assert_array_equal(x, y)
+
+    # The elastic event renders through obs.report and GATES through
+    # obs.diff: a candidate that shrank vs a baseline that did not is a
+    # regression (scaling numbers changed out from under the metrics).
+    rep = subprocess.run(
+        [sys.executable, '-m', 'dgmc_tpu.obs.report', str(obs)],
+        cwd=REPO, capture_output=True, text=True,
+        env=dict(os.environ, JAX_PLATFORMS='cpu'), timeout=120)
+    assert rep.returncode == 0, rep.stderr[-2000:]
+    assert 'elastic shrink' in rep.stdout
+
+
+@pytest.mark.slow
+def test_fence_deadline_converts_stall_into_forensics(tmp_path):
+    """collective-stall@2 inside the epoch fence, --fence-deadline 3:
+    instead of hanging to rc:124, the run exits FENCE_TIMEOUT_RC with a
+    hang_report.json naming the fence phase/step, and obs.aggregate
+    attributes the hung host to its last completed fence/phase."""
+    obs = tmp_path / 'obs'
+    out = _run_cli(
+        tmp_path, 'stall',
+        ['--epochs', '3', '--phase1_epochs', '1', '--model_shards', '8',
+         '--obs-dir', str(obs), '--fence-deadline', '3',
+         '--inject-fault', 'collective-stall@2:60'],
+        expect_rc=FENCE_TIMEOUT_RC)
+    assert 'firing collective-stall@2 inside the step-2 fence' in out
+    rep = json.load(open(obs / 'hang_report.json'))
+    assert rep['reason'].startswith('fence-deadline')
+    assert rep['fence'] == {'phase': 'epoch-fence', 'step': 2}
+
+    agg = subprocess.run(
+        [sys.executable, '-m', 'dgmc_tpu.obs.aggregate', str(obs),
+         '--json'],
+        cwd=REPO, capture_output=True, text=True,
+        env=dict(os.environ, JAX_PLATFORMS='cpu'), timeout=120)
+    assert agg.returncode == 0, agg.stderr[-2000:]
+    summary = json.loads(agg.stdout)
+    assert summary['hung_hosts'] == ['host_0']
+    att = summary['hang_attribution']['host_0']
+    assert att['reason'].startswith('fence-deadline')
+    assert att['in_flight'] == {'phase': 'fence', 'name': 'epoch-fence'}
+    # The control-plane heartbeat pins the last thing this host was
+    # doing (the epoch it entered before wedging in the fence).
+    assert att['last_heartbeat']['step'] == 2

@@ -29,6 +29,13 @@ from dgmc_tpu.resilience.faults import (FaultInjected, FaultPlan,
     ('ckpt-corrupt@2', 'ckpt-corrupt', 2, None),
     ('download-fail', 'download-fail', None, 1),
     ('download-fail:3', 'download-fail', None, 3),
+    ('peer-death@4', 'peer-death', 4, None),
+    ('peer-death@4:1', 'peer-death', 4, 1),
+    ('straggler@2:250', 'straggler', 2, 250.0),
+    ('straggler@2', 'straggler', 2, 1000.0),
+    ('coord-partition@5', 'coord-partition', 5, None),
+    ('collective-stall@3', 'collective-stall', 3, 3600.0),
+    ('collective-stall@3:7.5', 'collective-stall', 3, 7.5),
 ])
 def test_parse_spec(text, kind, step, arg):
     spec = parse_spec(text)
@@ -41,6 +48,8 @@ def test_parse_spec(text, kind, step, arg):
     'sigkill',            # step required
     'download-fail@3',    # takes a count, not a step
     'raise@x',            # non-integer step
+    'peer-death',         # step required
+    'collective-stall',   # step required
 ])
 def test_parse_spec_rejects(bad):
     with pytest.raises(ValueError):
@@ -108,6 +117,77 @@ def test_nan_grads_not_ledgered(tmp_path):
     for step in range(1, 10):
         plan.before_step(step)  # never raises, never writes the ledger
     assert not os.path.exists(tmp_path / faults.FIRED_LEDGER)
+
+
+# -- distributed kinds -----------------------------------------------------
+
+def test_straggler_sleeps_every_step_from_n(monkeypatch):
+    """straggler is a CONDITION: it re-fires on every step >= N
+    (including supervised replays) and never enters the ledger."""
+    naps = []
+    monkeypatch.setattr(faults.time, 'sleep', naps.append)
+    plan = FaultPlan(['straggler@3:250'], state_dir=None)
+    for step in range(1, 6):
+        plan.before_step(step)
+    assert naps == [0.25, 0.25, 0.25]   # steps 3, 4, 5
+
+
+def test_peer_death_writes_tombstone_then_kills(tmp_path, monkeypatch):
+    kills = []
+    monkeypatch.setattr(faults.os, 'kill',
+                        lambda pid, sig: kills.append((pid, sig)))
+    monkeypatch.setattr(faults.time, 'sleep', lambda s: None)
+    cdir = str(tmp_path / 'control')
+    plan = FaultPlan(['peer-death@2:1'], state_dir=str(tmp_path),
+                     control_dir=cdir)
+    with pytest.raises(FaultInjected):   # the swallowed-kill backstop
+        plan.before_step(2)
+    import signal
+    assert kills == [(os.getpid(), signal.SIGKILL)]
+    tomb = json.load(open(os.path.join(cdir, 'host_1.tombstone.json')))
+    assert tomb['host'] == 1 and tomb['step'] == 2
+    # The tombstone was written (and the ledger marked) BEFORE the kill.
+    assert 'peer-death@2' in json.load(
+        open(tmp_path / faults.FIRED_LEDGER))['fired']
+
+
+def test_peer_death_defaults_to_own_host_index(tmp_path, monkeypatch):
+    monkeypatch.setattr(faults.os, 'kill', lambda pid, sig: None)
+    monkeypatch.setattr(faults.time, 'sleep', lambda s: None)
+    cdir = str(tmp_path / 'control')
+    plan = FaultPlan(['peer-death@1'], control_dir=cdir, host_index=3)
+    with pytest.raises(FaultInjected):
+        plan.before_step(1)
+    assert os.path.exists(os.path.join(cdir, 'host_3.tombstone.json'))
+
+
+def test_coord_partition_sets_flag_once(tmp_path):
+    plan = FaultPlan(['coord-partition@2'], state_dir=str(tmp_path))
+    plan.before_step(1)
+    assert not plan.coord_partitioned
+    plan.before_step(2)
+    assert plan.coord_partitioned
+    # A restarted process (fresh plan, same ledger) stays healed.
+    replay = FaultPlan(['coord-partition@2'], state_dir=str(tmp_path))
+    replay.before_step(2)
+    assert not replay.coord_partitioned
+
+
+def test_collective_stall_fires_in_fence_once(tmp_path, monkeypatch):
+    naps = []
+    monkeypatch.setattr(faults.time, 'sleep', naps.append)
+    plan = FaultPlan(['collective-stall@3:12'], state_dir=str(tmp_path))
+    plan.before_step(3)      # a step is NOT a fence
+    plan.before_fence(2)     # wrong step
+    assert naps == []
+    plan.before_fence(3)
+    assert naps == [12.0]
+    plan.before_fence(3)     # fire-once
+    assert naps == [12.0]
+    replay = FaultPlan(['collective-stall@3:12'],
+                       state_dir=str(tmp_path))
+    replay.before_fence(3)   # ledgered across restarts
+    assert naps == [12.0]
 
 
 # -- checkpoint damage -----------------------------------------------------

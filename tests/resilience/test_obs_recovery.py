@@ -31,7 +31,8 @@ def _write_attempt(root, k, steps=3, hang=False):
     return d
 
 
-def _write_recovery(root, restarts, outcome='completed', degradations=()):
+def _write_recovery(root, restarts, outcome='completed', degradations=(),
+                    elastic=()):
     os.makedirs(root, exist_ok=True)
     attempts = [
         {'attempt': k, 'reason': 'signal:SIGKILL', 'rc': -9,
@@ -45,6 +46,10 @@ def _write_recovery(root, restarts, outcome='completed', degradations=()):
         json.dump({'outcome': outcome, 'restarts': restarts,
                    'degradations': [{'rung': r, 'attempt': 1,
                                      'detail': r} for r in degradations],
+                   'elastic': [{'attempt': 0,
+                                'reason': 'peer-death:host_1',
+                                'detail': d, 'mesh_after': 4}
+                               for d in elastic],
                    'attempts': attempts, 'events': []}, f)
 
 
@@ -98,6 +103,53 @@ def test_diff_gates_on_extra_restarts(tmp_path, supervised_root):
     rows, _regs = diff_runs(base, cand, thresholds={'restarts': 1})
     row = next(r for r in rows if r['metric'] == 'restarts')
     assert row['status'] == 'ok'
+
+
+def test_diff_gates_on_elastic_shrink(tmp_path):
+    """A candidate whose supervisor shrank the mesh survived on fewer
+    devices than the run asked for — every scaling number changed out
+    from under the metrics, so the diff must fail even when the restart
+    slack would have allowed the restart itself."""
+    base_root = str(tmp_path / 'base')
+    _write_recovery(base_root, restarts=1)
+    _write_attempt(base_root, 0)
+    _write_attempt(base_root, 1)
+    cand_root = str(tmp_path / 'cand')
+    _write_recovery(cand_root, restarts=1,
+                    elastic=['--model_shards 8 -> 4 (shrink the mesh)'])
+    _write_attempt(cand_root, 0)
+    _write_attempt(cand_root, 1)
+    base = summarize(load_run(base_root))
+    cand = summarize(load_run(cand_root))
+
+    rows, regs = diff_runs(base, cand, thresholds={'restarts': 100})
+    row = next(r for r in rows if r['metric'] == 'elastic_shrinks')
+    assert row['status'] == 'REGRESSION' and row in regs
+    assert '--model_shards 8 -> 4' in row['note']
+    # Equal shrink histories (e.g. both runs re-ran the same recovery
+    # scenario): clean.
+    rows, regs = diff_runs(cand, cand, thresholds={'restarts': 100})
+    row = next(r for r in rows if r['metric'] == 'elastic_shrinks')
+    assert row['status'] == 'ok' and not regs
+    # A baseline that shrank against a candidate that did not is the
+    # fix, not a regression.
+    rows, regs = diff_runs(cand, base, thresholds={'restarts': 100})
+    row = next(r for r in rows if r['metric'] == 'elastic_shrinks')
+    assert row['status'] == 'ok' and not regs
+
+
+def test_elastic_events_render_in_report(tmp_path):
+    root = str(tmp_path / 'obs')
+    _write_recovery(root, restarts=1,
+                    elastic=['--row_shards 8 -> 4 (shrink the mesh)'])
+    _write_attempt(root, 0)
+    _write_attempt(root, 1)
+    s = summarize(load_run(root))
+    assert [e['detail'] for e in s['recovery']['elastic']] == \
+        ['--row_shards 8 -> 4 (shrink the mesh)']
+    text = render(load_run(root))
+    assert 'elastic shrink' in text
+    assert '--row_shards 8 -> 4' in text
 
 
 def test_diff_gave_up_fails_unconditionally(tmp_path):

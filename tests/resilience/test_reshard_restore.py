@@ -1,0 +1,198 @@
+"""Restore onto a DIFFERENT mesh than the checkpoint was saved under —
+the elastic mesh-shrink rung's load-bearing half. Pins the two fixed
+failure modes:
+
+- a restore target with no shardings (host numpy state, what
+  ``resume_or_init`` passes) used to make orbax read the sharding
+  recorded in the checkpoint; after a topology shrink that sharding
+  names dead devices and the placement error masqueraded as
+  ``CheckpointCorruptError`` ("every checkpoint failed to restore");
+- a restored-but-single-device-committed state fed to a mesh-
+  constrained step crashed with "incompatible devices" —
+  ``resume_or_init(mesh=...)`` now re-derives target shardings on the
+  CURRENT mesh so the state deserializes directly onto it.
+
+The cross-topology cases (8 devices at save, genuinely only 4 at
+restore) run in subprocesses with their own ``XLA_FLAGS``.
+"""
+
+import os
+import subprocess
+import sys
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from dgmc_tpu.train import Checkpointer, create_train_state, \
+    resume_or_init
+
+from tests.train.test_steps import tiny_loader, tiny_model
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _mesh(n):
+    return Mesh(np.array(jax.devices()[:n]).reshape(n, 1),
+                ('data', 'model'))
+
+
+def _state(seed=0):
+    model = tiny_model()
+    batch = next(iter(tiny_loader()))
+    return create_train_state(model, jax.random.key(seed), batch)
+
+
+def test_host_numpy_target_restores_values(tmp_path):
+    """A shardingless (host numpy) restore target comes back as host
+    numpy — not via the checkpoint's recorded placement."""
+    state = _state()
+    ckpt = Checkpointer(tmp_path / 'ck')
+    ckpt.save(1, state, wait=True)
+    ckpt.close()
+
+    target = jax.tree.map(
+        lambda x: np.asarray(x) if hasattr(x, 'shape') else x, state)
+    ckpt = Checkpointer(tmp_path / 'ck')
+    restored = ckpt.restore(target)
+    assert ckpt.restored_step == 1
+    for got, want in zip(jax.tree.leaves(restored),
+                         jax.tree.leaves(state)):
+        if np.ndim(want):
+            # Non-scalar leaves come back as host numpy, never as
+            # device arrays placed by the checkpoint's recorded
+            # sharding (scalars may deserialize as Python numbers).
+            assert isinstance(got, np.ndarray)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+    ckpt.close()
+
+
+def test_resume_or_init_places_state_on_mesh(tmp_path):
+    """``resume_or_init(mesh=...)`` restores every leaf onto the given
+    mesh (replicated without rules) — including a mesh SMALLER than the
+    checkpoint's: the committed-to-device-0 vs mesh-constraint crash is
+    gone because the state never bounces through one device."""
+    state = _state()
+    mesh8 = _mesh(8)
+    placed = jax.device_put(state, NamedSharding(mesh8, P()))
+    ckpt = Checkpointer(tmp_path / 'ck')
+    ckpt.save(2, placed, wait=True)
+    ckpt.close()
+
+    mesh4 = _mesh(4)
+    ckpt, restored, start = resume_or_init(
+        str(tmp_path / 'ck'), _state(seed=9), mesh=mesh4)
+    assert start == 3
+    for got, want in zip(jax.tree.leaves(restored),
+                         jax.tree.leaves(placed)):
+        if not hasattr(got, 'sharding'):
+            continue
+        assert got.sharding.mesh.devices.size == 4, got.sharding
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+    ckpt.close()
+
+
+def test_resume_or_init_mesh_with_rules(tmp_path):
+    """The partition-rule path: the restore target's shardings come
+    from the declarative config on the CURRENT mesh."""
+    from dgmc_tpu.parallel.rules import streamed_rules
+    state = _state()
+    ckpt = Checkpointer(tmp_path / 'ck')
+    ckpt.save(1, state, wait=True)
+    ckpt.close()
+
+    rules = streamed_rules()
+    ckpt, restored, start = resume_or_init(
+        str(tmp_path / 'ck'), _state(seed=9), mesh=_mesh(2), rules=rules)
+    assert start == 2
+    leaf = jax.tree.leaves(restored.params)[0]
+    assert leaf.sharding.mesh.devices.size == 2
+    ckpt.close()
+
+
+def test_fresh_start_is_still_placed_on_mesh(tmp_path):
+    """No checkpoint yet: the initial state still lands on the mesh, so
+    the first epoch and a resumed epoch see identically-placed state."""
+    _ckpt, state, start = resume_or_init(
+        str(tmp_path / 'empty_ck'), _state(), mesh=_mesh(4))
+    assert start == 1
+    leaf = jax.tree.leaves(state.params)[0]
+    assert leaf.sharding.mesh.devices.size == 4
+
+
+_SAVE8 = r'''
+import os, sys
+os.environ['XLA_FLAGS'] = '--xla_force_host_platform_device_count=8'
+os.environ['JAX_PLATFORMS'] = 'cpu'
+import jax, numpy as np
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from dgmc_tpu.train.checkpoint import Checkpointer
+mesh = Mesh(np.array(jax.devices()).reshape(4, 2), ('data', 'model'))
+state = {
+    'w': jax.device_put(jnp.arange(64.0).reshape(8, 8),
+                        NamedSharding(mesh, P('data', None))),
+    'b': jax.device_put(jnp.ones((8,)) * 5, NamedSharding(mesh, P())),
+    'count': jnp.asarray(3),
+}
+ck = Checkpointer(sys.argv[1])
+ck.save(5, state, wait=True)
+ck.close()
+print('SAVED8 ok')
+'''
+
+_RESTORE4 = r'''
+import os, sys, json
+os.environ['XLA_FLAGS'] = '--xla_force_host_platform_device_count=4'
+os.environ['JAX_PLATFORMS'] = 'cpu'
+import jax, numpy as np
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from dgmc_tpu.train.checkpoint import Checkpointer
+assert len(jax.devices()) == 4
+
+# (a) host numpy target: must deserialize to host, NOT the dead saved
+# topology (this raised CheckpointCorruptError before the fix).
+host_target = {'w': np.zeros((8, 8), np.float32),
+               'b': np.zeros((8,), np.float32),
+               'count': np.asarray(0)}
+ck = Checkpointer(sys.argv[1])
+got = ck.restore(host_target)
+assert ck.restored_step == 5, ck.restored_step
+assert isinstance(got['w'], np.ndarray), type(got['w'])
+assert float(got['w'][3, 3]) == 27.0 and int(got['count']) == 3
+ck.close()
+
+# (b) mesh target: leaves land resharded on the 4-device mesh.
+mesh = Mesh(np.array(jax.devices()).reshape(2, 2), ('data', 'model'))
+target = {
+    'w': jax.device_put(jnp.zeros((8, 8)),
+                        NamedSharding(mesh, P('data', None))),
+    'b': jax.device_put(jnp.zeros((8,)), NamedSharding(mesh, P())),
+    'count': jnp.asarray(0),
+}
+ck = Checkpointer(sys.argv[1])
+got = ck.restore(target)
+assert got['w'].sharding.mesh.devices.size == 4
+assert float(got['w'][3, 3]) == 27.0 and float(got['b'][0]) == 5.0
+ck.close()
+print('RESTORE4 ok')
+'''
+
+
+@pytest.mark.slow
+def test_restore_on_genuinely_shrunk_topology(tmp_path):
+    """8 devices at save, 4 at restore (separate processes, separate
+    XLA_FLAGS): both the host-target and the mesh-target restores must
+    succeed — this is the topology change an elastic restart survives."""
+    ck_dir = str(tmp_path / 'ck')
+    env = {k: v for k, v in os.environ.items() if k != 'XLA_FLAGS'}
+    env['JAX_ENABLE_COMPILATION_CACHE'] = 'false'
+    for code, tag in ((_SAVE8, 'SAVED8'), (_RESTORE4, 'RESTORE4')):
+        proc = subprocess.run([sys.executable, '-c', code, ck_dir],
+                              cwd=REPO, env=env, timeout=600,
+                              capture_output=True, text=True)
+        assert proc.returncode == 0, (tag, proc.stderr[-3000:])
+        assert f'{tag} ok' in proc.stdout
