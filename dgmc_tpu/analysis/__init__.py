@@ -28,6 +28,16 @@ a run is launched, in two tiers:
   resharding churn inside the consensus loop, per-specimen
   communication-byte budgets, and bf16-accumulation precision-contract
   violations.
+- **schedule & liveness tier** (:mod:`~dgmc_tpu.analysis.sched_rules`,
+  on the schedule model :mod:`~dgmc_tpu.analysis.hlo_sched` and the
+  liveness model :mod:`~dgmc_tpu.analysis.hlo_liveness`): over the same
+  compiled specimens, a dependency-DAG list schedule measures each
+  collective's dependence-allowed overlap (serialized async pairs,
+  per-specimen overlap budgets, double-buffer opportunities in streamed
+  chunk loops) and a buffer-liveness walk bounds static peak-live bytes
+  per device (per-specimen budgets — the static face of the
+  million-entity memory claims — and the AD-residual-blowup class of
+  loop-carried full-axis buffers).
 
 A recompile-hazard pass (:mod:`~dgmc_tpu.analysis.recompile`) hashes
 abstract step signatures across padding buckets and cross-checks them
@@ -52,6 +62,10 @@ from dgmc_tpu.analysis.registry import (SpecimenCache, default_specimens,
                                         run_trace_tier)
 from dgmc_tpu.analysis.hlo_comm import collective_schedule, parse_hlo_module
 from dgmc_tpu.analysis.shd_rules import analyze_sharded_hlo, run_sharded_tier
+from dgmc_tpu.analysis.hlo_sched import module_schedules, schedule_summary
+from dgmc_tpu.analysis.hlo_liveness import module_peak, peak_summary
+from dgmc_tpu.analysis.sched_rules import (analyze_schedule_hlo,
+                                           run_sched_tier)
 
 __all__ = [
     'Finding',
@@ -73,4 +87,10 @@ __all__ = [
     'parse_hlo_module',
     'analyze_sharded_hlo',
     'run_sharded_tier',
+    'module_schedules',
+    'schedule_summary',
+    'module_peak',
+    'peak_summary',
+    'analyze_schedule_hlo',
+    'run_sched_tier',
 ]

@@ -12,12 +12,16 @@ from typing import Dict
 
 __all__ = ['RuleDoc', 'RULES', 'RULE_CATALOG', 'TIERS', 'explain_rule']
 
-#: Tier key -> human name (the order tiers report in).
+#: Tier key -> human name (the order tiers report in). SCH and MEM are
+#: two rule families of ONE tier (the schedule & liveness pass over the
+#: same compiled specimens; ``--skip-sched`` skips both).
 TIERS = {
     'TRC': 'trace (lowered jaxpr / compiled executable)',
     'SRC': 'source (ast lints over the package source)',
     'RCP': 'recompile (padding-bucket churn + obs telemetry)',
     'SHD': 'sharded HLO (post-GSPMD partitioned programs)',
+    'SCH': 'schedule (list-schedule overlap over partitioned HLO)',
+    'MEM': 'liveness (static peak-live bytes over partitioned HLO)',
 }
 
 
@@ -215,6 +219,78 @@ RULES: Dict[str, RuleDoc] = {d.rule: d for d in [
        'lose mass. This is a correctness rule, not a style rule.',
        'Set preferred_element_type=f32 on the contraction, or keep '
        'the reduction input in f32 (cast AFTER the accumulation).'),
+    # --- schedule & liveness tier ----------------------------------------
+    _r('SCH401', 'error',
+       'async collective serialized inside a loop body',
+       'An async -start/-done pair inside a while body with no compute '
+       'between the start and its done in program order.',
+       'The program paid for asynchrony and then immediately blocked '
+       'on it: the streamed-S shard-boundary collective-permutes exist '
+       'to overlap the per-tile top-k compute, and a pair that '
+       'serializes is the chunk loop regressing to lockstep '
+       '(ROADMAP item 4).',
+       'Move independent per-tile compute between the start/done pair, '
+       'or double-buffer the chunk loop so the transfer hides behind '
+       "the previous chunk's work."),
+    _r('SCH402', 'warning',
+       'modeled collective overlap below the specimen budget',
+       "The program's dependency-allowed collective overlap fraction "
+       '(conservative two-stream list schedule, analysis/hlo_sched.py) '
+       "fell below the specimen's recorded overlap_budget "
+       '(analysis/registry.py, beside the SHD304 comm budget).',
+       'The model measures what the dependency structure PERMITS, not '
+       'wall clock: a drop means an edit added a dependence that '
+       'forces serialization on every backend, including the TPU runs '
+       'the CPU CI cannot time.',
+       'If the serialization is intended, lower the overlap_budget in '
+       'the registry and re-baseline; otherwise find the new '
+       'dependence chaining the chunk loop (the finding counts the '
+       'fully-serialized collectives).'),
+    _r('SCH403', 'info',
+       'per-iteration fetch serialized behind the loop carry '
+       '(double-buffer opportunity)',
+       'A gather / dynamic-slice / collective-permute on a while '
+       "body's critical path that re-issues off the loop-carried state "
+       'every iteration, feeds the body compute, and moves at least '
+       'double_buffer_min_bytes.',
+       "Iteration k+1's fetch cannot start until iteration k finishes "
+       '— the strictly-serial chunk loop ROADMAP item 4 wants '
+       'pipelined. The INFO severity marks an optimization '
+       'opportunity, not a defect.',
+       'Restructure the body to fetch chunk k+1 while computing chunk '
+       'k (double buffering); the fetch then overlaps compute and '
+       'SCH402 can pin the win.'),
+    _r('MEM404', 'error',
+       'static peak-live bytes exceed the specimen device budget',
+       "The liveness model's static peak-live bound "
+       '(analysis/hlo_liveness.py: defs to last uses, region peaks '
+       "stacked, aliasing bookkeeping zero-byte) exceeds the specimen's "
+       'recorded peak_bytes_budget.',
+       "The streamed specimen's budget is the static face of "
+       "SCALE_r07's 1.04 GiB/device claim: memory regressions at "
+       'million-entity scale must fail CI before a scale run is '
+       'launched, not during one.',
+       'If the growth is intended, raise peak_bytes_budget in the '
+       'registry and re-baseline; otherwise the finding names the top '
+       'stages holding bytes at the peak point — find the buffer that '
+       'began outliving its consumer.'),
+    _r('MEM405', 'error',
+       'loop-carried residual scales with the full streamed axis',
+       'A while-carried buffer of rank >= 2 and at least '
+       'residual_min_bytes whose shape carries a full streamed-axis '
+       'dimension (or stacks one slab per chunk across the whole axis) '
+       'in a specimen that declares stream_full/stream_chunk. Rank-1 '
+       'full-axis vectors are excluded by design: a [stream_full] '
+       'vector is the legitimate per-row output class, not a residual '
+       'slab.',
+       'The PR 9 defect class as a lint: under value_and_grad the '
+       'chunked candidate search stacked per-tile select masks as loop '
+       'residuals — 2 GiB/device at 2^20 targets for a search whose '
+       'real state is [rows, k]. Residual bytes must scale with the '
+       'chunk, never the corpus.',
+       'Make the producing search AD-opaque (custom_jvp + '
+       'stop_gradient, as ops/topk.py does) or rematerialize in the '
+       'backward pass instead of carrying full-axis residuals.'),
 ]}
 
 #: ``{rule: one-line title}`` — the ``--list-rules`` table (kept under

@@ -8,6 +8,16 @@ A :class:`Finding` is one detected hazard: a rule id, a severity, a
 a finding across unrelated edits but releases it the moment the finding
 moves or changes class.
 
+Fingerprints are **line-number independent** (baseline version 2): the
+trailing ``:line`` of ``where`` is stripped before hashing, and the
+finding's :attr:`~Finding.context` — a normalized snippet of what was
+actually flagged (the source line's text, an HLO op's kind+shape) —
+takes its place as the within-file discriminator. Pure line relocation
+(an edit above the finding) leaves the fingerprint unchanged; the
+finding moving to different code (new context) releases it. Version-1
+baselines hashed the raw line number and churned on every relocation;
+``dgmc-lint --write-baseline`` is the one-shot migration.
+
 The baseline file (``lint-baseline.json``) is the reviewed debt ledger:
 ``dgmc-lint --write-baseline`` records the current findings;
 ``dgmc-lint --fail-on new`` then fails only on findings whose
@@ -19,7 +29,9 @@ import dataclasses
 import enum
 import hashlib
 import json
+import linecache
 import os
+import re
 from typing import Dict, Iterable, List, Optional, Tuple
 
 
@@ -39,6 +51,11 @@ class Severity(enum.IntEnum):
                 f'{[s.name.lower() for s in cls]}') from None
 
 
+#: Trailing ``:line`` of a ``where`` string — stripped before hashing so
+#: pure line relocation never churns the fingerprint.
+_WHERE_LINE = re.compile(r':\d+$')
+
+
 @dataclasses.dataclass(frozen=True)
 class Finding:
     """One detected hazard.
@@ -53,16 +70,26 @@ class Finding:
             the fingerprint, so keep it deterministic).
         detail: free-form extra context (NOT fingerprinted — safe to
             enrich without invalidating baselines).
+        context: normalized snippet of what was flagged — the source
+            line's stripped text for source-located findings, an HLO
+            op's kind+shape for trace/HLO findings. Identity-bearing:
+            together with the line-stripped ``where`` it replaces the
+            line number in the fingerprint, so relocation keeps the
+            suppression but a different flagged construct releases it.
     """
     rule: str
     severity: Severity
     where: str
     message: str
     detail: Optional[str] = None
+    context: Optional[str] = None
 
     @property
     def fingerprint(self) -> str:
-        ident = f'{self.rule}|{self.where}|{self.message}'
+        where = _WHERE_LINE.sub('', self.where)
+        ident = f'{self.rule}|{where}|{self.message}'
+        if self.context:
+            ident += f'|{self.context}'
         return hashlib.sha256(ident.encode()).hexdigest()[:16]
 
     def to_json(self) -> dict:
@@ -75,7 +102,32 @@ class Finding:
         }
         if self.detail:
             out['detail'] = self.detail
+        if self.context:
+            out['context'] = self.context
         return out
+
+
+def disambiguate_contexts(findings: Iterable[Finding]) -> List[Finding]:
+    """Suffix an occurrence ordinal onto the context of every
+    same-identity duplicate (same rule, line-stripped where, message,
+    and context) so two IDENTICAL violating statements in one file keep
+    distinct fingerprints — without it, a copy-pasted duplicate of a
+    baselined violation would silently ride its suppression. The first
+    occurrence keeps the bare context (stable under relocation); every
+    producer calls this on its per-program output, so ordering — and
+    with it, which occurrence is first — is the program's deterministic
+    walk order."""
+    seen: Dict[tuple, int] = {}
+    out = []
+    for f in findings:
+        key = (f.rule, _WHERE_LINE.sub('', f.where), f.message,
+               f.context)
+        n = seen.get(key, 0)
+        seen[key] = n + 1
+        if n and f.context:
+            f = dataclasses.replace(f, context=f'{f.context} #{n + 1}')
+        out.append(f)
+    return out
 
 
 def sort_findings(findings: Iterable[Finding]) -> List[Finding]:
@@ -89,8 +141,36 @@ def sort_findings(findings: Iterable[Finding]) -> List[Finding]:
 # Baseline file
 # ---------------------------------------------------------------------------
 
-BASELINE_VERSION = 1
+#: Version 2 = line-number-independent (context-hash) fingerprints.
+#: Version-1 ledgers hold line-hashed fingerprints that can never match
+#: a v2 finding — loading one for a CHECK is an error (everything would
+#: silently report as new-and-unsuppressed or stale); the one-shot
+#: migration is ``dgmc-lint --write-baseline``, which re-records the
+#: same reviewed findings under their v2 fingerprints.
+BASELINE_VERSION = 2
+_MIGRATABLE_VERSIONS = (1,)
 DEFAULT_BASELINE_NAME = 'lint-baseline.json'
+
+
+def read_source_line(rel_path: str, lineno: int) -> Optional[str]:
+    """The stripped text of ``rel_path:lineno`` — the normalized context
+    snippet line-located findings fingerprint on. ``rel_path`` is the
+    repo-relative spelling provenance uses (``dgmc_tpu/ops/graph.py``),
+    resolved against the tree this package was imported from, then the
+    cwd; None when the file or line cannot be read (callers fall back
+    to a structural snippet). Reads ride :mod:`linecache`, so N
+    findings in one module cost one file read, not N scans."""
+    if not rel_path or lineno <= 0:
+        return None
+    pkg_parent = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    for root in (pkg_parent, os.getcwd()):
+        cand = os.path.join(root, rel_path)
+        if not os.path.isfile(cand):
+            continue
+        line = linecache.getline(cand, lineno)
+        return line.strip() or None
+    return None
 
 
 def default_baseline_path(start: Optional[str] = None) -> str:
@@ -115,17 +195,49 @@ def default_baseline_path(start: Optional[str] = None) -> str:
                         DEFAULT_BASELINE_NAME)
 
 
-def load_baseline(path: str) -> Dict[str, dict]:
-    """``{fingerprint: recorded entry}`` — empty when the file is absent."""
+def baseline_version(path: str) -> Optional[int]:
+    """The ``version`` field of a baseline file, or None when absent or
+    unreadable — the migration-warning probe (lint.py warns when a
+    ``--write-baseline`` over a v1 ledger must preserve entries it
+    cannot re-fingerprint)."""
+    if not path or not os.path.exists(path):
+        return None
+    try:
+        with open(path) as f:
+            return json.load(f).get('version')
+    except (OSError, ValueError):
+        return None
+
+
+def load_baseline(path: str, migrate: bool = False) -> Dict[str, dict]:
+    """``{fingerprint: recorded entry}`` — empty when the file is absent.
+
+    A version-1 ledger (legacy line-hashed fingerprints) raises unless
+    ``migrate`` is set: its fingerprints can never match a v2 finding,
+    so checking against one silently un-suppresses everything. The
+    baseline *rewriters* (``--write-baseline`` / ``--prune-baseline``)
+    pass ``migrate=True`` — they only need the old entries to preserve
+    unanalyzed tiers, and re-record everything else under v2
+    fingerprints (the one-shot migration).
+    """
     if not path or not os.path.exists(path):
         return {}
     with open(path) as f:
         data = json.load(f)
-    if data.get('version') != BASELINE_VERSION:
+    version = data.get('version')
+    if version == BASELINE_VERSION:
+        return {e['fingerprint']: e for e in data.get('findings', [])}
+    if version in _MIGRATABLE_VERSIONS:
+        if migrate:
+            return {e['fingerprint']: e for e in data.get('findings', [])}
         raise ValueError(
-            f'{path}: unsupported baseline version {data.get("version")!r} '
-            f'(this dgmc-lint writes version {BASELINE_VERSION})')
-    return {e['fingerprint']: e for e in data.get('findings', [])}
+            f'{path}: baseline version {version} carries legacy '
+            f'line-number fingerprints; run `dgmc-lint --write-baseline` '
+            f'once to migrate it to version {BASELINE_VERSION} '
+            f'(line-independent context fingerprints)')
+    raise ValueError(
+        f'{path}: unsupported baseline version {version!r} '
+        f'(this dgmc-lint writes version {BASELINE_VERSION})')
 
 
 def write_baseline(path: str, findings: Iterable[Finding],

@@ -32,10 +32,10 @@ import re
 from typing import Dict, Iterator, List, Optional, Tuple
 
 __all__ = [
-    'COLLECTIVE_OPS', 'DTYPE_BYTES', 'hlo_shape_bytes', 'mlir_tensor_info',
-    'HloOp', 'HloComputation', 'HloModule', 'CollectiveOp',
-    'parse_hlo_module', 'collective_schedule', 'collective_table',
-    'trim_source_path',
+    'COLLECTIVE_OPS', 'DTYPE_BYTES', 'STAGE_NAMES', 'hlo_shape_bytes',
+    'mlir_tensor_info', 'HloOp', 'HloComputation', 'HloModule',
+    'CollectiveOp', 'parse_hlo_module', 'collective_schedule',
+    'collective_table', 'stage_of', 'trim_source_path',
 ]
 
 #: Cross-device collective ops, HLO spelling (the StableHLO spelling
@@ -107,6 +107,28 @@ def trim_source_path(fname: str) -> str:
     return fname
 
 
+#: Pipeline stages the per-stage attributions bucket ops into,
+#: innermost-scope wins (``psi2`` is nested inside ``consensus_iter``;
+#: ``loss`` and ``optimizer`` come from ``train/steps.py``). Lives here
+#: — next to the op-name metadata parsing — so both the ``obs/cost.py``
+#: account (which re-exports it) and the liveness model bucket
+#: identically.
+STAGE_NAMES = ('psi1', 'psi2', 'initial_corr', 'topk', 'consensus_iter',
+               'loss', 'optimizer')
+
+
+def stage_of(op_name: str) -> str:
+    """Map one op-name scope path to its pipeline stage (innermost
+    matching scope wins; ``'other'`` when none matches). Transposed
+    (backward) ops carry the primal scope inside ``transpose(...)``
+    segments, so they attribute to the same stage."""
+    for seg in reversed(op_name.split('/')):
+        for stage in STAGE_NAMES:
+            if stage in seg:
+                return stage
+    return 'other'
+
+
 # ---------------------------------------------------------------------------
 # Structured HLO module parsing
 # ---------------------------------------------------------------------------
@@ -176,6 +198,23 @@ class HloOp:
         if op.endswith('-start'):
             op = op[:-len('-start')]
         return op if op in COLLECTIVE_OPS else None
+
+    @property
+    def async_done_kind(self) -> Optional[str]:
+        """Base collective kind of a ``-done`` op (None otherwise) —
+        the half of an async pair :attr:`collective_kind` deliberately
+        ignores. Needed to count a pair whose ``-start`` lives in a
+        DIFFERENT computation (a collective threaded through a while
+        boundary) exactly once."""
+        if not self.opcode.endswith('-done'):
+            return None
+        base = self.opcode[:-len('-done')]
+        return base if base in COLLECTIVE_OPS else None
+
+    @property
+    def is_async_start(self) -> bool:
+        return (self.opcode.endswith('-start')
+                and self.collective_kind is not None)
 
     @property
     def channel_id(self) -> Optional[int]:
@@ -253,6 +292,25 @@ class HloOp:
                 out.append((m.group(1), dims, m.group(3)))
         return out
 
+    def operand_refs(self) -> List[str]:
+        """Every ``%name`` referenced inside the call parens — typed or
+        not — in operand order. The dependency edges the schedule and
+        liveness models walk (``operands()`` keeps only typed operands,
+        which drops e.g. ``get-tuple-element``'s bare tuple ref)."""
+        start = self.line.find(self.opcode + '(')
+        if start < 0:
+            return []
+        start += len(self.opcode) + 1
+        depth = 1
+        i = start
+        while i < len(self.line) and depth:
+            if self.line[i] == '(':
+                depth += 1
+            elif self.line[i] == ')':
+                depth -= 1
+            i += 1
+        return re.findall(r'%([\w.\-]+)', self.line[start:i - 1])
+
     def called_computations(self) -> List[str]:
         """Region computations this op enters: while body/condition,
         conditional branches, ``call``/``fusion`` targets. ``to_apply``
@@ -316,25 +374,74 @@ class HloModule:
                 out.append((op, refs['body']))
         return out
 
+    def orphan_done_ids(self) -> frozenset:
+        """``id()`` of every ``-done`` op whose matching ``-start`` is
+        absent from this module — the start lives across a while/call
+        boundary the dump did not carry (or a saved fragment cut it).
+        Pairing is two-stage: a done consumes its same-computation start
+        through its operand; an unconsumed done then claims any
+        same-kind start with the same ``channel_id`` anywhere in the
+        module (the while-boundary case). What remains is an orphan,
+        and stands in for its whole pair wherever collectives are
+        counted — so a split pair counts exactly once, never zero."""
+        starts_by_channel = {}
+        unmatched = []
+        for comp in self.computations.values():
+            defs = {op.result: op for op in comp.ops}
+            for op in comp.ops:
+                if op.is_async_start:
+                    key = (op.collective_kind, op.channel_id)
+                    starts_by_channel[key] = \
+                        starts_by_channel.get(key, 0) + 1
+            for op in comp.ops:
+                kind = op.async_done_kind
+                if kind is None:
+                    continue
+                operands = op.operands()
+                producer = (defs.get(operands[0][2]) if operands
+                            else None)
+                if producer is not None and producer.is_async_start:
+                    key = (kind, producer.channel_id)
+                    if starts_by_channel.get(key, 0) > 0:
+                        starts_by_channel[key] -= 1
+                    continue
+                unmatched.append((kind, op))
+        orphans = []
+        for kind, op in unmatched:
+            key = (kind, op.channel_id)
+            if starts_by_channel.get(key, 0) > 0:
+                starts_by_channel[key] -= 1       # cross-computation pair
+                continue
+            orphans.append(id(op))
+        return frozenset(orphans)
+
     def flatten_collectives(self, comp_name: str,
                             _seen: Optional[frozenset] = None,
+                            _orphans: Optional[frozenset] = None,
                             ) -> List['CollectiveOp']:
         """Collectives reachable from ``comp_name``, program order,
         descending into regions (a while body contributes once — its
         per-iteration repetition is a schedule property, not an op
-        count)."""
+        count). An async pair counts at its ``-start``; a ``-done``
+        whose start is absent from the module (while-boundary split,
+        truncated dump) stands in for its pair instead of vanishing."""
         comp = self.computations.get(comp_name)
         if comp is None:
             return []
+        if _orphans is None:
+            _orphans = self.orphan_done_ids()
         seen = (_seen or frozenset()) | {comp_name}
         out = []
         for op in comp.ops:
             kind = op.collective_kind
+            if kind is None and id(op) in _orphans:
+                kind = op.async_done_kind
             if kind is not None:
                 out.append(CollectiveOp.from_op(kind, op, comp_name))
             for sub in op.called_computations():
                 if sub not in seen:
-                    out.extend(self.flatten_collectives(sub, seen))
+                    out.extend(self.flatten_collectives(sub, seen,
+                                                        _orphans))
         return out
 
 
@@ -449,8 +556,15 @@ def collective_table(text: str) -> Dict:
         ops = _stablehlo_collective_table(text)
     else:
         ops = {}
-        for _, op in parse_hlo_module(text).iter_ops():
+        module = parse_hlo_module(text)
+        orphans = module.orphan_done_ids()
+        for _, op in module.iter_ops():
             kind = op.collective_kind
+            if kind is None and id(op) in orphans:
+                # A -done whose -start fell across a computation
+                # boundary (or off the dump): stands in for its pair —
+                # counted once, never zero, never twice.
+                kind = op.async_done_kind
             if kind is None:
                 continue
             row = ops.setdefault(kind, {'count': 0, 'bytes': 0})

@@ -1,0 +1,236 @@
+"""Buffer-liveness model: static peak-live bytes over post-GSPMD HLO.
+
+PR 9's AD-residual blowup (a 2 GiB/device stack of select masks carried
+as loop residuals) was caught at runtime, by watching a scale run die.
+The information was in the compiled program the whole time: every
+buffer's definition point, its last use, and the region structure that
+keeps a while body's working set alive on top of its caller's. This
+module walks that structure and produces a **static peak-live-bytes
+bound** per program, attributed to the ``jax.named_scope`` pipeline
+stages ``obs/cost.py`` already buckets by (the ``op_name`` loc metadata
+GSPMD copies onto every partitioned op):
+
+- Each op's result allocates its ``result_bytes`` at its definition
+  index and frees after its last use. Aliasing bookkeeping
+  (``get-tuple-element`` / ``tuple`` / ``bitcast``) is zero-byte but
+  **propagates liveness** to the storage it aliases.
+- Region ops (``while`` / ``conditional`` / ``call``) add their region's
+  peak on top of the live set at the call point — a while body's working
+  set rides on everything the caller still holds. Fusion interiors are
+  folded into the fusion op's result (the backend never materializes
+  them).
+- Parameters are live from entry until their last use. Donation aliasing
+  is deliberately ignored: the model is a conservative *upper* bound,
+  and a bound that assumed donation would under-report exactly when
+  donation silently breaks (the TRC004 class).
+
+The MEM rules (:mod:`~dgmc_tpu.analysis.sched_rules`) gate per-specimen
+budgets on this bound (the streamed specimen's budget pins the
+SCALE_r07 1.04 GiB/device claim's static face), and ``obs/cost.py``
+publishes it into ``efficiency.json`` as ``static_peak_bytes``.
+
+Pure text analysis — no jax import.
+"""
+
+import dataclasses
+from typing import Dict, List, Optional, Tuple
+
+from dgmc_tpu.analysis.hlo_comm import (DTYPE_BYTES, HloModule, HloOp,
+                                        _HLO_SHAPE, parse_hlo_module,
+                                        stage_of)
+
+__all__ = [
+    'ALIAS_OPS', 'REGION_OPS', 'LiveBuffer', 'ComputationLiveness',
+    'computation_liveness', 'module_peak', 'peak_summary',
+    'while_carry_elements',
+]
+
+#: Zero-byte bookkeeping that aliases existing storage (keeps its
+#: operands alive for as long as it is referenced).
+ALIAS_OPS = frozenset({'get-tuple-element', 'tuple', 'bitcast',
+                       'parameter', 'after-all'})
+
+#: Ops whose region's working set stacks on the caller's live set.
+#: ``fusion`` is deliberately absent: its interior never materializes.
+REGION_OPS = frozenset({'while', 'conditional', 'call'})
+
+
+@dataclasses.dataclass
+class LiveBuffer:
+    """One buffer live at the peak point."""
+    index: int
+    op: HloOp
+    nbytes: int
+
+    @property
+    def stage(self) -> str:
+        return stage_of(self.op.op_name)
+
+
+@dataclasses.dataclass
+class ComputationLiveness:
+    """One computation's liveness account."""
+    name: str
+    #: Static peak-live bytes, region peaks included.
+    peak_bytes: int
+    #: Program index of the peak point.
+    peak_index: int
+    #: Buffers live at the peak (excluding region interiors).
+    live_at_peak: List[LiveBuffer]
+    #: Bytes the region entered at the peak point contributed (0 when
+    #: the peak is a flat op).
+    region_bytes: int
+    #: The region computation charged at the peak, if any.
+    region_name: Optional[str]
+    #: Pipeline stage of the region op itself (where its bytes charge).
+    region_stage: Optional[str] = None
+
+    def stage_bytes(self) -> Dict[str, int]:
+        """Live bytes at the peak, grouped by pipeline stage; the
+        region's contribution is charged to the region op's stage, so
+        the buckets sum to :attr:`peak_bytes` and reconcile against the
+        headline bound."""
+        out: Dict[str, int] = {}
+        for buf in self.live_at_peak:
+            out[buf.stage] = out.get(buf.stage, 0) + buf.nbytes
+        if self.region_bytes:
+            stage = self.region_stage or 'other'
+            out[stage] = out.get(stage, 0) + self.region_bytes
+        return out
+
+
+def _alloc_bytes(op: HloOp) -> int:
+    """Bytes this op's result genuinely allocates (0 for aliases)."""
+    if op.opcode in ALIAS_OPS and op.opcode != 'parameter':
+        return 0
+    return op.result_bytes
+
+
+def computation_liveness(module: HloModule, name: str,
+                         _memo: Optional[dict] = None,
+                         _stack: Optional[frozenset] = None,
+                         ) -> ComputationLiveness:
+    """Liveness walk of one computation (regions recursed, memoized)."""
+    memo = _memo if _memo is not None else {}
+    if name in memo:
+        return memo[name]
+    stack = (_stack or frozenset()) | {name}
+    comp = module.computations.get(name)
+    if comp is None:
+        empty = ComputationLiveness(name=name, peak_bytes=0,
+                                    peak_index=-1, live_at_peak=[],
+                                    region_bytes=0, region_name=None)
+        memo[name] = empty
+        return empty
+
+    ops = comp.ops
+    n = len(ops)
+    defs = {op.result: i for i, op in enumerate(ops)}
+    dep_idx: List[Tuple[int, ...]] = []
+    for op in ops:
+        dep_idx.append(tuple(sorted(
+            {defs[r] for r in op.operand_refs() if r in defs})))
+
+    # Last use with alias propagation: an alias op's operands stay live
+    # as long as the alias itself is referenced. Reverse walk makes each
+    # op's own last_use final before it extends its operands'.
+    last_use = list(range(n))
+    root = next((i for i in range(n - 1, -1, -1) if ops[i].is_root), n - 1)
+    if n:
+        last_use[root] = n            # the result outlives the program
+    for i in range(n - 1, -1, -1):
+        reach = last_use[i] if ops[i].opcode in ALIAS_OPS else i
+        for d in dep_idx[i]:
+            if last_use[d] < reach:
+                last_use[d] = reach
+    frees_at: Dict[int, List[int]] = {}
+    for i in range(n):
+        frees_at.setdefault(last_use[i], []).append(i)
+
+    live: Dict[int, int] = {}
+    current = 0
+    peak = 0
+    peak_i = -1
+    peak_live: Dict[int, int] = {}
+    peak_region = 0
+    peak_region_name = None
+    for i, op in enumerate(ops):
+        nbytes = _alloc_bytes(op)
+        if nbytes:
+            live[i] = nbytes
+            current += nbytes
+        extra = 0
+        extra_name = None
+        if op.opcode in REGION_OPS:
+            for sub in op.called_computations():
+                if sub in stack:
+                    continue
+                sub_live = computation_liveness(module, sub, memo, stack)
+                if sub_live.peak_bytes > extra:
+                    extra = sub_live.peak_bytes
+                    extra_name = sub
+        if current + extra > peak:
+            peak = current + extra
+            peak_i = i
+            peak_live = dict(live)
+            peak_region = extra
+            peak_region_name = extra_name
+        for j in frees_at.get(i, ()):
+            current -= live.pop(j, 0)
+
+    result = ComputationLiveness(
+        name=name, peak_bytes=peak, peak_index=peak_i,
+        live_at_peak=[LiveBuffer(index=j, op=ops[j], nbytes=b)
+                      for j, b in sorted(peak_live.items())],
+        region_bytes=peak_region, region_name=peak_region_name,
+        region_stage=(stage_of(ops[peak_i].op_name)
+                      if peak_region_name and 0 <= peak_i < n else None))
+    memo[name] = result
+    return result
+
+
+def module_peak(text_or_module) -> ComputationLiveness:
+    """The ENTRY computation's liveness account (regions included) —
+    the program's static peak-live bound."""
+    module = (text_or_module if isinstance(text_or_module, HloModule)
+              else parse_hlo_module(text_or_module))
+    entry = module.entry or (next(iter(module.computations), None))
+    if entry is None:
+        return ComputationLiveness(name='<empty>', peak_bytes=0,
+                                   peak_index=-1, live_at_peak=[],
+                                   region_bytes=0, region_name=None)
+    return computation_liveness(module, entry)
+
+
+def peak_summary(text_or_module) -> dict:
+    """The fields ``obs/cost.py`` merges into ``efficiency.json``:
+    ``static_peak_bytes`` (the ONE key this number carries on every
+    surface — efficiency.json, obs.diff rows, the schedule-report
+    artifact — so cross-artifact grep works), the peak point's
+    per-stage byte attribution, and the charged region (if the peak
+    sits inside a while body)."""
+    lv = module_peak(text_or_module)
+    out = {'static_peak_bytes': lv.peak_bytes}
+    stages = {k: v for k, v in sorted(lv.stage_bytes().items(),
+                                      key=lambda kv: -kv[1]) if v}
+    if stages:
+        out['peak_stage_bytes'] = stages
+    if lv.region_name:
+        out['peak_region'] = lv.region_name
+        out['peak_region_bytes'] = lv.region_bytes
+    return out
+
+
+def while_carry_elements(op: HloOp) -> List[Tuple[str, Tuple[int, ...], int]]:
+    """``(dtype, dims, nbytes)`` per element of a while op's carried
+    tuple — the loop-carried state MEM405's residual accounting walks.
+    Parsed from the while's result type (identical to the carry type by
+    HLO's while contract)."""
+    out = []
+    for m in _HLO_SHAPE.finditer(op.result_type):
+        dims = tuple(int(d) for d in m.group(2).split(',') if d)
+        n = 1
+        for d in dims:
+            n *= d
+        out.append((m.group(1), dims, n * DTYPE_BYTES.get(m.group(1), 4)))
+    return out

@@ -1,0 +1,430 @@
+"""Schedule model over post-GSPMD HLO: overlap, serialization, critical
+path.
+
+ROADMAP item 4 asks for the streamed-S chunk loop's compute/communication
+overlap to be verified *statically* — the weak-scaling gap (0.894 at
+SCALE_r07) is the chunk loop waiting on gather/collective and the
+collective waiting on compute, and that serialization is visible in the
+compiled program's dependency structure long before a run is launched.
+This module builds that view:
+
+- **Dependency DAG** per computation over
+  :func:`~dgmc_tpu.analysis.hlo_comm.parse_hlo_module` output: every op's
+  ``%operand`` references become edges (``HloOp.operand_refs``).
+- **Async intervals**: ``-start``/``-done`` pairs are widened into
+  in-flight intervals (paired through the done's operand chain inside a
+  computation; a cross-computation pair — the while-boundary split
+  ``hlo_comm`` counts once — degrades to a zero-length join here, which
+  is the conservative reading).
+- **Conservative list schedule**: ops run in program order on two
+  streams — one compute stream, one communication stream — each op
+  starting no earlier than its dependencies finish. Durations are byte
+  proxies (result bytes for compute, payload bytes for collectives):
+  deterministic, machine-free, and comparable run over run. Under this
+  model a *synchronous* collective still occupies only the comm stream;
+  whether any compute lands inside its window is decided purely by the
+  dependency structure — which is exactly the question "could this
+  communication overlap?". A serial chunk loop (fetch k -> compute k ->
+  fetch k+1) shows zero overlap because its chain forces it; a
+  double-buffered body (fetch k+1 independent of compute k) shows the
+  overlap the rewrite bought, statically.
+- **Per-collective overlap fraction**: the fraction of a collective's
+  modeled in-flight window covered by busy compute-stream time; the
+  program's ``overlap_fraction`` is the payload-weighted mean. A
+  collective with zero overlappable compute is **serialized**.
+- **Critical-path share**: longest dependency-path cost over total cost
+  — how much of the program is chain, not width. 1.0 = fully serial.
+
+``python -m dgmc_tpu.analysis.hlo_sched`` renders the schedule report
+over the registered multi-device specimens (the artifact CI uploads);
+the SCH rules (:mod:`~dgmc_tpu.analysis.sched_rules`) consume the same
+model, and ``obs/cost.py`` publishes ``overlap_fraction`` into
+``efficiency.json`` from it — one model, three consumers, no drift.
+
+Pure text analysis — importing this module must never bring up a jax
+backend (the CLI entry point imports the registry lazily).
+"""
+
+import dataclasses
+from typing import Dict, List, Optional, Tuple
+
+from dgmc_tpu.analysis.hlo_comm import (HloComputation, HloModule, HloOp,
+                                        parse_hlo_module)
+
+__all__ = [
+    'FREE_OPS', 'FETCH_OPS', 'ScheduledOp', 'CollectiveInterval',
+    'ComputationSchedule', 'schedule_computation', 'module_schedules',
+    'schedule_summary', 'main',
+]
+
+#: Ops that neither move nor produce bytes worth modeling: bookkeeping
+#: that any backend folds away. Zero duration, no stream occupancy.
+FREE_OPS = frozenset({
+    'parameter', 'constant', 'get-tuple-element', 'tuple', 'bitcast',
+    'after-all', 'partition-id', 'replica-id', 'iota', 'broadcast',
+    'reshape',
+})
+
+#: Ops that FETCH the next chunk's data in a streamed loop body — the
+#: double-buffer candidates SCH403 watches: gathers/slices re-issued per
+#: iteration off the carry, and the shard-boundary permutes.
+FETCH_OPS = frozenset({
+    'gather', 'dynamic-slice', 'collective-permute',
+    'collective-permute-start', 'all-gather', 'all-gather-start',
+})
+
+
+@dataclasses.dataclass
+class ScheduledOp:
+    """One op's placement in the modeled schedule."""
+    index: int
+    op: HloOp
+    stream: str               # 'compute' | 'comm' | 'free'
+    duration: int             # byte proxy
+    start: float
+    finish: float
+    deps: Tuple[int, ...]
+
+
+@dataclasses.dataclass
+class CollectiveInterval:
+    """One collective's modeled in-flight window."""
+    op: HloOp
+    kind: str
+    nbytes: int
+    computation: str
+    start: float
+    finish: float
+    #: Busy compute-stream time inside [start, finish).
+    overlapped: float
+    #: ``overlapped / duration`` (0..1); 0.0 = fully serialized.
+    overlap_fraction: float
+    #: For an async pair: compute cost of ops strictly between the
+    #: ``-start`` and its ``-done`` in PROGRAM order (what the program as
+    #: written can hide the latency behind). None for sync collectives.
+    program_gap_cost: Optional[int] = None
+    #: The matched ``-done`` op's index; None for sync collectives and
+    #: cross-computation pairs.
+    done_index: Optional[int] = None
+
+
+@dataclasses.dataclass
+class ComputationSchedule:
+    """The schedule model of one computation."""
+    name: str
+    ops: List[ScheduledOp]
+    collectives: List[CollectiveInterval]
+    compute_cost: int
+    comm_cost: int
+    #: Longest dependency-path cost (infinite-resource bound).
+    critical_path_cost: int
+    #: ``critical_path_cost / (compute_cost + comm_cost)`` — 1.0 means
+    #: the computation is one chain: nothing can overlap anything.
+    critical_path_share: float
+    #: Indices (into ``ops``) on at least one critical path.
+    critical_ops: frozenset
+
+    @property
+    def overlap_fraction(self) -> Optional[float]:
+        """Payload-weighted mean per-collective overlap; None without
+        collectives."""
+        total = sum(c.nbytes for c in self.collectives)
+        if not total:
+            return None
+        return sum(c.overlap_fraction * c.nbytes
+                   for c in self.collectives) / total
+
+
+def _duration(op: HloOp) -> int:
+    if op.opcode in FREE_OPS or op.opcode.endswith('-done'):
+        return 0
+    return max(op.result_bytes, 1)
+
+
+def _dep_indices(comp: HloComputation) -> List[Tuple[int, ...]]:
+    defs = {op.result: i for i, op in enumerate(comp.ops)}
+    out = []
+    for op in comp.ops:
+        deps = []
+        for name in op.operand_refs():
+            j = defs.get(name)
+            if j is not None:
+                deps.append(j)
+        out.append(tuple(sorted(set(deps))))
+    return out
+
+
+def _pair_async_in_comp(comp: HloComputation) -> Dict[int, int]:
+    """``{start_index: done_index}`` for async pairs joined through the
+    done's operand chain within one computation. A done whose producer
+    is not a start (the start crossed a while boundary) stays unpaired —
+    the schedule treats it as an instant join, the conservative
+    reading."""
+    defs = {op.result: i for i, op in enumerate(comp.ops)}
+    pairs = {}
+    for i, op in enumerate(comp.ops):
+        if op.async_done_kind is None:
+            continue
+        refs = op.operand_refs()
+        j = defs.get(refs[0]) if refs else None
+        if j is not None and comp.ops[j].is_async_start:
+            pairs[j] = i
+    return pairs
+
+
+def schedule_computation(comp: HloComputation) -> ComputationSchedule:
+    """Run the conservative list schedule over one computation.
+
+    Program order is preserved per stream (no reordering — the model
+    never claims more overlap than a scheduler keeping HLO order could
+    achieve); an op starts at ``max(stream frontier, deps ready)``.
+    Collectives occupy the comm stream, everything else with bytes the
+    compute stream; consumers of a collective wait for its finish
+    through the dependency edge, so a dependence-serialized program
+    shows serialized collectives no matter which stream they sit on.
+    """
+    deps = _dep_indices(comp)
+    async_pairs = _pair_async_in_comp(comp)
+    done_to_start = {d: s for s, d in async_pairs.items()}
+
+    finish: Dict[int, float] = {}
+    scheduled: List[ScheduledOp] = []
+    busy: List[Tuple[float, float]] = []     # compute-stream segments
+    t_compute = 0.0
+    t_comm = 0.0
+    coll_windows = []                        # (index, start, finish)
+
+    for i, op in enumerate(comp.ops):
+        dur = _duration(op)
+        ready = max((finish[d] for d in deps[i] if d in finish),
+                    default=0.0)
+        if i in done_to_start:
+            # Join point of an async pair: completes when the start's
+            # transfer does (already folded into finish[start]).
+            s = f = max(ready, finish.get(done_to_start[i], 0.0))
+            stream = 'free'
+        elif op.collective_kind is not None:
+            s = max(t_comm, ready)
+            f = s + dur
+            t_comm = f
+            stream = 'comm'
+            coll_windows.append((i, s, f))
+        elif op.async_done_kind is not None:
+            # Done without a local start (cross-computation pair):
+            # instant join — hlo_comm's module-level pairing owns the
+            # byte accounting for these.
+            s = f = ready
+            stream = 'free'
+        elif dur == 0:
+            s = f = ready
+            stream = 'free'
+        else:
+            s = max(t_compute, ready)
+            f = s + dur
+            t_compute = f
+            busy.append((s, f))
+            stream = 'compute'
+        finish[i] = f
+        scheduled.append(ScheduledOp(index=i, op=op, stream=stream,
+                                     duration=dur, start=s, finish=f,
+                                     deps=deps[i]))
+
+    collectives = []
+    for i, s, f in coll_windows:
+        op = comp.ops[i]
+        overlapped = sum(max(0.0, min(f, b1) - max(s, b0))
+                         for b0, b1 in busy)
+        dur = max(f - s, 1e-9)
+        gap_cost = None
+        done_idx = async_pairs.get(i)
+        if op.is_async_start:
+            end = done_idx if done_idx is not None else len(comp.ops)
+            gap_cost = sum(_duration(comp.ops[j])
+                           for j in range(i + 1, end)
+                           if scheduled[j].stream == 'compute')
+        collectives.append(CollectiveInterval(
+            op=op, kind=op.collective_kind, nbytes=_duration(op),
+            computation=comp.name, start=s, finish=f,
+            overlapped=overlapped,
+            overlap_fraction=min(1.0, overlapped / dur),
+            program_gap_cost=gap_cost, done_index=done_idx))
+
+    compute_cost = sum(o.duration for o in scheduled
+                       if o.stream == 'compute')
+    comm_cost = sum(o.duration for o in scheduled if o.stream == 'comm')
+
+    # Critical path: longest dependency-path cost, infinite resources.
+    # (A -done's dependency on its -start rides the operand edge, so the
+    # transfer cost is on the path without special casing.)
+    lp: List[float] = []
+    for i in range(len(comp.ops)):
+        base = max((lp[d] for d in deps[i]), default=0.0)
+        lp.append(base + scheduled[i].duration)
+    cp = max(lp, default=0.0)
+    total = compute_cost + comm_cost
+    # Backward pass marks ops on at least one critical path.
+    critical = set()
+    if cp > 0:
+        consumers: List[List[int]] = [[] for _ in comp.ops]
+        for j, ds in enumerate(deps):
+            for d in ds:
+                consumers[d].append(j)
+        down: List[float] = [0.0] * len(comp.ops)
+        for i in range(len(comp.ops) - 1, -1, -1):
+            down[i] = max((down[j] + scheduled[j].duration
+                           for j in consumers[i]), default=0.0)
+            if lp[i] + down[i] >= cp - 1e-9:
+                critical.add(i)
+
+    return ComputationSchedule(
+        name=comp.name, ops=scheduled, collectives=collectives,
+        compute_cost=compute_cost, comm_cost=comm_cost,
+        critical_path_cost=int(cp),
+        critical_path_share=(cp / total if total else 0.0),
+        critical_ops=frozenset(critical))
+
+
+def module_schedules(text_or_module) -> Dict[str, ComputationSchedule]:
+    """Per-computation schedules for every computation reachable from
+    ENTRY (while bodies/conditions, conditional branches, calls — each
+    modeled once; fusion interiors are folded into their fusion op like
+    the backend folds them)."""
+    module = (text_or_module if isinstance(text_or_module, HloModule)
+              else parse_hlo_module(text_or_module))
+    roots = [module.entry] if module.entry else list(module.computations)[:1]
+    out: Dict[str, ComputationSchedule] = {}
+
+    def walk(name):
+        comp = module.computations.get(name)
+        if comp is None or name in out:
+            return
+        out[name] = schedule_computation(comp)
+        for op in comp.ops:
+            if op.opcode == 'fusion':
+                continue
+            for sub in op.called_computations():
+                walk(sub)
+
+    for r in roots:
+        if r:
+            walk(r)
+    return out
+
+
+def schedule_summary(text_or_module, scheds=None) -> dict:
+    """The program-level account ``obs/cost.py`` publishes and the SCH
+    rules gate on: payload-weighted ``overlap_fraction`` over every
+    reachable collective, the serialized subset, and the entry
+    computation's ``critical_path_share``. ``overlap_fraction`` is
+    omitted when the program moves nothing between devices. Pass
+    ``scheds`` (a :func:`module_schedules` result) to reuse an
+    already-built model instead of rebuilding it."""
+    module = (text_or_module if isinstance(text_or_module, HloModule)
+              else parse_hlo_module(text_or_module))
+    if scheds is None:
+        scheds = module_schedules(module)
+    colls: List[CollectiveInterval] = []
+    for sched in scheds.values():
+        colls.extend(sched.collectives)
+    out = {'computations': len(scheds)}
+    entry = scheds.get(module.entry) if module.entry else None
+    if entry is None and scheds:
+        entry = next(iter(scheds.values()))
+    if entry is not None:
+        out['critical_path_share'] = round(entry.critical_path_share, 4)
+    if colls:
+        total = sum(c.nbytes for c in colls)
+        out['collective_count'] = len(colls)
+        out['collective_bytes'] = total
+        out['overlap_fraction'] = round(
+            sum(c.overlap_fraction * c.nbytes for c in colls) / total, 4)
+        out['serialized_collectives'] = sum(
+            1 for c in colls if c.overlap_fraction <= 0.0)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# CLI: the schedule report over the registered specimens
+# ---------------------------------------------------------------------------
+
+
+def _specimen_report(names=None, on_progress=None) -> dict:
+    """``{specimen: schedule_summary + static peak}`` over the
+    registered multi-device specimens (compiled under their meshes via
+    the shared registry artifacts) — the ``schedule-report`` artifact CI
+    uploads next to the lint report."""
+    from dgmc_tpu.analysis.hlo_liveness import peak_summary
+    from dgmc_tpu.analysis.registry import (SpecimenCache,
+                                            iter_runnable_specimens)
+    cache = SpecimenCache()
+    out = {}
+    for spec in iter_runnable_specimens('sched', names=names,
+                                        on_progress=on_progress):
+        if on_progress:
+            on_progress(f'schedule {spec.name}')
+        try:
+            module = parse_hlo_module(
+                cache.artifacts(spec).compiled().as_text())
+            row = schedule_summary(module)
+            row.update(peak_summary(module))
+            out[spec.name] = row
+        except Exception as e:
+            out[spec.name] = {'error': f'{type(e).__name__}: {e}'}
+    return out
+
+
+def main(argv=None):
+    import argparse
+    import json
+    import sys
+
+    parser = argparse.ArgumentParser(
+        prog='python -m dgmc_tpu.analysis.hlo_sched',
+        description='Schedule/liveness report over the registered '
+                    'multi-device specimens: modeled collective overlap, '
+                    'serialized collectives, critical-path share, and '
+                    'static peak-live bytes per program.')
+    parser.add_argument('--specimens', default=None,
+                        help='comma-separated specimen names '
+                             '(default: all runnable sched-tier '
+                             'specimens)')
+    parser.add_argument('--json', action='store_true',
+                        help='print the machine-readable report')
+    args = parser.parse_args(argv)
+
+    quiet = args.json
+
+    def progress(msg):
+        if not quiet:
+            print(f'[hlo_sched] {msg}', file=sys.stderr)
+
+    names = ({n.strip() for n in args.specimens.split(',') if n.strip()}
+             if args.specimens else None)
+    report = _specimen_report(names=names, on_progress=progress)
+    if not report:
+        print('hlo_sched: no runnable sched-tier specimens matched',
+              file=sys.stderr)
+        return 2
+    if args.json:
+        print(json.dumps(report, indent=1, sort_keys=True))
+        return 0
+    for name, row in report.items():
+        if 'error' in row:
+            print(f'-- {name}: ERROR {row["error"]}')
+            continue
+        print(f'-- {name} --')
+        ov = row.get('overlap_fraction')
+        print(f'   overlap_fraction     '
+              f'{"-" if ov is None else f"{ov:.4f}"}   '
+              f'({row.get("collective_count", 0)} collective(s), '
+              f'{row.get("serialized_collectives", 0)} serialized)')
+        print(f'   critical_path_share  '
+              f'{row.get("critical_path_share", 0):.4f}')
+        print(f'   static_peak_bytes    '
+              f'{row.get("static_peak_bytes", 0)}')
+    return 0
+
+
+if __name__ == '__main__':
+    import sys
+    sys.exit(main())

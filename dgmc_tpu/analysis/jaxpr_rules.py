@@ -46,7 +46,9 @@ from typing import Iterator, List, Optional, Tuple
 import jax
 from jax import core as jax_core
 
-from dgmc_tpu.analysis.findings import Finding, Severity
+from dgmc_tpu.analysis.findings import (Finding, Severity,
+                                        disambiguate_contexts,
+                                        read_source_line)
 
 #: Primitive names that fence the host. Matched exactly or by suffix.
 CALLBACK_PRIMITIVES = ('debug_callback', 'pure_callback', 'io_callback',
@@ -130,6 +132,22 @@ def eqn_provenance(eqn) -> str:
     return f'{fname}:{frame.start_line}'
 
 
+def _prov_context(prov: str, fallback: str) -> str:
+    """Line-independent context snippet for a ``file.py:line``
+    provenance: the source line's stripped text when readable, else a
+    structural ``fallback`` (op kind + shapes) — what the fingerprint
+    hashes in place of the line number (findings.py)."""
+    path, sep, line = prov.rpartition(':')
+    if sep:
+        try:
+            text = read_source_line(path, int(line))
+        except ValueError:
+            text = None
+        if text:
+            return text
+    return fallback
+
+
 def _aval_of(var):
     aval = getattr(var, 'aval', None)
     return aval
@@ -163,7 +181,8 @@ def check_dtype_promotion(closed, ctx: TraceContext) -> List[Finding]:
             where=f'{ctx.specimen}:{prov}',
             message=(f'64-bit value introduced by `{prim}` '
                      f'({", ".join(dtypes)}) in a <=32-bit pipeline'),
-            detail=f'{n} equation(s) at this site; e.g. {example}')
+            detail=f'{n} equation(s) at this site; e.g. {example}',
+            context=_prov_context(prov, f'{prim} {" ".join(dtypes)}'))
         for (prim, prov, dtypes), (n, example) in sorted(sites.items())]
 
 
@@ -223,7 +242,8 @@ def check_host_callbacks(closed, ctx: TraceContext) -> List[Finding]:
             message=(f'host callback `{name}` in a program expected '
                      f'callback-free (probes disabled) — fences '
                      f'device->host every step'),
-            detail=f'{n} equation(s) at this site')
+            detail=f'{n} equation(s) at this site',
+            context=_prov_context(prov, name))
         for (name, prov), n in sorted(sites.items())]
 
 
@@ -264,7 +284,8 @@ def check_pathological_lowerings(closed, ctx: TraceContext) -> List[Finding]:
             detail=(f'{n} equation(s) at this site, out shapes '
                     f'{sorted(shapes)}; inherent to unsorted segment '
                     f'aggregation — prefer sorted/blocked forms on hot '
-                    f'paths')))
+                    f'paths'),
+            context=_prov_context(prov, name)))
     for (name, prov), (n, dims_seen) in sorted(sorts.items()):
         out.append(Finding(
             rule='TRC006', severity=Severity.WARNING,
@@ -272,7 +293,8 @@ def check_pathological_lowerings(closed, ctx: TraceContext) -> List[Finding]:
             message=(f'sort over axis of >= {ctx.sort_dim} elements — on '
                      f'TPU prefer top_k / the streaming blockwise top-k'),
             detail=f'{n} equation(s) at this site, axis sizes '
-                   f'{sorted(dims_seen)}'))
+                   f'{sorted(dims_seen)}',
+            context=_prov_context(prov, name)))
     return out
 
 
@@ -285,7 +307,7 @@ def analyze_closed_jaxpr(closed, ctx: Optional[TraceContext] = None,
     out += check_giant_constants(closed, ctx)
     out += check_host_callbacks(closed, ctx)
     out += check_pathological_lowerings(closed, ctx)
-    return out
+    return disambiguate_contexts(out)
 
 
 # ---------------------------------------------------------------------------

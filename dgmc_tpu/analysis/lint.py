@@ -16,12 +16,15 @@ hot functions), ``--skip-source`` (ast lints over the package source),
 ``--skip-recompile`` (padding-bucket churn), ``--skip-sharded`` (SHD
 rules over the post-GSPMD partitioned HLO of the multi-device
 specimens — needs enough devices; CI forces 8 virtual CPU devices so
-the tier runs on every push). The recompile pass needs a recorded
-run's buckets: it runs only when ``--obs-dir`` is given — padding
-buckets are a runtime artifact, there is nothing to analyze statically
-without one. The trace and sharded tiers share one build/trace/lower/
-compile per specimen (:class:`~dgmc_tpu.analysis.registry.
-SpecimenCache`).
+the tier runs on every push), ``--skip-sched`` (SCH/MEM schedule &
+liveness rules over the same partitioned HLO: modeled collective
+overlap, serialized async pairs, double-buffer opportunities, static
+peak-live-byte budgets, AD-residual blowup). The recompile pass needs
+a recorded run's buckets: it runs only when ``--obs-dir`` is given —
+padding buckets are a runtime artifact, there is nothing to analyze
+statically without one. The trace, sharded, and schedule tiers share
+one build/trace/lower/compile per specimen
+(:class:`~dgmc_tpu.analysis.registry.SpecimenCache`).
 
 Exit status: 0 clean under the ``--fail-on`` policy, 1 otherwise, 2 on
 usage errors. ``--fail-on`` policies: ``new`` (default — findings not in
@@ -88,6 +91,8 @@ def build_parser():
                    help='skip the padding-bucket recompile pass')
     p.add_argument('--skip-sharded', action='store_true',
                    help='skip the sharded-HLO (SHD) tier')
+    p.add_argument('--skip-sched', action='store_true',
+                   help='skip the schedule & liveness (SCH/MEM) tier')
     p.add_argument('--source-root', default=None,
                    help='source tree to lint (default: the installed '
                         'dgmc_tpu package)')
@@ -144,7 +149,8 @@ def collect_findings(args, progress):
         out.extend(analyze_buckets(buckets, specimen='obs',
                                    compile_events=events))
     cache = None
-    if tier_on('TRC') or tier_on('SHD'):
+    if tier_on('TRC') or tier_on('SHD') or tier_on('SCH') \
+            or tier_on('MEM'):
         from dgmc_tpu.analysis.registry import SpecimenCache
         cache = SpecimenCache()
     if tier_on('TRC'):
@@ -157,6 +163,10 @@ def collect_findings(args, progress):
         out.extend(run_sharded_tier(
             cache=cache, comm_budget_bytes=args.comm_budget_bytes,
             on_progress=progress, skipped=skipped))
+    if tier_on('SCH') or tier_on('MEM'):
+        from dgmc_tpu.analysis.sched_rules import run_sched_tier
+        out.extend(run_sched_tier(cache=cache, on_progress=progress,
+                                  skipped=skipped))
     return out, skipped
 
 
@@ -173,6 +183,8 @@ def _rules_analyzed(args):
         rules -= {r for r in rules if r.startswith('RCP')}
     if args.skip_sharded:
         rules -= {r for r in rules if r.startswith('SHD')}
+    if args.skip_sched:
+        rules -= {r for r in rules if r.startswith(('SCH', 'MEM'))}
     if args.select:
         rules &= _parse_rules(args.select)
     if args.ignore:
@@ -280,8 +292,29 @@ def main(argv=None):
 
     baseline_path = args.baseline or default_baseline_path()
     if args.write_baseline:
-        preserved = _entries_not_analyzed(load_baseline(baseline_path),
-                                          args, skipped_specimens)
+        # migrate=True: rewriting is the one-shot migration path off
+        # legacy (version-1, line-hashed) baselines — the old entries
+        # are only needed to preserve unanalyzed tiers.
+        prior_version = findings_mod.baseline_version(baseline_path)
+        preserved = _entries_not_analyzed(
+            load_baseline(baseline_path, migrate=True), args,
+            skipped_specimens)
+        if prior_version == 1 and preserved:
+            # Preserved v1 entries keep legacy line-hashed fingerprints
+            # that can never match a v2 finding: the tiers/specimens
+            # this environment skipped will report as NEW wherever they
+            # DO run (CI's 8-device mesh). Say so loudly instead of
+            # letting the gate break a push later.
+            print(f'dgmc-lint: WARNING: migrated a version-1 baseline '
+                  f'while {len(preserved)} entr'
+                  f'{"y" if len(preserved) == 1 else "ies"} of '
+                  f'unanalyzed tiers/specimens had to be preserved '
+                  f'with legacy fingerprints that can never match '
+                  f'again — re-run `dgmc-lint --write-baseline` in an '
+                  f'environment that analyzes everything (e.g. under '
+                  f'XLA_FLAGS=--xla_force_host_platform_device_count=8)'
+                  f' or CI will report those findings as new',
+                  file=sys.stderr)
         write_baseline(baseline_path, found, preserved_entries=preserved)
         if not quiet:
             kept = (f' (+ {len(preserved)} preserved from tiers/'
@@ -289,7 +322,15 @@ def main(argv=None):
             print(f'dgmc-lint: wrote {len(found)} finding(s) to '
                   f'{baseline_path}{kept}')
     elif args.prune_baseline:
-        prior = load_baseline(baseline_path)
+        # NO migrate here: prune never re-records findings, so against a
+        # v1 (line-hashed) ledger every analyzed entry would read as
+        # stale and the whole reviewed debt ledger would be deleted in
+        # one command. Migration is --write-baseline's job.
+        try:
+            prior = load_baseline(baseline_path)
+        except ValueError as e:
+            print(f'dgmc-lint: {e}', file=sys.stderr)
+            return 2
         produced = {f.fingerprint for f in found}
         protected = {e['fingerprint'] for e in _entries_not_analyzed(
             prior, args, skipped_specimens)}
@@ -309,7 +350,14 @@ def main(argv=None):
                 print(f'  - {e.get("rule")} {e.get("where")}')
         return 0
 
-    baseline = load_baseline(baseline_path)
+    try:
+        baseline = load_baseline(baseline_path)
+    except ValueError as e:
+        # Legacy (line-hashed) or unknown baseline version: checking
+        # against it would silently report everything as new — surface
+        # the migration instruction as a usage error instead.
+        print(f'dgmc-lint: {e}', file=sys.stderr)
+        return 2
     new, suppressed = split_by_baseline(reported, baseline)
 
     report = {

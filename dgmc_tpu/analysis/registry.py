@@ -41,17 +41,23 @@ class Specimen:
     optional ``'donate_argnums'`` (tuple — run the donation-aliasing
     rule), ``'prejitted'`` (the callable is already jitted, e.g. with
     its own ``in_shardings``), ``'corr_bytes'`` (full correspondence-
-    matrix payload in bytes — arms the SHD302 replication rule) and
+    matrix payload in bytes — arms the SHD302 replication rule),
     ``'comm_budget_bytes'`` (per-step collective-byte budget — arms
     SHD304, recorded here like the recompile pass's compiles-per-bucket
-    budget).
+    budget), ``'overlap_budget'`` (minimum modeled collective overlap
+    fraction — arms SCH402), ``'peak_bytes_budget'`` (static peak-live
+    byte budget — arms MEM404), and ``'stream_full'``/``'stream_chunk'``
+    (the streamed axis and its chunk — arm the MEM405 residual
+    accounting).
     """
     name: str
     build: Callable[[], Dict]
     #: None = always runnable; else the minimum jax.devices() count.
     min_devices: int = 0
     #: Which lint tiers analyze this specimen: ``'trace'`` (jaxpr +
-    #: donation rules) and/or ``'shd'`` (post-GSPMD sharded-HLO rules).
+    #: donation rules), ``'shd'`` (post-GSPMD sharded-HLO rules), and/or
+    #: ``'sched'`` (schedule & liveness rules over the same compiled
+    #: text).
     tiers: Tuple[str, ...] = ('trace',)
 
 
@@ -319,7 +325,29 @@ def _streamed_train_step_specimen():
     parallel.streamed_train_step``; 64 KiB holds ~8x headroom for
     layout jitter while still failing on a structural regression (one
     extra all-gathered activation at fixture scale adds tens of KiB;
-    an S-sized replication additionally trips SHD302)."""
+    an S-sized replication additionally trips SHD302).
+
+    Schedule & liveness budgets (the SCH402/MEM404/MEM405 face of
+    ROADMAP item 4, measured via ``python -m dgmc_tpu.analysis.
+    hlo_sched --specimens parallel.streamed_train_step``): the modeled
+    collective overlap fraction of the compiled fixture is **0.1353**
+    (38 collectives, 21 dependence-serialized — the strictly-serial
+    chunk loop; a double-buffered rewrite RAISES this), so
+    ``overlap_budget=0.12`` fails CI the moment an edit chains the loop
+    further; the static peak-live bound is **29,596 B**, so
+    ``peak_bytes_budget=40 KiB`` (~1.35x headroom for layout jitter)
+    fails on a structural blowup — the fixture-scale face of the
+    SCALE_r07 1.04 GiB/device claim. ``stream_full``/``stream_chunk``
+    mirror the ``streamed_rules(stream_chunk=8)`` config over the
+    n_s=16 source axis, arming MEM405's residual accounting — with
+    ``residual_min_bytes=4 KiB``, scaled to the fixture (its largest
+    LEGITIMATE loop-carried buffer is 1,536 B, so any full-axis carry
+    >= 4 KiB here is anomalous; the default GiB-class floor would make
+    the rule inert at this scale). ``double_buffer_min_bytes`` keeps
+    its default deliberately: the fixture's per-chunk fetches are
+    KiB-scale and SCH403 firing on the known-single-buffered loop
+    would add a standing INFO finding — lower it alongside the
+    pipelining rewrite to surface the sites it should fix."""
     def build():
         import jax
 
@@ -345,7 +373,12 @@ def _streamed_train_step_specimen():
                 'prejitted': True,
                 'donate_argnums': (0,),
                 'corr_bytes': b * n_s * n_t * 4,
-                'comm_budget_bytes': 64 << 10}
+                'comm_budget_bytes': 64 << 10,
+                'overlap_budget': 0.12,
+                'peak_bytes_budget': 40 << 10,
+                'stream_full': n_s,
+                'stream_chunk': 8,
+                'residual_min_bytes': 4 << 10}
     return build
 
 
@@ -394,20 +427,48 @@ def default_specimens() -> List[Specimen]:
         Specimen('ops.segment_sum', _segment_specimen()),
         Specimen('parallel.sharded_train_step',
                  _sharded_train_step_specimen(), min_devices=2,
-                 tiers=('trace', 'shd')),
+                 tiers=('trace', 'shd', 'sched')),
         Specimen('parallel.sharded_forward_rows',
                  _sharded_forward_rows_specimen(), min_devices=4,
-                 tiers=('shd',)),
+                 tiers=('shd', 'sched')),
         Specimen('parallel.sharded_train_step_pairs2',
                  _sharded_train_step_pairs_specimen(), min_devices=4,
-                 tiers=('shd',)),
+                 tiers=('shd', 'sched')),
         Specimen('parallel.streamed_train_step',
                  _streamed_train_step_specimen(), min_devices=4,
-                 tiers=('shd',)),
+                 tiers=('shd', 'sched')),
         Specimen('parallel.sharded_topk_cols',
                  _sharded_topk_cols_specimen(), min_devices=2,
-                 tiers=('shd',)),
+                 tiers=('shd', 'sched')),
     ]
+
+
+def iter_runnable_specimens(tier, *, names=None, specimens=None,
+                            on_progress=None, skipped=None):
+    """The one specimen-selection loop every compiled tier shares:
+    yields each registered specimen belonging to ``tier`` that this
+    process has enough devices for, reporting skips via ``on_progress``
+    and appending them to ``skipped`` (the baseline writers'
+    preservation signal). ``names`` optionally restricts to a name set
+    (the report CLIs' ``--specimens``). One implementation — the SCH/MEM
+    tier driver and the schedule-report artifact must never disagree
+    about WHICH programs were analyzed."""
+    import jax
+    n_dev = len(jax.devices())
+    for spec in (specimens if specimens is not None
+                 else default_specimens()):
+        if tier not in spec.tiers:
+            continue
+        if names is not None and spec.name not in names:
+            continue
+        if spec.min_devices and n_dev < spec.min_devices:
+            if on_progress:
+                on_progress(f'skip {spec.name} (needs >= '
+                            f'{spec.min_devices} devices, have {n_dev})')
+            if skipped is not None and spec.name not in skipped:
+                skipped.append(spec.name)
+            continue
+        yield spec
 
 
 class SpecimenArtifacts:
@@ -535,22 +596,11 @@ def run_trace_tier(specimens: Optional[List[Specimen]] = None, *,
     appended to ``skipped`` when given — baseline writers use that to
     preserve the skipped specimens' prior entries). ``cache`` shares
     each specimen's single trace/lowering with the other tiers."""
-    import jax
     findings = []
-    n_dev = len(jax.devices())
     cache = cache if cache is not None else SpecimenCache()
-    for spec in (specimens if specimens is not None
-                 else default_specimens()):
-        if 'trace' not in spec.tiers:
-            continue
-        if spec.min_devices and n_dev < spec.min_devices:
-            if on_progress:
-                on_progress(f'skip {spec.name} '
-                            f'(needs >= {spec.min_devices} devices, '
-                            f'have {n_dev})')
-            if skipped is not None:
-                skipped.append(spec.name)
-            continue
+    for spec in iter_runnable_specimens('trace', specimens=specimens,
+                                        on_progress=on_progress,
+                                        skipped=skipped):
         if on_progress:
             on_progress(f'trace {spec.name}')
         findings.extend(run_specimen(spec, const_bytes=const_bytes,

@@ -1,0 +1,355 @@
+"""SCH/MEM tier: schedule & liveness rules over post-GSPMD HLO.
+
+The SHD tier (PR 8) reads *what* a partitioned program communicates;
+this tier reads *when* and *how much lives*: the schedule model
+(:mod:`~dgmc_tpu.analysis.hlo_sched` — dependency DAG, async intervals,
+conservative two-stream list schedule) and the liveness model
+(:mod:`~dgmc_tpu.analysis.hlo_liveness` — static peak-live bytes with
+region stacking). Five rules run over each registered sched-tier
+specimen's compiled HLO:
+
+``SCH401`` serialized-async-collective (error)
+    An async ``-start``/``-done`` pair inside a while body with NO
+    compute between start and done in program order: the program paid
+    for asynchrony and then immediately blocked on it. The streamed-S
+    shard-boundary collective-permutes exist to overlap the per-tile
+    top-k compute — a pair that serializes is the chunk loop regressing
+    to lockstep.
+``SCH402`` overlap-budget (warning)
+    The program's modeled collective overlap fraction fell below the
+    specimen's recorded ``overlap_budget`` (declared in the registry
+    beside SHD304's ``comm_budget_bytes``). The model is dependency
+    slack, not wall clock: a drop means an edit added a dependence that
+    FORCES serialization, whatever the runtime does.
+``SCH403`` double-buffer-opportunity (info)
+    A fetch-class op (gather / dynamic-slice / collective-permute)
+    inside a while body that is on the body's critical path, feeds the
+    body's compute, re-issues off the loop carry every iteration, and
+    moves at least ``double_buffer_min_bytes`` — the classic
+    single-buffered chunk loop ROADMAP item 4 wants pipelined
+    (double-buffer the source chunks so iteration k+1's fetch overlaps
+    iteration k's compute).
+``MEM404`` peak-budget (error)
+    Static peak-live bytes exceed the specimen's recorded
+    ``peak_bytes_budget``. The streamed specimen's budget is the static
+    face of SCALE_r07's 1.04 GiB/device claim: a regression fails CI
+    before any scale run is launched.
+``MEM405`` residual-blowup (error)
+    A loop-carried buffer whose shape scales with the FULL streamed axis
+    (``stream_full``) instead of the chunk (``stream_chunk``) and whose
+    bytes clear ``residual_min_bytes`` — the PR 9 class (per-tile select
+    masks stacked as backward residuals, 2 GiB/device at 2^20 targets
+    for a search whose real state was ``[rows, k]``) as a lint.
+"""
+
+import dataclasses
+import math
+from typing import List, Optional
+
+from dgmc_tpu.analysis.findings import (Finding, Severity,
+                                        disambiguate_contexts)
+from dgmc_tpu.analysis.hlo_comm import HloModule, parse_hlo_module
+from dgmc_tpu.analysis.hlo_liveness import (module_peak,
+                                            while_carry_elements)
+from dgmc_tpu.analysis.hlo_sched import (FETCH_OPS, module_schedules,
+                                         schedule_summary)
+from dgmc_tpu.analysis.shd_rules import _loc, _pow2_bucket
+
+__all__ = ['SchedContext', 'analyze_schedule_hlo', 'run_sched_tier',
+           'check_serialized_async', 'check_overlap_budget',
+           'check_double_buffer', 'check_peak_budget',
+           'check_residual_blowup']
+
+
+@dataclasses.dataclass
+class SchedContext:
+    """Provenance prefix + budgets for one partitioned program."""
+    specimen: str = 'program'
+    #: Minimum modeled collective overlap fraction (0..1); SCH402 runs
+    #: only with it set (recorded per specimen like SHD304's budget).
+    overlap_budget: Optional[float] = None
+    #: Static peak-live byte budget; MEM404 runs only with it set.
+    peak_bytes_budget: Optional[int] = None
+    #: Full length of the streamed axis and the chunk it streams in;
+    #: MEM405 runs only with both set.
+    stream_full: Optional[int] = None
+    stream_chunk: Optional[int] = None
+    #: A loop-carried full-axis buffer below this is not worth an ERROR
+    #: (fixture-scale specimens carry tiny legitimate state; the defect
+    #: class is measured in GiB).
+    residual_min_bytes: int = 1 << 20
+    #: A serialized in-loop fetch below this is not worth a report.
+    double_buffer_min_bytes: int = 1 << 20
+
+
+# ---------------------------------------------------------------------------
+# Rules
+# ---------------------------------------------------------------------------
+
+
+def check_serialized_async(module: HloModule, ctx: SchedContext,
+                           scheds=None) -> List[Finding]:
+    """SCH401: async pair in a while body with nothing overlappable
+    between start and done as written."""
+    out = []
+    if scheds is None:
+        scheds = module_schedules(module)
+    # while_bodies() order, deduped — NOT a set: finding order feeds
+    # disambiguate_contexts' occurrence ordinals, which must be the
+    # program's deterministic walk order, never hash order.
+    bodies = list(dict.fromkeys(b for _, b in module.while_bodies()))
+    for name in bodies:
+        sched = scheds.get(name)
+        if sched is None:
+            continue
+        idx = 0
+        for coll in sched.collectives:
+            if coll.program_gap_cost is None:
+                continue                      # sync op, not a pair
+            if coll.done_index is None:
+                # Start whose done lives across the loop back-edge (the
+                # pipelined/double-buffered pattern): the transfer
+                # overlaps the NEXT iteration's compute — exactly what
+                # this rule's remediation recommends, never an error.
+                continue
+            idx += 1
+            if coll.program_gap_cost > 0:
+                continue
+            op = coll.op
+            out.append(Finding(
+                rule='SCH401', severity=Severity.ERROR,
+                where=f'{ctx.specimen}:'
+                      f'{_loc(op, f"{op.opcode}#{idx - 1}")}',
+                message=(f'async `{coll.kind}` inside a loop body is '
+                         f'serialized — its -done immediately follows '
+                         f'the -start with no compute in between'),
+                detail=(f'{coll.nbytes} B in flight in computation '
+                        f'`{name}` with zero overlappable work; move '
+                        f'independent per-tile compute between the '
+                        f'start/done pair (or double-buffer the chunk '
+                        f'loop) so the transfer hides behind it'),
+                context=f'{op.opcode} {op.result_type}'))
+    return out
+
+
+def check_overlap_budget(module: HloModule, ctx: SchedContext,
+                         scheds=None) -> List[Finding]:
+    """SCH402: modeled overlap fraction under the recorded budget."""
+    if ctx.overlap_budget is None:
+        return []
+    summary = schedule_summary(module, scheds=scheds)
+    measured = summary.get('overlap_fraction')
+    if measured is None or measured >= ctx.overlap_budget:
+        return []
+    return [Finding(
+        rule='SCH402', severity=Severity.WARNING,
+        where=f'{ctx.specimen}:sched-overlap',
+        message=(f'modeled collective overlap fraction fell below the '
+                 f'recorded budget {ctx.overlap_budget} — a dependency '
+                 f'now forces serialization'),
+        detail=(f'measured {measured} over '
+                f'{summary.get("collective_count", 0)} collective(s) '
+                f'({summary.get("serialized_collectives", 0)} fully '
+                f'serialized, {summary.get("collective_bytes", 0)} B '
+                f'payload); either the serialization is intended '
+                f'(lower the overlap_budget in the registry and '
+                f're-baseline) or an edit chained the chunk loop'))]
+
+
+def check_double_buffer(module: HloModule, ctx: SchedContext,
+                        scheds=None) -> List[Finding]:
+    """SCH403: a big critical-path fetch re-issued per iteration off the
+    loop carry — the single-buffered chunk loop."""
+    out = []
+    if scheds is None:
+        scheds = module_schedules(module)
+    for w_i, (while_op, body) in enumerate(module.while_bodies()):
+        sched = scheds.get(body)
+        if sched is None:
+            continue
+        params = {i for i, s in enumerate(sched.ops)
+                  if s.op.opcode == 'parameter'}
+        # Transitive carry-derived set (ops fed by the loop state).
+        carried = set(params)
+        for s in sched.ops:
+            if any(d in carried for d in s.deps):
+                carried.add(s.index)
+        hits = 0
+        for s in sched.ops:
+            op = s.op
+            if op.opcode not in FETCH_OPS:
+                continue
+            if s.duration < ctx.double_buffer_min_bytes:
+                continue
+            if s.index not in carried or s.index not in sched.critical_ops:
+                continue
+            # Feeds compute: some compute op downstream of the fetch.
+            feeds = any(s.index in t.deps and t.stream == 'compute'
+                        for t in sched.ops)
+            if not feeds:
+                downstream = {s.index}
+                for t in sched.ops:
+                    if any(d in downstream for d in t.deps):
+                        downstream.add(t.index)
+                        if t.stream == 'compute':
+                            feeds = True
+                            break
+            if not feeds:
+                continue
+            out.append(Finding(
+                rule='SCH403', severity=Severity.INFO,
+                where=f'{ctx.specimen}:'
+                      f'{_loc(op, f"{op.opcode}#{w_i}.{hits}")}',
+                message=(f'`{op.opcode}` fetching '
+                         f'{_pow2_bucket(s.duration)} per iteration is '
+                         f'strictly serialized behind the loop-carried '
+                         f'state — double-buffer opportunity'),
+                detail=(f'the fetch sits on the critical path of loop '
+                        f'body `{body}` and feeds its compute: '
+                        f"iteration k+1's fetch cannot start until "
+                        f'iteration k finishes. Restructure the body to '
+                        f"fetch chunk k+1 while computing chunk k "
+                        f'(ROADMAP item 4) to hide the latency'),
+                context=f'{op.opcode} {op.result_type}'))
+            hits += 1
+    return out
+
+
+def check_peak_budget(module: HloModule,
+                      ctx: SchedContext) -> List[Finding]:
+    """MEM404: static peak-live bytes over the recorded budget."""
+    if not ctx.peak_bytes_budget:
+        return []
+    lv = module_peak(module)
+    if lv.peak_bytes <= ctx.peak_bytes_budget:
+        return []
+    stages = ', '.join(f'{k}: {v} B'
+                       for k, v in sorted(lv.stage_bytes().items(),
+                                          key=lambda kv: -kv[1])[:5])
+    region = (f'; +{lv.region_bytes} B inside region '
+              f'`{lv.region_name}`' if lv.region_name else '')
+    return [Finding(
+        rule='MEM404', severity=Severity.ERROR,
+        where=f'{ctx.specimen}:peak-live',
+        message=(f'static peak-live bytes {_pow2_bucket(lv.peak_bytes)} '
+                 f'exceed the recorded {ctx.peak_bytes_budget} B '
+                 f'device budget'),
+        detail=(f'exact peak {lv.peak_bytes} B at program index '
+                f'{lv.peak_index} — top stages: {stages}{region}; '
+                f'either the growth is intended (raise '
+                f'peak_bytes_budget in the registry and re-baseline) '
+                f'or a buffer began outliving its consumer'))]
+
+
+def check_residual_blowup(module: HloModule,
+                          ctx: SchedContext) -> List[Finding]:
+    """MEM405: loop-carried buffer scaling with the full streamed axis."""
+    if not ctx.stream_full or not ctx.stream_chunk:
+        return []
+    full, chunk = ctx.stream_full, ctx.stream_chunk
+    trips = math.ceil(full / chunk)
+    out = []
+    for w_i, (while_op, body) in enumerate(module.while_bodies()):
+        for dtype, dims, nbytes in while_carry_elements(while_op):
+            # rank-1 full-axis carries are excluded BY DESIGN: a 1-D
+            # [stream_full] vector is the legitimate per-row OUTPUT
+            # class (row maxima, shortlist scores) whose size is the
+            # answer, not a residual; the PR 9 blowup class is rank>=2
+            # slabs (full axis x per-chunk working set).
+            if nbytes < ctx.residual_min_bytes or len(dims) < 2:
+                continue
+            n = 1
+            for d in dims:
+                n *= d
+            # A dim IS the streamed axis only when it equals its length
+            # — `>=` would flag any big unrelated feature/hidden dim on
+            # a carried accumulator as "the corpus axis".
+            full_dim = any(d == full for d in dims)
+            stacked = (trips > 1 and dims[0] == trips
+                       and n >= full * chunk)
+            if not (full_dim or stacked):
+                continue
+            shape = f'{dtype}[{",".join(map(str, dims))}]'
+            spelling = ('carries a full streamed-axis dimension'
+                        if full_dim else
+                        f'stacks one slab per chunk (leading dim '
+                        f'{dims[0]} = trip count)')
+            out.append(Finding(
+                rule='MEM405', severity=Severity.ERROR,
+                where=f'{ctx.specimen}:'
+                      f'{_loc(while_op, f"while#{w_i}")}',
+                message=(f'loop-carried {shape} ({nbytes} B) scales '
+                         f'with the full streamed axis ({full}) instead '
+                         f'of the chunk ({chunk}) — AD-residual blowup '
+                         f'class'),
+                detail=(f'the carried buffer {spelling}; at streamed '
+                        f'scale this is the PR 9 select-mask defect '
+                        f'(2 GiB/device of residuals for a [rows, k] '
+                        f'search state). Make the producing search '
+                        f'AD-opaque (custom_jvp + stop_gradient) or '
+                        f'rematerialize in the backward pass instead '
+                        f'of carrying full-axis residuals'),
+                context=f'while carry {shape}'))
+    return out
+
+
+def analyze_schedule_hlo(hlo_text,
+                         ctx: Optional[SchedContext] = None,
+                         ) -> List[Finding]:
+    """All SCH/MEM rules over one partitioned program (parsed once)."""
+    ctx = ctx or SchedContext()
+    module = (hlo_text if isinstance(hlo_text, HloModule)
+              else parse_hlo_module(hlo_text))
+    # ONE schedule build serves all three SCH rules (the dominant cost
+    # of this tier after the specimen compile itself).
+    scheds = module_schedules(module)
+    out = []
+    out += check_serialized_async(module, ctx, scheds)
+    out += check_overlap_budget(module, ctx, scheds)
+    out += check_double_buffer(module, ctx, scheds)
+    out += check_peak_budget(module, ctx)
+    out += check_residual_blowup(module, ctx)
+    return disambiguate_contexts(out)
+
+
+# ---------------------------------------------------------------------------
+# Tier driver
+# ---------------------------------------------------------------------------
+
+
+def run_sched_tier(specimens=None, *, cache=None, on_progress=None,
+                   skipped=None) -> List[Finding]:
+    """Compile every sched-registered specimen under its mesh (reusing
+    the lint run's shared SpecimenCache lowerings — the same compiled
+    text the SHD tier read) and run the SCH/MEM rules. Mesh specimens
+    below the process's device count are skipped and reported, like the
+    other compiled tiers."""
+    from dgmc_tpu.analysis.registry import (SpecimenCache,
+                                            iter_runnable_specimens)
+
+    cache = cache if cache is not None else SpecimenCache()
+    findings = []
+    for spec in iter_runnable_specimens('sched', specimens=specimens,
+                                        on_progress=on_progress,
+                                        skipped=skipped):
+        if on_progress:
+            on_progress(f'schedule {spec.name}')
+        art = cache.artifacts(spec)
+        built = art.built()
+        module = parse_hlo_module(art.compiled().as_text())
+        ctx = SchedContext(
+            specimen=spec.name,
+            overlap_budget=built.get('overlap_budget'),
+            peak_bytes_budget=built.get('peak_bytes_budget'),
+            stream_full=built.get('stream_full'),
+            stream_chunk=built.get('stream_chunk'))
+        # The byte floors default to GiB-class scale-run values; a
+        # fixture-scale specimen must scale them down with itself or
+        # the rules it arms are inert in CI (the streamed specimen
+        # declares a floor just above its largest legitimate carry).
+        if built.get('residual_min_bytes') is not None:
+            ctx.residual_min_bytes = built['residual_min_bytes']
+        if built.get('double_buffer_min_bytes') is not None:
+            ctx.double_buffer_min_bytes = built['double_buffer_min_bytes']
+        findings.extend(analyze_schedule_hlo(module, ctx))
+    return findings

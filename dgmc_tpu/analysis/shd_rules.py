@@ -48,7 +48,8 @@ import dataclasses
 import re
 from typing import List, Optional
 
-from dgmc_tpu.analysis.findings import Finding, Severity
+from dgmc_tpu.analysis.findings import (Finding, Severity,
+                                        disambiguate_contexts)
 from dgmc_tpu.analysis.hlo_comm import (HloModule, collective_schedule,
                                         parse_hlo_module)
 
@@ -137,6 +138,7 @@ def check_branch_divergence(module: HloModule,
         rendered = ' vs '.join('[' + ', '.join(s) + ']' for s in seqs)
         out.append(Finding(
             rule='SHD301', severity=Severity.ERROR,
+            context=f'conditional {rendered}',
             where=f'{ctx.specimen}:{_loc(op, f"conditional#{cond_idx}")}',
             message=(f'collective sequence diverges across conditional '
                      f'branches ({rendered}) — a collective reachable '
@@ -172,6 +174,7 @@ def check_corr_replication(module: HloModule,
         shape = f'{m.group(1)}[{m.group(2)}]'
         out.append(Finding(
             rule='SHD302', severity=Severity.ERROR,
+            context=f'{coll.kind} {shape}',
             where=f'{ctx.specimen}:{_loc(coll, coll.kind)}',
             message=(f'`{coll.kind}` materializes a full '
                      f'correspondence-shaped tensor ({shape}) — '
@@ -198,6 +201,7 @@ def check_reshard_churn(module: HloModule,
         kinds = sorted({c.kind for c in resh})
         out.append(Finding(
             rule='SHD303', severity=Severity.WARNING,
+            context=f'while {"/".join(kinds)}',
             where=f'{ctx.specimen}:{_loc(while_op, f"while#{i}")}',
             message=(f'resharding churn inside a loop body '
                      f'({"/".join(kinds)} round-trip) — the layout is '
@@ -297,6 +301,7 @@ def check_precision_contract(module: HloModule,
             # which would churn the fingerprint.
             out.append(Finding(
                 rule='SHD305', severity=Severity.ERROR,
+                context=f'{op.opcode} {op.result_type}',
                 where=f'{ctx.specimen}:'
                       f'{_loc(op, f"{op.opcode}#{hits}")}',
                 message=message,
@@ -322,7 +327,7 @@ def analyze_sharded_hlo(hlo_text: str,
     out += check_reshard_churn(module, ctx)
     out += check_comm_budget(module, ctx)
     out += check_precision_contract(module, ctx)
-    return out
+    return disambiguate_contexts(out)
 
 
 # ---------------------------------------------------------------------------
@@ -339,24 +344,14 @@ def run_sharded_tier(specimens=None, *, cache=None,
     specimens below the process's device count are skipped (reported,
     and appended to ``skipped`` so baseline writers preserve their
     prior entries)."""
-    import jax
-
-    from dgmc_tpu.analysis.registry import SpecimenCache, default_specimens
+    from dgmc_tpu.analysis.registry import (SpecimenCache,
+                                            iter_runnable_specimens)
 
     cache = cache if cache is not None else SpecimenCache()
     findings = []
-    n_dev = len(jax.devices())
-    for spec in (specimens if specimens is not None
-                 else default_specimens()):
-        if 'shd' not in spec.tiers:
-            continue
-        if spec.min_devices and n_dev < spec.min_devices:
-            if on_progress:
-                on_progress(f'skip {spec.name} (needs >= '
-                            f'{spec.min_devices} devices, have {n_dev})')
-            if skipped is not None and spec.name not in skipped:
-                skipped.append(spec.name)
-            continue
+    for spec in iter_runnable_specimens('shd', specimens=specimens,
+                                        on_progress=on_progress,
+                                        skipped=skipped):
         if on_progress:
             on_progress(f'sharded-hlo {spec.name}')
         art = cache.artifacts(spec)

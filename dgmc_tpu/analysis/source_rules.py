@@ -29,10 +29,12 @@ descended into, and pointing it at a ``.pyc`` (or anything inside
 """
 
 import ast
+import dataclasses
 import os
 from typing import Iterator, List, Optional, Sequence
 
-from dgmc_tpu.analysis.findings import Finding, Severity
+from dgmc_tpu.analysis.findings import (Finding, Severity,
+                                        disambiguate_contexts)
 
 _JIT_NAMES = {'jit'}          # bare `jit` (from jax import jit)
 _NP_MODULES = {'np', 'numpy', 'onp'}
@@ -281,7 +283,26 @@ def lint_source_file(path: str, rel: Optional[str] = None) -> List[Finding]:
     out += _check_host_syncs(tree, rel)
     out += _check_jit_in_loop(tree, rel)
     out += _check_static_arg_hashability(tree, rel)
-    return out
+    return disambiguate_contexts(_with_line_context(f, src) for f in out)
+
+
+def _with_line_context(finding: Finding, src: str) -> Finding:
+    """Attach the flagged line's stripped text as the finding's
+    ``context`` — the line-number-independent fingerprint discriminator
+    (findings.py): an edit above the line relocates the finding without
+    churning the baseline, while a change to the flagged statement
+    itself releases the suppression."""
+    try:
+        lineno = int(finding.where.rsplit(':', 1)[1])
+    except (IndexError, ValueError):
+        return finding
+    lines = src.splitlines()
+    if not 1 <= lineno <= len(lines):
+        return finding
+    text = lines[lineno - 1].strip()
+    if not text:
+        return finding
+    return dataclasses.replace(finding, context=text)
 
 
 def iter_source_files(root: str) -> Iterator[str]:

@@ -51,13 +51,15 @@ import math
 import re
 import sys
 
-# The collective accounting (op table, byte counting, dtype widths)
-# lives in the shared walker; re-exported here so existing callers of
-# ``cost.collective_table`` / ``cost.COLLECTIVE_OPS`` keep working.
+# The collective accounting (op table, byte counting, dtype widths) and
+# the stage bucketing live in the shared walker; re-exported here so
+# existing callers of ``cost.collective_table`` / ``cost.STAGE_NAMES``
+# / ``cost.stage_of`` keep working.
 from dgmc_tpu.analysis.hlo_comm import (COLLECTIVE_OPS,  # noqa: F401
                                         DTYPE_BYTES as _DTYPE_BYTES,
-                                        collective_table, hlo_shape_bytes,
-                                        mlir_tensor_info)
+                                        STAGE_NAMES, collective_table,
+                                        hlo_shape_bytes, mlir_tensor_info,
+                                        stage_of)
 
 __all__ = [
     'PEAK_FLOPS', 'CPU_PEAK_FLOPS', 'STAGE_NAMES', 'COLLECTIVE_OPS',
@@ -86,13 +88,6 @@ PEAK_FLOPS = {
 #: that is tiny but nonzero and comparable run over run, which is all
 #: ``obs.diff``'s MFU gate needs.
 CPU_PEAK_FLOPS = 48e9
-
-#: Pipeline stages the attribution buckets ops into, innermost-scope
-#: wins (``psi2`` is nested inside ``consensus_iter``; ``loss`` and
-#: ``optimizer`` come from ``train/steps.py``).
-STAGE_NAMES = ('psi1', 'psi2', 'initial_corr', 'topk', 'consensus_iter',
-               'loss', 'optimizer')
-
 
 def peak_flops_entry(device=None):
     """``{'peak_flops', 'ref', 'source'}`` for ``device`` (default: the
@@ -135,18 +130,6 @@ def _tensor_info(dims, dtype):
     """(element_count, bytes) for one parsed ``tensor<...>`` type —
     the shared walker's MLIR-type accounting."""
     return mlir_tensor_info(dims or '', dtype)
-
-
-def stage_of(op_name):
-    """Map one op-name scope path to its pipeline stage (innermost
-    matching scope wins; ``'other'`` when none matches). Transposed
-    (backward) ops carry the primal scope inside ``transpose(...)``
-    segments, so they attribute to the same stage."""
-    for seg in reversed(op_name.split('/')):
-        for stage in STAGE_NAMES:
-            if stage in seg:
-                return stage
-    return 'other'
 
 
 def _loc_names(asm):
@@ -306,10 +289,51 @@ def cost_summary(target, *args, step_time_s=None):
             stages = _compiled_stage_bytes(text)
             if stages:
                 out['stages'] = stages
+            out.update(_schedule_fields(text))
     if out.get('flops') and out.get('bytes'):
         out['arith_intensity'] = round(out['flops'] / out['bytes'], 3)
     if step_time_s:
         out['step_time_s'] = step_time_s
+    return out
+
+
+def _schedule_fields(hlo_text):
+    """Schedule/liveness account of one compiled (post-GSPMD) program:
+    ``overlap_fraction`` (payload-weighted modeled collective overlap,
+    omitted when the program moves nothing), ``critical_path_share``,
+    and ``static_peak_bytes`` (the liveness model's per-device bound) —
+    the same models the SCH/MEM lint tier gates on
+    (:mod:`dgmc_tpu.analysis.hlo_sched` /
+    :mod:`dgmc_tpu.analysis.hlo_liveness`), so ``efficiency.json`` and
+    the lint can never disagree about what a program overlaps or
+    holds."""
+    out = {}
+    try:
+        from dgmc_tpu.analysis.hlo_comm import parse_hlo_module
+        from dgmc_tpu.analysis.hlo_liveness import peak_summary
+        from dgmc_tpu.analysis.hlo_sched import schedule_summary
+        module = parse_hlo_module(hlo_text)   # ONE parse for both models
+    except Exception as e:
+        # A model error must leave a breadcrumb, not a bare missing
+        # field: obs.diff reports a vanished account as REGRESSION, and
+        # "missing from candidate" with no cause is undiagnosable.
+        return {'schedule_error': f'{type(e).__name__}: {e}'}
+    try:
+        sched = schedule_summary(module)
+        if sched.get('overlap_fraction') is not None:
+            out['overlap_fraction'] = sched['overlap_fraction']
+        if sched.get('critical_path_share') is not None:
+            out['critical_path_share'] = sched['critical_path_share']
+    except Exception as e:
+        out['schedule_error'] = f'{type(e).__name__}: {e}'
+    try:
+        # Independent of the schedule model: a failure in one must not
+        # discard the other's already-computed account.
+        peak = peak_summary(module)
+        if peak.get('static_peak_bytes'):
+            out['static_peak_bytes'] = peak['static_peak_bytes']
+    except Exception as e:
+        out['liveness_error'] = f'{type(e).__name__}: {e}'
     return out
 
 
@@ -468,6 +492,13 @@ def render_costs(payload):
             lines.append(f'    MFU                  {p["mfu"]:.4%} '
                          f'(step {st * 1e3:.3f} ms)' if st else
                          f'    MFU                  {p["mfu"]:.4%}')
+        if p.get('overlap_fraction') is not None:
+            lines.append(f'    overlap / cp-share   '
+                         f'{p["overlap_fraction"]:.4f} / '
+                         f'{p.get("critical_path_share", 0):.4f}')
+        if p.get('static_peak_bytes') is not None:
+            lines.append(f'    static peak          '
+                         f'{_fmt_num(p["static_peak_bytes"])}B')
         for stage, row in (p.get('stages') or {}).items():
             lines.append(f'    stage {stage:<15} '
                          f'flops {_fmt_num(row.get("flops")):>8}  '

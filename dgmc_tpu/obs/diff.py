@@ -51,6 +51,17 @@ regression thresholds:
   ``--max-intensity-regression`` fails (a program that got
   byte-heavier per FLOP slid down the roofline even if wall-clock
   noise hides it); lost-from-candidate fails like MFU.
+- **collective overlap** — the headline modeled overlap fraction
+  (``efficiency.json``, from the schedule model
+  ``analysis/hlo_sched.py``) dropping below the ``--min-overlap``
+  floor fails: the chunk loop serialized, whatever wall-clock noise
+  says. An absolute floor, not a ratio — 0.0 is a meaningful value
+  and ratios against it are not. Lost-from-candidate fails.
+- **static peak bytes** — relative increase of the liveness model's
+  static peak-live bound (``efficiency.json``) above
+  ``--max-peak-regression`` fails; unlike the runtime memory row it
+  needs no matching measurement source, because the bound is computed
+  from the compiled program alone. Lost-from-candidate fails.
 - **skew** — the device step-time skew ratio (``aggregate.json``, see
   ``obs.aggregate``) growing past ``--max-skew-regression`` fails;
   runs without aggregation skip the row (the artifact is produced by a
@@ -79,6 +90,11 @@ DEFAULT_THRESHOLDS = {
     'intensity': 0.40,
     'skew': 0.50,
     'restarts': 0,
+    #: Absolute overlap-fraction floor; None = gate off unless asked
+    #: (a run whose programs legitimately model 0.0 must not fail by
+    #: default).
+    'min_overlap': None,
+    'static_peak': 0.25,
 }
 
 
@@ -239,6 +255,51 @@ def diff_runs(a, b, thresholds=None, allow_kernel_fallback=False):
             gate('arith_intensity', ai_a, ai_b, round(d, 4),
                  thr['intensity'], -d > thr['intensity'])
 
+    # -- modeled collective overlap ---------------------------------------
+    # An ABSOLUTE floor, not a ratio gate: 0.0 overlap is a meaningful
+    # value (a fully serial program) and fractional change against it is
+    # undefined. A candidate that lost the account the baseline had
+    # fails like MFU; the floor itself only gates when configured.
+    ov_a, ov_b = a.get('overlap_fraction'), b.get('overlap_fraction')
+    floor = thr.get('min_overlap')
+    if ov_a is not None and ov_b is None:
+        rows.append(_row('overlap_fraction', ov_a, ov_b, None, floor,
+                         'REGRESSION', 'missing from candidate'))
+    elif ov_b is not None and floor is not None:
+        gate('overlap_fraction', ov_a, ov_b,
+             None if ov_a is None else round(ov_b - ov_a, 4), floor,
+             ov_b < floor,
+             'chunk loop serialized below the floor'
+             if ov_b < floor else '')
+    elif ov_a is not None or ov_b is not None:
+        rows.append(_row('overlap_fraction', ov_a, ov_b,
+                         None if None in (ov_a, ov_b)
+                         else round(ov_b - ov_a, 4), floor, 'info',
+                         'no --min-overlap floor configured'))
+
+    # -- static peak-live bytes -------------------------------------------
+    # The liveness model's bound needs no matching measurement source
+    # (it is computed from the compiled program alone), so unlike the
+    # runtime memory row it always compares when both runs carry it.
+    pk_a, pk_b = a.get('static_peak_bytes'), b.get('static_peak_bytes')
+    if pk_a is not None and pk_b is None:
+        rows.append(_row('static_peak_bytes', pk_a, pk_b, None,
+                         thr['static_peak'], 'REGRESSION',
+                         'missing from candidate'))
+    elif pk_a is None and pk_b is not None:
+        rows.append(_row('static_peak_bytes', pk_a, pk_b, None,
+                         thr['static_peak'], 'skipped',
+                         'missing from baseline'))
+    elif pk_a is not None:
+        d = _rel(pk_a, pk_b)
+        if d is None:
+            rows.append(_row('static_peak_bytes', pk_a, pk_b, None,
+                             thr['static_peak'], 'skipped',
+                             'zero baseline'))
+        else:
+            gate('static_peak_bytes', pk_a, pk_b, round(d, 4),
+                 thr['static_peak'], d > thr['static_peak'])
+
     # -- multi-device skew ------------------------------------------------
     sk_a = (a.get('skew') or {}).get('step_time_ratio')
     sk_b = (b.get('skew') or {}).get('step_time_ratio')
@@ -386,6 +447,21 @@ def main(argv=None):
                         help='allowed fractional decrease of the headline '
                              'achieved arithmetic intensity (FLOPs/byte, '
                              'efficiency.json; default %(default)s)')
+    parser.add_argument('--min-overlap', type=float, default=None,
+                        metavar='FRAC',
+                        help='absolute floor on the headline modeled '
+                             'collective overlap fraction '
+                             '(efficiency.json, analysis/hlo_sched.py); '
+                             'a candidate below it serialized the chunk '
+                             'loop (default: floor off; a lost overlap '
+                             'account still fails)')
+    parser.add_argument('--max-peak-regression', type=float,
+                        default=DEFAULT_THRESHOLDS['static_peak'],
+                        metavar='FRAC',
+                        help='allowed fractional increase of the static '
+                             'peak-live-bytes bound (efficiency.json, '
+                             'analysis/hlo_liveness.py; '
+                             'default %(default)s)')
     parser.add_argument('--max-skew-regression', type=float,
                         default=DEFAULT_THRESHOLDS['skew'],
                         metavar='FRAC',
@@ -431,6 +507,8 @@ def main(argv=None):
             'intensity': args.max_intensity_regression,
             'skew': args.max_skew_regression,
             'restarts': args.max_restarts_regression,
+            'min_overlap': args.min_overlap,
+            'static_peak': args.max_peak_regression,
         },
         allow_kernel_fallback=args.allow_kernel_fallback)
 

@@ -241,6 +241,19 @@ def summarize(run):
                     break
         if ai is not None:
             out['arith_intensity'] = ai
+        # Headline schedule/liveness fields (same convention): the
+        # modeled collective overlap fraction and the static peak-live
+        # bound from efficiency.json, so obs.diff can gate "the chunk
+        # loop serialized" / "peak memory regressed" from artifacts.
+        for key in ('overlap_fraction', 'static_peak_bytes'):
+            val = ts.get(key)
+            if val is None:
+                for p in eff.get('programs', {}).values():
+                    if p.get(key) is not None:
+                        val = p[key]
+                        break
+            if val is not None:
+                out[key] = val
 
     hang = run.get('hang')
     if hang:
@@ -397,6 +410,14 @@ def render(run):
                      f'{eff.get("peak_flops_ref")}]')
         if s.get('mfu') is not None:
             lines.append(f'  MFU              {s["mfu"]:.4%}')
+        if s.get('overlap_fraction') is not None:
+            lines.append(f'  overlap          '
+                         f'{s["overlap_fraction"]:.4f} (modeled '
+                         f'collective overlap)')
+        if s.get('static_peak_bytes') is not None:
+            lines.append(f'  static peak      '
+                         f'{_fmt_bytes(s["static_peak_bytes"])} '
+                         f'(liveness bound)')
         for name, p in eff.get('programs', {}).items():
             if 'error' in p:
                 lines.append(f'  {name}: cost unavailable ({p["error"]})')

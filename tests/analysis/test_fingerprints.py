@@ -1,0 +1,236 @@
+"""Line-number-independent fingerprints (baseline v2): relocation keeps
+a suppression, changed code releases it, and the one-shot migration off
+v1 ledgers is enforced.
+
+PRs 6 and 9 each churned 3 TRC005 baseline entries on pure line
+relocations — edits ABOVE the finding that moved its line without
+touching the flagged statement. The v2 fingerprint hashes the
+line-stripped ``where`` plus a normalized context snippet (source line
+text / HLO op kind+shape) instead, pinned here end to end.
+"""
+
+import json
+import os
+import textwrap
+
+import pytest
+
+from dgmc_tpu.analysis.findings import (Finding, Severity, load_baseline,
+                                        write_baseline)
+from dgmc_tpu.analysis.lint import main as lint_main
+from dgmc_tpu.analysis.source_rules import lint_source_tree
+
+SRC = textwrap.dedent('''\
+    import jax
+
+    def build(fns):
+        out = []
+        for f in fns:
+            out.append(jax.jit(f))
+        return out
+''')
+
+
+def test_fingerprint_ignores_where_line_number():
+    a = Finding(rule='TRC005', severity=Severity.INFO,
+                where='spec:dgmc_tpu/ops/graph.py:101', message='m',
+                context='return jax.ops.segment_sum(m, r)')
+    b = Finding(rule='TRC005', severity=Severity.INFO,
+                where='spec:dgmc_tpu/ops/graph.py:202', message='m',
+                context='return jax.ops.segment_sum(m, r)')
+    assert a.fingerprint == b.fingerprint
+    # Different context at the same file = a different finding.
+    c = Finding(rule='TRC005', severity=Severity.INFO,
+                where='spec:dgmc_tpu/ops/graph.py:101', message='m',
+                context='return other_scatter(m, r)')
+    assert c.fingerprint != a.fingerprint
+
+
+def test_moving_a_source_finding_keeps_its_fingerprint(tmp_path):
+    """End to end: inserting lines ABOVE a finding relocates it without
+    churning the fingerprint — the exact edit class that invalidated 3
+    baseline entries in PRs 6 and 9."""
+    root_a = tmp_path / 'a' / 'pkg'
+    root_b = tmp_path / 'b' / 'pkg'
+    for root in (root_a, root_b):
+        root.mkdir(parents=True)
+    (root_a / 'mod.py').write_text(SRC)
+    (root_b / 'mod.py').write_text('# a new comment\n# another\n' + SRC)
+    (fa,) = lint_source_tree(str(root_a))
+    (fb,) = lint_source_tree(str(root_b))
+    assert fa.rule == fb.rule == 'SRC103'
+    assert fa.where != fb.where                # the line DID move
+    assert fa.context == fb.context == 'out.append(jax.jit(f))'
+    assert fa.fingerprint == fb.fingerprint
+
+
+def test_editing_the_flagged_line_releases_the_fingerprint(tmp_path):
+    root_a = tmp_path / 'a' / 'pkg'
+    root_b = tmp_path / 'b' / 'pkg'
+    for root in (root_a, root_b):
+        root.mkdir(parents=True)
+    (root_a / 'mod.py').write_text(SRC)
+    (root_b / 'mod.py').write_text(
+        SRC.replace('out.append(jax.jit(f))',
+                    'out.append(jax.jit(f, donate_argnums=(0,)))'))
+    (fa,) = lint_source_tree(str(root_a))
+    (fb,) = lint_source_tree(str(root_b))
+    assert fa.where == fb.where                # same line number...
+    assert fa.fingerprint != fb.fingerprint    # ...different statement
+
+
+def test_baseline_roundtrip_suppresses_across_relocation(tmp_path):
+    """The CLI path: baseline written against tree A suppresses the
+    relocated finding in tree B with zero new findings."""
+    root_a = tmp_path / 'a' / 'pkg'
+    root_b = tmp_path / 'b' / 'pkg'
+    for root in (root_a, root_b):
+        root.mkdir(parents=True)
+    (root_a / 'mod.py').write_text(SRC)
+    (root_b / 'mod.py').write_text('# moved\n' * 7 + SRC)
+    baseline = str(tmp_path / 'bl.json')
+    args = ['--skip-trace', '--skip-recompile', '--skip-sharded',
+            '--skip-sched', '--baseline', baseline]
+    assert lint_main(args + ['--source-root', str(root_a),
+                             '--write-baseline']) == 0
+    assert lint_main(args + ['--source-root', str(root_b),
+                             '--fail-on', 'new']) == 0
+
+
+def test_v1_baseline_check_is_a_migration_error(tmp_path, capsys):
+    """Checking against a legacy line-hashed ledger must not silently
+    un-suppress everything — it exits 2 naming the migration."""
+    baseline = tmp_path / 'bl.json'
+    baseline.write_text(json.dumps({
+        'version': 1, 'tool': 'dgmc-lint',
+        'findings': [{'rule': 'TRC005', 'severity': 'info',
+                      'where': 'x:dgmc_tpu/y.py:1', 'message': 'm',
+                      'fingerprint': 'deadbeefdeadbeef'}]}))
+    with pytest.raises(ValueError, match='--write-baseline'):
+        load_baseline(str(baseline))
+    assert load_baseline(str(baseline), migrate=True)
+    rc = lint_main(['--skip-trace', '--skip-recompile', '--skip-sharded',
+                    '--skip-sched', '--skip-source',
+                    '--baseline', str(baseline), '--fail-on', 'new'])
+    assert rc == 2
+    assert '--write-baseline' in capsys.readouterr().err
+
+
+def test_write_baseline_migrates_v1_to_v2(tmp_path):
+    """The one-shot migration: --write-baseline over a v1 ledger
+    produces a v2 file whose re-recorded findings carry context
+    fingerprints."""
+    root = tmp_path / 'pkg'
+    root.mkdir()
+    (root / 'mod.py').write_text(SRC)
+    baseline = tmp_path / 'bl.json'
+    baseline.write_text(json.dumps({
+        'version': 1, 'tool': 'dgmc-lint', 'findings': []}))
+    rc = lint_main(['--skip-trace', '--skip-recompile', '--skip-sharded',
+                    '--skip-sched', '--source-root', str(root),
+                    '--baseline', str(baseline), '--write-baseline'])
+    assert rc == 0
+    data = json.loads(baseline.read_text())
+    assert data['version'] == 2
+    assert data['findings']
+    assert all(e.get('context') for e in data['findings'])
+
+
+def test_committed_baseline_is_v2_with_contexts():
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    path = os.path.join(repo, 'lint-baseline.json')
+    data = json.loads(open(path).read())
+    assert data['version'] == 2
+    assert len(data['findings']) == 21, \
+        'the migration must carry the same 21 reviewed findings'
+    assert all(e.get('context') for e in data['findings'])
+
+
+def test_write_baseline_helper_emits_v2(tmp_path):
+    path = str(tmp_path / 'bl.json')
+    payload = write_baseline(path, [Finding(
+        rule='SRC103', severity=Severity.WARNING, where='a.py:3',
+        message='m', context='jit(f)')])
+    assert payload['version'] == 2
+    assert load_baseline(path)
+
+
+def test_identical_duplicate_statements_get_distinct_fingerprints(
+        tmp_path):
+    """A copy-pasted duplicate of a baselined violation must NOT ride
+    the original's suppression: same rule/file/message/context gets an
+    occurrence ordinal, and the first occurrence's fingerprint stays
+    stable (relocation-safe) while the duplicate reports as new."""
+    root_a = tmp_path / 'a' / 'pkg'
+    root_b = tmp_path / 'b' / 'pkg'
+    for root in (root_a, root_b):
+        root.mkdir(parents=True)
+    (root_a / 'mod.py').write_text(SRC)
+    dup = SRC.replace('        out.append(jax.jit(f))\n',
+                      '        out.append(jax.jit(f))\n'
+                      '        out.append(jax.jit(f))\n')
+    assert dup != SRC
+    (root_b / 'mod.py').write_text(dup)
+    (fa,) = lint_source_tree(str(root_a))
+    fb1, fb2 = lint_source_tree(str(root_b))
+    assert fb1.fingerprint != fb2.fingerprint
+    assert fb2.context.endswith('#2')
+    assert fa.fingerprint == fb1.fingerprint   # original stays baselined
+    # CLI path: baseline from the single-occurrence tree suppresses one
+    # and reports exactly the duplicate as new.
+    baseline = str(tmp_path / 'bl.json')
+    args = ['--skip-trace', '--skip-recompile', '--skip-sharded',
+            '--skip-sched', '--baseline', baseline]
+    assert lint_main(args + ['--source-root', str(root_a),
+                             '--write-baseline']) == 0
+    assert lint_main(args + ['--source-root', str(root_b),
+                             '--fail-on', 'new']) == 1
+
+
+def test_prune_baseline_refuses_v1_ledger(tmp_path, capsys):
+    """--prune-baseline cannot re-record findings, so against a v1
+    ledger it must refuse (rc 2) instead of classifying every reviewed
+    entry as stale and deleting the whole ledger."""
+    baseline = tmp_path / 'bl.json'
+    original = {'version': 1, 'tool': 'dgmc-lint',
+                'findings': [{'rule': 'SRC103', 'severity': 'warning',
+                              'where': 'pkg/mod.py:6', 'message': 'm',
+                              'fingerprint': 'deadbeefdeadbeef'}]}
+    baseline.write_text(json.dumps(original))
+    rc = lint_main(['--skip-trace', '--skip-recompile', '--skip-sharded',
+                    '--skip-sched', '--skip-source',
+                    '--baseline', str(baseline), '--prune-baseline'])
+    assert rc == 2
+    assert '--write-baseline' in capsys.readouterr().err
+    assert json.loads(baseline.read_text()) == original, \
+        'refused prune must leave the ledger untouched'
+
+
+def test_partial_migration_warns_about_preserved_v1_entries(tmp_path,
+                                                           capsys):
+    """Migrating from an environment that skips a tier preserves that
+    tier's v1 entries with fingerprints that can never match again —
+    the migration must SAY so, or CI breaks on the next push."""
+    root = tmp_path / 'pkg'
+    root.mkdir()
+    (root / 'mod.py').write_text(SRC)
+    baseline = tmp_path / 'bl.json'
+    baseline.write_text(json.dumps({
+        'version': 1, 'tool': 'dgmc-lint',
+        'findings': [{'rule': 'TRC005', 'severity': 'info',
+                      'where': 'forward_dense:dgmc_tpu/x.py:1',
+                      'message': 'm',
+                      'fingerprint': 'feedfacefeedface'}]}))
+    rc = lint_main(['--skip-trace', '--skip-recompile', '--skip-sharded',
+                    '--skip-sched', '--source-root', str(root),
+                    '--baseline', str(baseline), '--write-baseline'])
+    assert rc == 0
+    err = capsys.readouterr().err
+    assert 'WARNING' in err and 'legacy fingerprints' in err
+    # A clean v2->v2 refresh with the same skips must NOT warn.
+    rc = lint_main(['--skip-trace', '--skip-recompile', '--skip-sharded',
+                    '--skip-sched', '--source-root', str(root),
+                    '--baseline', str(baseline), '--write-baseline'])
+    assert rc == 0
+    assert 'WARNING' not in capsys.readouterr().err

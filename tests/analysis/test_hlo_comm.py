@@ -165,6 +165,80 @@ def test_hlo_shape_bytes_ignores_layouts():
         4 + 8 * 8 * 2
 
 
+# A pipelined loop: the collective-permute STARTS inside the while body
+# (threaded out through the carry) and its -done lands in ENTRY after
+# the loop — the while-boundary split. The pair must count ONCE.
+SPLIT_ASYNC = (
+    '%body (carry: (s32[], f32[8])) -> (s32[], f32[8]) {\n'
+    '  %carry = (s32[], f32[8]{0}) parameter(0)\n'
+    '  %v = f32[8]{0} get-tuple-element((s32[], f32[8]{0}) %carry),'
+    ' index=1\n'
+    '  %cps = f32[8]{0} collective-permute-start(f32[8]{0} %v),'
+    ' channel_id=5, source_target_pairs={{0,1},{1,0}}\n'
+    '  %i = s32[] get-tuple-element((s32[], f32[8]{0}) %carry),'
+    ' index=0\n'
+    '  ROOT %t = (s32[], f32[8]{0}) tuple(s32[] %i, f32[8]{0} %cps)\n'
+    '}\n'
+    '\n'
+    '%cond (c: (s32[], f32[8])) -> pred[] {\n'
+    '  %c = (s32[], f32[8]{0}) parameter(0)\n'
+    '  %i.1 = s32[] get-tuple-element((s32[], f32[8]{0}) %c), index=0\n'
+    '  %lim = s32[] constant(4)\n'
+    '  ROOT %lt = pred[] compare(s32[] %i.1, s32[] %lim),'
+    ' direction=LT\n'
+    '}\n'
+    '\n'
+    'ENTRY %main (x: f32[8], i0: s32[]) -> f32[8] {\n'
+    '  %x = f32[8]{0} parameter(0)\n'
+    '  %i0 = s32[] parameter(1)\n'
+    '  %init = (s32[], f32[8]{0}) tuple(s32[] %i0, f32[8]{0} %x)\n'
+    '  %loop = (s32[], f32[8]{0}) while((s32[], f32[8]{0}) %init),'
+    ' condition=%cond, body=%body\n'
+    '  %pending = f32[8]{0}'
+    ' get-tuple-element((s32[], f32[8]{0}) %loop), index=1\n'
+    '  ROOT %cpd = f32[8]{0}'
+    ' collective-permute-done(f32[8]{0} %pending), channel_id=5\n'
+    '}\n'
+)
+
+
+def test_split_async_pair_counts_once():
+    """-start in the while body, -done in ENTRY: one collective, not
+    two (the done consumed a loop-carried start), and the schedule
+    walk agrees with the table."""
+    t = hlo_comm.collective_table(SPLIT_ASYNC)
+    assert t['ops'] == {'collective-permute': {'count': 1,
+                                              'bytes': 8 * 4}}
+    sched = hlo_comm.collective_schedule(SPLIT_ASYNC)
+    assert [c.kind for c in sched] == ['collective-permute']
+    assert sched[0].computation == 'body'
+
+
+def test_orphan_done_stands_in_for_its_pair():
+    """A -done whose -start is entirely absent (truncated dump / start
+    hidden in an unparsed region) still counts its pair once — never
+    zero."""
+    fragment = (
+        'ENTRY %main (p: f32[16]) -> f32[16] {\n'
+        '  %p = f32[16]{0} parameter(0)\n'
+        '  ROOT %agd = f32[16]{0} all-gather-done(f32[16]{0} %p),'
+        ' channel_id=9\n'
+        '}\n'
+    )
+    t = hlo_comm.collective_table(fragment)
+    assert t['ops'] == {'all-gather': {'count': 1, 'bytes': 16 * 4}}
+    sched = hlo_comm.collective_schedule(fragment)
+    assert [c.kind for c in sched] == ['all-gather']
+
+
+def test_same_computation_pair_still_counts_once():
+    """Control: the in-computation pair (the MODULE fixture's all-gather
+    start/done) is unchanged — counted at its start, done invisible."""
+    mod = hlo_comm.parse_hlo_module(MODULE)
+    assert mod.orphan_done_ids() == frozenset()
+    assert hlo_comm.collective_table(MODULE)['count'] == 4
+
+
 @pytest.mark.skipif(len(jax.devices()) < 4, reason='needs 4 devices')
 def test_real_partitioned_program_schedule():
     """A genuinely GSPMD-partitioned reduction must expose its

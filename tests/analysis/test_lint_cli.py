@@ -139,7 +139,7 @@ def test_write_baseline_preserves_unanalyzed_tiers(bad_tree, tmp_path,
                'where': 'parallel.sharded_train_step:dgmc_tpu/x.py:1',
                'message': 'm', 'fingerprint': 'feedfacefeedface'}
     (tmp_path / 'bl.json').write_text(json.dumps(
-        {'version': 1, 'findings': [sharded]}))
+        {'version': 2, 'findings': [sharded]}))
     rc, _ = _run(['--skip-trace', '--skip-recompile', '--skip-sharded',
                   '--source-root', bad_tree, '--baseline', baseline,
                   '--write-baseline'], capsys)
@@ -208,7 +208,7 @@ def test_prune_baseline_drops_only_stale_entries(bad_tree, tmp_path,
                     'where': 'forward_dense:dgmc_tpu/x.py:1',
                     'message': 'm', 'fingerprint': 'feedfacefeedface'})
     (tmp_path / 'bl.json').write_text(json.dumps(
-        {'version': 1, 'tool': 'dgmc-lint', 'findings': entries}))
+        {'version': 2, 'tool': 'dgmc-lint', 'findings': entries}))
     rc, out = _run(args + ['--prune-baseline'], capsys)
     assert rc == 0
     assert 'pruned 1 stale entry' in out
@@ -222,7 +222,7 @@ def test_prune_baseline_drops_only_stale_entries(bad_tree, tmp_path,
 
 
 def test_select_skips_unselected_tiers(bad_tree, tmp_path, capsys):
-    """--select SRC... must not pay the trace/SHD tiers' specimen
+    """--select SRC... must not pay the trace/SHD/sched tiers' specimen
     compiles (the dominant lint cost) for findings the filter would
     drop anyway."""
     rc = main(['--select', 'SRC102', '--source-root', bad_tree,
@@ -233,6 +233,21 @@ def test_select_skips_unselected_tiers(bad_tree, tmp_path, capsys):
     assert 'source tier' in err
     assert 'trace ' not in err, 'trace tier ran despite --select SRC102'
     assert 'sharded-hlo' not in err, 'SHD tier ran despite --select'
+    assert 'schedule ' not in err, 'sched tier ran despite --select'
+
+
+def test_skip_sched_drops_sch_and_mem_rules(bad_tree, tmp_path, capsys):
+    """--skip-sched removes BOTH rule families of the schedule &
+    liveness tier (SCH and MEM are one pass over the same compiled
+    specimens)."""
+    from dgmc_tpu.analysis.lint import _rules_analyzed, build_parser
+    args = build_parser().parse_args(['--skip-sched'])
+    rules = _rules_analyzed(args)
+    assert not {r for r in rules if r.startswith(('SCH', 'MEM'))}
+    assert {'SHD301', 'TRC001', 'SRC101'} <= rules
+    # And the sched-tier rules exist in the catalog for --select.
+    assert {'SCH401', 'SCH402', 'SCH403',
+            'MEM404', 'MEM405'} <= set(RULE_CATALOG)
 
 
 def test_prune_baseline_ignores_min_severity(bad_tree, tmp_path,

@@ -8,8 +8,8 @@ import jax
 
 from dgmc_tpu.analysis import (SpecimenCache, callback_equations,
                                load_baseline, lint_source_tree,
-                               run_sharded_tier, run_trace_tier,
-                               split_by_baseline)
+                               run_sched_tier, run_sharded_tier,
+                               run_trace_tier, split_by_baseline)
 from dgmc_tpu.analysis.jaxpr_rules import TraceContext, analyze_closed_jaxpr
 from dgmc_tpu.analysis.registry import default_specimens, probes_forced_off
 
@@ -20,15 +20,16 @@ BASELINE = os.path.join(REPO, 'lint-baseline.json')
 
 def test_repo_lint_matches_committed_baseline():
     """No finding outside the reviewed ledger — the exact check CI runs
-    (``dgmc-lint --fail-on new``), trace AND sharded tiers on one
-    shared specimen cache."""
+    (``dgmc-lint --fail-on new``), trace, sharded, AND schedule/liveness
+    tiers on one shared specimen cache."""
     baseline = load_baseline(BASELINE)
     assert baseline, f'missing committed baseline at {BASELINE}'
     import dgmc_tpu
     pkg = os.path.dirname(os.path.abspath(dgmc_tpu.__file__))
     cache = SpecimenCache()
     findings = (lint_source_tree(pkg) + run_trace_tier(cache=cache)
-                + run_sharded_tier(cache=cache))
+                + run_sharded_tier(cache=cache)
+                + run_sched_tier(cache=cache))
     new, suppressed = split_by_baseline(findings, baseline)
     assert not new, (
         'findings not in lint-baseline.json (fix them or re-run '

@@ -1,0 +1,442 @@
+"""SCH/MEM tier golden fixtures: each rule detected by exactly that
+rule, plus clean controls, the real-specimen drive, and the committed
+overlap/peak budgets of the streamed train step.
+
+Like the SHD fixtures, these are hand-seeded partitioned-HLO programs:
+the defect classes (an async pair that immediately blocks, a loop body
+whose fetch chains every iteration, a 33 MiB residual slab riding the
+loop carry) are read out of compiler output, wherever it came from.
+"""
+
+import jax
+import pytest
+
+from dgmc_tpu.analysis.hlo_liveness import module_peak
+from dgmc_tpu.analysis.hlo_sched import module_schedules, schedule_summary
+from dgmc_tpu.analysis.sched_rules import (SchedContext,
+                                           analyze_schedule_hlo)
+
+
+def _rules(findings):
+    return sorted({f.rule for f in findings})
+
+
+# --- SCH401: async pair serialized inside a while body ------------------
+
+SERIAL_ASYNC_LOOP = (
+    '%body (carry: (s32[], f32[64])) -> (s32[], f32[64]) {\n'
+    '  %carry = (s32[], f32[64]{0}) parameter(0)\n'
+    '  %s = f32[64]{0} get-tuple-element((s32[], f32[64]{0}) %carry),'
+    ' index=1\n'
+    '  %cps = f32[64]{0} collective-permute-start(f32[64]{0} %s),'
+    ' channel_id=1, source_target_pairs={{0,1},{1,0}}\n'
+    '  %cpd = f32[64]{0} collective-permute-done(f32[64]{0} %cps)\n'
+    '  %m = f32[64]{0} multiply(f32[64]{0} %cpd, f32[64]{0} %cpd)\n'
+    '  %i = s32[] get-tuple-element((s32[], f32[64]{0}) %carry),'
+    ' index=0\n'
+    '  ROOT %t = (s32[], f32[64]{0}) tuple(s32[] %i, f32[64]{0} %m)\n'
+    '}\n'
+    '\n'
+    '%cond (c: (s32[], f32[64])) -> pred[] {\n'
+    '  %c = (s32[], f32[64]{0}) parameter(0)\n'
+    '  %i.1 = s32[] get-tuple-element((s32[], f32[64]{0}) %c), index=0\n'
+    '  %lim = s32[] constant(8)\n'
+    '  ROOT %lt = pred[] compare(s32[] %i.1, s32[] %lim), direction=LT\n'
+    '}\n'
+    '\n'
+    'ENTRY %main (x: f32[64], i0: s32[]) -> f32[64] {\n'
+    '  %x = f32[64]{0} parameter(0)\n'
+    '  %i0 = s32[] parameter(1)\n'
+    '  %init = (s32[], f32[64]{0}) tuple(s32[] %i0, f32[64]{0} %x)\n'
+    '  %loop = (s32[], f32[64]{0}) while((s32[], f32[64]{0}) %init),'
+    ' condition=%cond, body=%body\n'
+    '  ROOT %out = f32[64]{0}'
+    ' get-tuple-element((s32[], f32[64]{0}) %loop), index=1\n'
+    '}\n'
+)
+
+# Control: per-tile compute between the start and its done — the
+# transfer hides behind it, exactly what the streamed layout wants.
+OVERLAPPED_ASYNC_LOOP = SERIAL_ASYNC_LOOP.replace(
+    '  %cpd = f32[64]{0} collective-permute-done(f32[64]{0} %cps)\n'
+    '  %m = f32[64]{0} multiply(f32[64]{0} %cpd, f32[64]{0} %cpd)\n',
+    '  %w = f32[64]{0} multiply(f32[64]{0} %s, f32[64]{0} %s)\n'
+    '  %cpd = f32[64]{0} collective-permute-done(f32[64]{0} %cps)\n'
+    '  %m = f32[64]{0} add(f32[64]{0} %cpd, f32[64]{0} %w)\n')
+
+
+def test_sch401_serialized_async_pair_in_loop():
+    findings = analyze_schedule_hlo(SERIAL_ASYNC_LOOP,
+                                    SchedContext(specimen='fix'))
+    assert _rules(findings) == ['SCH401']
+    (f,) = findings
+    assert f.severity.name == 'ERROR'
+    assert 'serialized' in f.message
+    assert f.where.startswith('fix:')
+    assert f.context.startswith('collective-permute-start')
+
+
+def test_sch401_overlapped_pair_is_clean():
+    assert analyze_schedule_hlo(OVERLAPPED_ASYNC_LOOP,
+                                SchedContext(specimen='fix')) == []
+
+
+# --- SCH402: modeled overlap under the recorded budget ------------------
+
+# A dependence-chained program: every op needs the collective's result,
+# so the model can place no compute inside its window (overlap 0.0).
+CHAINED_COMM = (
+    '%add (a: f32[], b: f32[]) -> f32[] {\n'
+    '  %a = f32[] parameter(0)\n'
+    '  %b = f32[] parameter(1)\n'
+    '  ROOT %s = f32[] add(f32[] %a, f32[] %b)\n'
+    '}\n'
+    '\n'
+    'ENTRY %main (g: f32[1024]) -> f32[1024] {\n'
+    '  %g = f32[1024]{0} parameter(0)\n'
+    '  %n = f32[1024]{0} negate(f32[1024]{0} %g)\n'
+    '  %ar = f32[1024]{0} all-reduce(f32[1024]{0} %n), channel_id=1,'
+    ' replica_groups={{0,1}}, to_apply=%add\n'
+    '  ROOT %n2 = f32[1024]{0} negate(f32[1024]{0} %ar)\n'
+    '}\n'
+)
+
+# Control: the collective and an equal-sized compute chain are
+# dependency-independent — the model overlaps them fully.
+SLACK_COMM = (
+    '%add (a: f32[], b: f32[]) -> f32[] {\n'
+    '  %a = f32[] parameter(0)\n'
+    '  %b = f32[] parameter(1)\n'
+    '  ROOT %s = f32[] add(f32[] %a, f32[] %b)\n'
+    '}\n'
+    '\n'
+    'ENTRY %main (g: f32[1024], h: f32[1024]) -> f32[1024] {\n'
+    '  %g = f32[1024]{0} parameter(0)\n'
+    '  %h = f32[1024]{0} parameter(1)\n'
+    '  %ar = f32[1024]{0} all-reduce(f32[1024]{0} %g), channel_id=1,'
+    ' replica_groups={{0,1}}, to_apply=%add\n'
+    '  %m = f32[1024]{0} multiply(f32[1024]{0} %h, f32[1024]{0} %h)\n'
+    '  ROOT %o = f32[1024]{0} add(f32[1024]{0} %ar, f32[1024]{0} %m)\n'
+    '}\n'
+)
+
+
+def test_sch402_overlap_under_budget():
+    ctx = SchedContext(specimen='fix', overlap_budget=0.5)
+    findings = analyze_schedule_hlo(CHAINED_COMM, ctx)
+    assert _rules(findings) == ['SCH402']
+    (f,) = findings
+    assert f.severity.name == 'WARNING'
+    assert '0.5' in f.message
+    assert 'measured 0.0' in f.detail
+
+
+def test_sch402_slack_meets_budget():
+    ctx = SchedContext(specimen='fix', overlap_budget=0.5)
+    assert analyze_schedule_hlo(SLACK_COMM, ctx) == []
+    assert schedule_summary(SLACK_COMM)['overlap_fraction'] == 1.0
+
+
+def test_sch402_needs_a_budget():
+    assert analyze_schedule_hlo(CHAINED_COMM,
+                                SchedContext(specimen='fix')) == []
+
+
+# --- SCH403: per-iteration fetch serialized behind the carry ------------
+
+def _fetch_loop(slice_elems):
+    slice_ty = f'f32[{slice_elems}]'
+    return (
+        f'%body (carry: (s32[], f32[1048576], {slice_ty})) ->'
+        f' (s32[], f32[1048576], {slice_ty}) {{\n'
+        f'  %carry = (s32[], f32[1048576]{{0}}, {slice_ty}{{0}})'
+        f' parameter(0)\n'
+        f'  %i = s32[] get-tuple-element((s32[], f32[1048576]{{0}},'
+        f' {slice_ty}{{0}}) %carry), index=0\n'
+        f'  %tab = f32[1048576]{{0}} get-tuple-element((s32[],'
+        f' f32[1048576]{{0}}, {slice_ty}{{0}}) %carry), index=1\n'
+        f'  %acc = {slice_ty}{{0}} get-tuple-element((s32[],'
+        f' f32[1048576]{{0}}, {slice_ty}{{0}}) %carry), index=2\n'
+        f'  %ds = {slice_ty}{{0}} dynamic-slice(f32[1048576]{{0}} %tab,'
+        f' s32[] %i), dynamic_slice_sizes={{{slice_elems}}}\n'
+        f'  %m = {slice_ty}{{0}} multiply({slice_ty}{{0}} %ds,'
+        f' {slice_ty}{{0}} %ds)\n'
+        f'  %a2 = {slice_ty}{{0}} add({slice_ty}{{0}} %m,'
+        f' {slice_ty}{{0}} %acc)\n'
+        f'  %one = s32[] constant(1)\n'
+        f'  %i2 = s32[] add(s32[] %i, s32[] %one)\n'
+        f'  ROOT %t = (s32[], f32[1048576]{{0}}, {slice_ty}{{0}})'
+        f' tuple(s32[] %i2, f32[1048576]{{0}} %tab,'
+        f' {slice_ty}{{0}} %a2)\n'
+        f'}}\n'
+        f'\n'
+        f'%cond (c: (s32[], f32[1048576], {slice_ty})) -> pred[] {{\n'
+        f'  %c = (s32[], f32[1048576]{{0}}, {slice_ty}{{0}})'
+        f' parameter(0)\n'
+        f'  %i.1 = s32[] get-tuple-element((s32[], f32[1048576]{{0}},'
+        f' {slice_ty}{{0}}) %c), index=0\n'
+        f'  %lim = s32[] constant(4)\n'
+        f'  ROOT %lt = pred[] compare(s32[] %i.1, s32[] %lim),'
+        f' direction=LT\n'
+        f'}}\n'
+        f'\n'
+        f'ENTRY %main (t0: f32[1048576], a0: {slice_ty}, i0: s32[]) ->'
+        f' {slice_ty} {{\n'
+        f'  %t0 = f32[1048576]{{0}} parameter(0)\n'
+        f'  %a0 = {slice_ty}{{0}} parameter(1)\n'
+        f'  %i0 = s32[] parameter(2)\n'
+        f'  %init = (s32[], f32[1048576]{{0}}, {slice_ty}{{0}})'
+        f' tuple(s32[] %i0, f32[1048576]{{0}} %t0,'
+        f' {slice_ty}{{0}} %a0)\n'
+        f'  %loop = (s32[], f32[1048576]{{0}}, {slice_ty}{{0}})'
+        f' while((s32[], f32[1048576]{{0}}, {slice_ty}{{0}}) %init),'
+        f' condition=%cond, body=%body\n'
+        f'  ROOT %out = {slice_ty}{{0}} get-tuple-element((s32[],'
+        f' f32[1048576]{{0}}, {slice_ty}{{0}}) %loop), index=2\n'
+        f'}}\n'
+    )
+
+
+#: 262144 f32 = 1 MiB fetched per iteration off the carry.
+BIG_FETCH_LOOP = _fetch_loop(262144)
+#: 64 f32 = 256 B per iteration — not worth pipelining.
+SMALL_FETCH_LOOP = _fetch_loop(64)
+
+
+def test_sch403_big_serial_fetch_is_double_buffer_opportunity():
+    findings = analyze_schedule_hlo(BIG_FETCH_LOOP,
+                                    SchedContext(specimen='fix'))
+    assert _rules(findings) == ['SCH403']
+    (f,) = findings
+    assert f.severity.name == 'INFO'
+    assert 'double-buffer' in f.message
+    assert 'dynamic-slice' in f.message
+    assert 'ROADMAP item 4' in f.detail
+
+
+def test_sch403_small_fetch_is_clean():
+    assert analyze_schedule_hlo(SMALL_FETCH_LOOP,
+                                SchedContext(specimen='fix')) == []
+
+
+# --- MEM404: static peak over the device budget -------------------------
+
+BIG_PEAK = (
+    'ENTRY %main (p: f32[262144]) -> f32[262144] {\n'
+    '  %p = f32[262144]{0} parameter(0)\n'
+    '  %a = f32[262144]{0} negate(f32[262144]{0} %p), metadata={'
+    'op_name="jit(f)/jit(main)/psi1/neg"}\n'
+    '  %b = f32[262144]{0} negate(f32[262144]{0} %a), metadata={'
+    'op_name="jit(f)/jit(main)/consensus_iter/neg"}\n'
+    '  ROOT %c = f32[262144]{0} add(f32[262144]{0} %a,'
+    ' f32[262144]{0} %b)\n'
+    '}\n'
+)
+
+
+def test_mem404_peak_over_budget():
+    # Peak: p (freed after %a... p's last use is %a) — at %b: a+b+p?
+    # p frees after %a, so peak point holds p+a (at %a) then a+b(+c).
+    # 3 buffers of 1 MiB overlap at the peak; a 2 MiB budget trips.
+    ctx = SchedContext(specimen='fix', peak_bytes_budget=2 << 20)
+    findings = analyze_schedule_hlo(BIG_PEAK, ctx)
+    assert _rules(findings) == ['MEM404']
+    (f,) = findings
+    assert f.severity.name == 'ERROR'
+    assert 'psi1' in f.detail or 'consensus_iter' in f.detail
+
+
+def test_mem404_within_budget_is_clean():
+    ctx = SchedContext(specimen='fix', peak_bytes_budget=8 << 20)
+    assert analyze_schedule_hlo(BIG_PEAK, ctx) == []
+
+
+def test_mem404_needs_a_budget():
+    assert analyze_schedule_hlo(BIG_PEAK,
+                                SchedContext(specimen='fix')) == []
+
+
+# --- MEM405: loop-carried full-axis residual ----------------------------
+
+# The PR 9 shape: one pred slab PER CHUNK stacked across the whole
+# streamed axis (leading dim = trip count 16384/128 = 128), riding the
+# while carry as a backward residual — 32 MiB for a loop whose real
+# state is the f32[2048,64] accumulator (512 KiB, chunk-scaled).
+RESIDUAL_LOOP = (
+    '%body (carry: (s32[], pred[128,2048,128], f32[2048,64])) ->'
+    ' (s32[], pred[128,2048,128], f32[2048,64]) {\n'
+    '  %carry = (s32[], pred[128,2048,128]{2,1,0}, f32[2048,64]{1,0})'
+    ' parameter(0)\n'
+    '  %i = s32[] get-tuple-element((s32[], pred[128,2048,128]{2,1,0},'
+    ' f32[2048,64]{1,0}) %carry), index=0\n'
+    '  %mask = pred[128,2048,128]{2,1,0} get-tuple-element((s32[],'
+    ' pred[128,2048,128]{2,1,0}, f32[2048,64]{1,0}) %carry), index=1\n'
+    '  %acc = f32[2048,64]{1,0} get-tuple-element((s32[],'
+    ' pred[128,2048,128]{2,1,0}, f32[2048,64]{1,0}) %carry), index=2\n'
+    '  %one = s32[] constant(1)\n'
+    '  %i2 = s32[] add(s32[] %i, s32[] %one)\n'
+    '  ROOT %t = (s32[], pred[128,2048,128]{2,1,0},'
+    ' f32[2048,64]{1,0}) tuple(s32[] %i2,'
+    ' pred[128,2048,128]{2,1,0} %mask, f32[2048,64]{1,0} %acc)\n'
+    '}\n'
+    '\n'
+    '%cond (c: (s32[], pred[128,2048,128], f32[2048,64])) -> pred[] {\n'
+    '  %c = (s32[], pred[128,2048,128]{2,1,0}, f32[2048,64]{1,0})'
+    ' parameter(0)\n'
+    '  %i.1 = s32[] get-tuple-element((s32[],'
+    ' pred[128,2048,128]{2,1,0}, f32[2048,64]{1,0}) %c), index=0\n'
+    '  %lim = s32[] constant(128)\n'
+    '  ROOT %lt = pred[] compare(s32[] %i.1, s32[] %lim),'
+    ' direction=LT\n'
+    '}\n'
+    '\n'
+    'ENTRY %main (m0: pred[128,2048,128], a0: f32[2048,64],'
+    ' i0: s32[]) -> f32[2048,64] {\n'
+    '  %m0 = pred[128,2048,128]{2,1,0} parameter(0)\n'
+    '  %a0 = f32[2048,64]{1,0} parameter(1)\n'
+    '  %i0 = s32[] parameter(2)\n'
+    '  %init = (s32[], pred[128,2048,128]{2,1,0}, f32[2048,64]{1,0})'
+    ' tuple(s32[] %i0, pred[128,2048,128]{2,1,0} %m0,'
+    ' f32[2048,64]{1,0} %a0)\n'
+    '  %loop = (s32[], pred[128,2048,128]{2,1,0}, f32[2048,64]{1,0})'
+    ' while((s32[], pred[128,2048,128]{2,1,0}, f32[2048,64]{1,0})'
+    ' %init), condition=%cond, body=%body\n'
+    '  ROOT %out = f32[2048,64]{1,0} get-tuple-element((s32[],'
+    ' pred[128,2048,128]{2,1,0}, f32[2048,64]{1,0}) %loop), index=2\n'
+    '}\n'
+)
+
+# Control: the same loop carrying only chunk-scaled state.
+CHUNK_LOOP = RESIDUAL_LOOP.replace('pred[128,2048,128]', 'pred[2048,128]')
+
+
+def test_mem405_full_axis_residual():
+    ctx = SchedContext(specimen='fix', stream_full=16384,
+                       stream_chunk=128)
+    findings = analyze_schedule_hlo(RESIDUAL_LOOP, ctx)
+    assert _rules(findings) == ['MEM405']
+    (f,) = findings
+    assert f.severity.name == 'ERROR'
+    assert 'pred[128,2048,128]' in f.message
+    assert 'trip count' in f.detail
+    assert f.context == 'while carry pred[128,2048,128]'
+
+
+def test_mem405_chunk_scaled_carry_is_clean():
+    ctx = SchedContext(specimen='fix', stream_full=16384,
+                       stream_chunk=128)
+    assert analyze_schedule_hlo(CHUNK_LOOP, ctx) == []
+
+
+def test_mem405_needs_stream_decl():
+    assert analyze_schedule_hlo(RESIDUAL_LOOP,
+                                SchedContext(specimen='fix')) == []
+
+
+def test_mem405_unrelated_wide_dim_is_not_the_streamed_axis():
+    """A legitimate carried accumulator with a big FEATURE dim (256)
+    must not read as 'carries the corpus axis' just because 256 >= the
+    streamed axis length — only a dim EQUAL to stream_full (or the
+    per-chunk stacking signature) is the class."""
+    legit = RESIDUAL_LOOP.replace('pred[128,2048,128]', 'f32[8,256]')
+    ctx = SchedContext(specimen='fix', stream_full=16,
+                       stream_chunk=8, residual_min_bytes=4096)
+    assert analyze_schedule_hlo(legit, ctx) == []   # 8 KiB, clears floor
+
+
+# Pipelined (double-buffered) loop: the -start issues at the END of the
+# body and threads OUT through the carry; its -done is consumed across
+# the back-edge. SCH401 must NOT flag the pattern its own remediation
+# recommends.
+PIPELINED_ASYNC_LOOP = SERIAL_ASYNC_LOOP.replace(
+    '  %cps = f32[64]{0} collective-permute-start(f32[64]{0} %s),'
+    ' channel_id=1, source_target_pairs={{0,1},{1,0}}\n'
+    '  %cpd = f32[64]{0} collective-permute-done(f32[64]{0} %cps)\n'
+    '  %m = f32[64]{0} multiply(f32[64]{0} %cpd, f32[64]{0} %cpd)\n',
+    '  %m = f32[64]{0} multiply(f32[64]{0} %s, f32[64]{0} %s)\n'
+    '  %cps = f32[64]{0} collective-permute-start(f32[64]{0} %m),'
+    ' channel_id=1, source_target_pairs={{0,1},{1,0}}\n').replace(
+    'tuple(s32[] %i, f32[64]{0} %m)', 'tuple(s32[] %i, f32[64]{0} %cps)')
+
+
+def test_sch401_skips_cross_iteration_pipelined_start():
+    assert analyze_schedule_hlo(PIPELINED_ASYNC_LOOP,
+                                SchedContext(specimen='fix')) == []
+
+
+# --- the schedule model itself ------------------------------------------
+
+
+def test_schedule_model_async_interval_overlap():
+    """The list schedule widens an async pair into an interval and
+    measures the independent compute inside it."""
+    scheds = module_schedules(OVERLAPPED_ASYNC_LOOP)
+    (coll,) = scheds['body'].collectives
+    assert coll.program_gap_cost and coll.program_gap_cost > 0
+    assert coll.overlap_fraction == 1.0
+    (serial,) = module_schedules(SERIAL_ASYNC_LOOP)['body'].collectives
+    assert serial.program_gap_cost == 0
+
+
+def test_schedule_model_critical_path_share():
+    """A pure chain has share 1.0; the slack program sits below it."""
+    chained = module_schedules(CHAINED_COMM)['main']
+    assert chained.critical_path_share == 1.0
+    slack = module_schedules(SLACK_COMM)['main']
+    assert slack.critical_path_share < 1.0
+
+
+def test_liveness_region_peak_stacks_on_caller():
+    """The while body's working set rides on the caller's live set: the
+    module peak exceeds the flat entry peak."""
+    lv = module_peak(BIG_FETCH_LOOP)
+    assert lv.region_name == 'body'
+    assert lv.region_bytes > 0
+    # Carry (4 MiB table + 1 MiB acc) + body interior (fetch + multiply
+    # + next acc) all live across the loop.
+    assert lv.peak_bytes > 6 << 20
+
+
+# --- real specimens through the tier driver -----------------------------
+
+
+@pytest.mark.skipif(len(jax.devices()) < 4, reason='needs 4 devices')
+def test_sched_tier_runs_clean_on_registered_specimens():
+    """The registered sched-tier specimens produce ONLY SCH/MEM findings
+    (today: none — the committed budgets hold; a future finding lands in
+    the baseline as a reviewed entry, never as drift in another
+    tier)."""
+    from dgmc_tpu.analysis.registry import SpecimenCache
+    from dgmc_tpu.analysis.sched_rules import run_sched_tier
+    cache = SpecimenCache()
+    findings = run_sched_tier(cache=cache)
+    assert all(f.rule.startswith(('SCH', 'MEM')) for f in findings)
+    assert findings == [], [f.to_json() for f in findings]
+
+
+@pytest.mark.skipif(len(jax.devices()) < 4, reason='needs 4 devices')
+def test_streamed_specimen_overlap_and_peak_budgets_pinned():
+    """The streamed train step's measured overlap fraction stays at or
+    above its committed budget (a sharding edit that serializes the
+    chunk loop fails here AND as SCH402 in CI), and its static peak
+    stays under the committed byte budget (the fixture-scale face of
+    the SCALE_r07 1.04 GiB/device claim)."""
+    from dgmc_tpu.analysis.registry import SpecimenCache, default_specimens
+    (spec,) = [s for s in default_specimens()
+               if s.name == 'parallel.streamed_train_step']
+    art = SpecimenCache().artifacts(spec)
+    built = art.built()
+    text = art.compiled().as_text()
+    summary = schedule_summary(text)
+    assert built['overlap_budget'] == 0.12
+    assert summary['overlap_fraction'] >= built['overlap_budget'], (
+        'streamed chunk loop serialized: modeled overlap '
+        f'{summary["overlap_fraction"]} fell under the committed '
+        f'{built["overlap_budget"]} budget')
+    peak = module_peak(text).peak_bytes
+    assert built['peak_bytes_budget'] == 40 << 10
+    assert 0 < peak <= built['peak_bytes_budget'], (
+        f'static peak {peak} B over the committed budget')
+    # MEM405's floor is scaled to the fixture (largest legitimate carry
+    # 1,536 B), not the GiB-class default that would make it inert.
+    assert built['residual_min_bytes'] == 4 << 10

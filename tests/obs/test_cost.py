@@ -133,6 +133,32 @@ def test_collectives_of_sharded_compiled_executable():
     assert s['collectives']['bytes'] >= 4
 
 
+def test_compiled_summary_carries_schedule_and_liveness_fields():
+    """The compiled view publishes the schedule/liveness account —
+    overlap_fraction (program moves bytes), critical_path_share, and
+    the static peak-live bound — the same models the SCH/MEM lint tier
+    gates on, so efficiency.json and the lint cannot disagree."""
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    devs = jax.devices()
+    if len(devs) < 2:
+        pytest.skip('needs >= 2 devices')
+    mesh = Mesh(np.array(devs), ('data',))
+    f = jax.jit(lambda x: jnp.sum(x * 2.0))
+    x = jax.device_put(np.random.randn(len(devs) * 2, 4).astype(np.float32),
+                       NamedSharding(mesh, P('data')))
+    s = cost.cost_summary(f.lower(x).compile())
+    assert 0.0 <= s['overlap_fraction'] <= 1.0
+    assert 0.0 < s['critical_path_share'] <= 1.0
+    assert s['static_peak_bytes'] > 0
+    # A single-device program has no collectives: the overlap field is
+    # omitted, never fabricated; the liveness bound still reports.
+    g = jax.jit(lambda y: jnp.sum(y * 2.0))
+    y = np.random.randn(4, 4).astype(np.float32)
+    s1 = cost.cost_summary(g.lower(y).compile())
+    assert 'overlap_fraction' not in s1
+    assert s1['static_peak_bytes'] > 0
+
+
 def test_peak_flops_entries():
     class Dev:
         def __init__(self, kind, platform):

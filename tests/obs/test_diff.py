@@ -261,3 +261,51 @@ def test_probe_aggregates_from_metrics_fallback(tmp_path, probe_fallback):
                                 'value': 3.0}) + '\n')
     s = summarize(load_run(d))
     assert 'corr_entropy' in s['probes']
+
+
+SCHED_EFF = {'mfu': 0.5, 'peak_flops': 1e12, 'peak_flops_source': 'table',
+             'programs': {'train_step': {'flops': 1e9, 'mfu': 0.5,
+                                         'overlap_fraction': 0.4,
+                                         'static_peak_bytes': 1 << 20}}}
+
+
+def test_min_overlap_floor_gates(tmp_path, capsys):
+    """--min-overlap is an ABSOLUTE floor on the modeled collective
+    overlap: a candidate under it serialized the chunk loop."""
+    a = write_run(tmp_path, 'a', efficiency=SCHED_EFF)
+    serial = dict(SCHED_EFF)
+    serial['programs'] = {'train_step': dict(
+        SCHED_EFF['programs']['train_step'], overlap_fraction=0.05)}
+    b = write_run(tmp_path, 'b', efficiency=serial)
+    # Floor off by default: informational only.
+    assert diff_mod.main([a, b]) == 0
+    assert diff_mod.main([a, b, '--min-overlap', '0.2']) == 1
+    assert 'serialized below the floor' in capsys.readouterr().out
+    assert diff_mod.main([a, b, '--min-overlap', '0.01']) == 0
+    # The healthy run clears the same floor.
+    assert diff_mod.main([b, a, '--min-overlap', '0.2']) == 0
+
+
+def test_overlap_missing_from_candidate_is_regression(tmp_path, capsys):
+    a = write_run(tmp_path, 'a', efficiency=SCHED_EFF)
+    lost = dict(SCHED_EFF)
+    lost['programs'] = {'train_step': {'flops': 1e9, 'mfu': 0.5}}
+    b = write_run(tmp_path, 'b', efficiency=lost)
+    assert diff_mod.main([a, b]) == 1
+    assert 'missing from candidate' in capsys.readouterr().out
+    # Baseline never had the account: nothing to lose.
+    assert diff_mod.main([b, a]) == 0
+
+
+def test_static_peak_regression_gates(tmp_path, capsys):
+    a = write_run(tmp_path, 'a', efficiency=SCHED_EFF)
+    fat = dict(SCHED_EFF)
+    fat['programs'] = {'train_step': dict(
+        SCHED_EFF['programs']['train_step'],
+        static_peak_bytes=2 << 20)}
+    b = write_run(tmp_path, 'b', efficiency=fat)
+    assert diff_mod.main([a, b]) == 1          # +100% > default 25%
+    assert 'static_peak_bytes' in capsys.readouterr().out
+    assert diff_mod.main([a, b, '--max-peak-regression', '1.5']) == 0
+    # Shrinking the bound passes.
+    assert diff_mod.main([b, a]) == 0
