@@ -611,7 +611,8 @@ def main(argv=None):
     # hang_report.json and THEN prints the partial line + exit 124.
     _install_signal_handlers()
     global _OBS
-    if args.obs_dir or args.probes or args.watchdog_deadline:
+    if args.obs_dir or args.probes or args.watchdog_deadline \
+            or args.obs_port is not None:
         # --probes without --obs-dir still flips the trace-time probe
         # switch (a disabled observer carries no sink) so a probe-overhead
         # bench run measures what it claims to — same contract as the
@@ -621,7 +622,8 @@ def main(argv=None):
         _OBS = RunObserver(args.obs_dir, probes=args.probes,
                            watchdog_deadline_s=args.watchdog_deadline,
                            fence_deadline_s=args.fence_deadline,
-                           watchdog_signals=(signal.SIGTERM,))
+                           watchdog_signals=(signal.SIGTERM,),
+                           obs_port=args.obs_port)
     prof = start_profile(args.profile_dir)
 
     # Sparse first: the allocator's peak_bytes_in_use is process-lifetime,

@@ -71,6 +71,12 @@ def cli_argv(args, obs_dir, row_shards, n_s=None, e_s=None):
     ]
     if row_shards > 1:
         argv += ['--row_shards', str(row_shards)]
+    if args.obs_port is not None:
+        # Live telemetry on the CLI child: with the default 0 each leg
+        # binds its own free port and advertises it in heartbeat.json,
+        # so the supervisor/aggregate discover it without coordination
+        # (a fixed port would collide between the 8-dev and 1-dev legs).
+        argv += ['--obs-port', str(args.obs_port)]
     return argv
 
 
@@ -280,6 +286,11 @@ def main(argv=None):
     parser.add_argument('--devices', type=int, default=8)
     parser.add_argument('--seed', type=int, default=7)
     parser.add_argument('--watchdog', type=int, default=7200)
+    parser.add_argument('--obs-port', '--obs_port', dest='obs_port',
+                        type=int, default=None, metavar='PORT',
+                        help='arm the live telemetry plane on each CLI '
+                             'leg (pass 0: every leg picks a free port '
+                             'and advertises it in its heartbeat.json)')
     parser.add_argument('--round', type=int, default=7)
     parser.add_argument('--anchor', choices=['slice', 'full'],
                         default='slice',

@@ -470,7 +470,7 @@ def main(argv=None):
     obs = RunObserver(host_obs_dir(args.obs_dir), probes=args.probes,
                       watchdog_deadline_s=args.watchdog_deadline,
                       fence_deadline_s=args.fence_deadline,
-                      host_channel=channel)
+                      host_channel=channel, obs_port=args.obs_port)
     # collective-stall@N fires INSIDE the fence guard, where a wedged
     # collective would actually block.
     obs.fence_hook = plan.before_fence
@@ -579,6 +579,14 @@ def main(argv=None):
                 guard_metrics = {
                     'skipped_steps': int(host['skip_count']),
                     'consec_bad': int(host['consec_bad'])}
+                # Publish to the live plane (/healthz gauges +
+                # dgmc_guard_* metrics): the counters ride the state
+                # pytree, so this print boundary is the one place the
+                # host actually knows them.
+                obs.set_gauge('guard_skip_count',
+                              guard_metrics['skipped_steps'])
+                obs.set_gauge('guard_consec_bad',
+                              guard_metrics['consec_bad'])
                 if int(host['consec_bad']) == 0 and np.isfinite(loss):
                     guard_mon.note_good(state, step=epoch)
                 else:

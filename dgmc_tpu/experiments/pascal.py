@@ -185,7 +185,8 @@ def main(argv=None):
     # solo); merge with `python -m dgmc_tpu.obs.aggregate <obs-dir>`.
     obs = RunObserver(host_obs_dir(args.obs_dir), probes=args.probes,
                       watchdog_deadline_s=args.watchdog_deadline,
-                      fence_deadline_s=args.fence_deadline)
+                      fence_deadline_s=args.fence_deadline,
+                      obs_port=args.obs_port)
     # Cost/MFU attribution (one extra trace, no extra XLA compile);
     # under data parallelism this is the sharded step, so the lowered
     # account covers the collective-carrying program.

@@ -127,7 +127,8 @@ def main(argv=None):
     from dgmc_tpu.parallel import host_obs_dir
     obs = RunObserver(host_obs_dir(args.obs_dir), probes=args.probes,
                       watchdog_deadline_s=args.watchdog_deadline,
-                      fence_deadline_s=args.fence_deadline)
+                      fence_deadline_s=args.fence_deadline,
+                      obs_port=args.obs_port)
     # One extra trace, no extra XLA compile: the per-stage FLOPs/bytes +
     # MFU account in <obs-dir>/efficiency.json (obs/cost.py).
     obs.record_cost('train_step', step, state, batch0,

@@ -52,7 +52,20 @@ measurements come from:
 - :mod:`~dgmc_tpu.obs.aggregate` — multi-device/host aggregation:
   merges per-host obs subdirectories (``obs-dir/host_<k>/``) into a
   straggler/skew summary (max/median device step-time ratio, per-device
-  memory-peak spread) via ``python -m dgmc_tpu.obs.aggregate``.
+  memory-peak spread) via ``python -m dgmc_tpu.obs.aggregate``; with
+  ``--scrape`` it also probes each host's live ``/healthz`` endpoint.
+- :mod:`~dgmc_tpu.obs.live` — the live telemetry plane behind
+  ``--obs-port``: ``/healthz`` (503 on a stale watchdog heartbeat,
+  the supervisor's own staleness definition), ``/metrics`` (Prometheus
+  text exposition with an O(1)-memory streaming step-latency
+  histogram), ``/status`` (live timings), and the always-on anomaly
+  **flight recorder** whose ring buffer is dumped as ``flight.json``
+  on any watchdog trip, fence timeout, guard rollback or signal
+  teardown.
+- :mod:`~dgmc_tpu.obs.timeline` — longitudinal bench trajectory:
+  ``python -m dgmc_tpu.obs.timeline benchmarks/`` renders the
+  committed ``BENCH_r*``/``MULTICHIP_r*``/``SCALE_r*.json`` rounds as
+  one throughput/p50/MFU/overlap table (``--json`` for rows).
 
 Model code carries :func:`jax.named_scope` annotations for the matching
 pipeline's stages (``psi1``, ``initial_corr``, ``topk``,

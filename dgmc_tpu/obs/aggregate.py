@@ -95,7 +95,61 @@ def _spread(rows, key):
             'worst': {k: worst[k] for k in ('host', 'device') if k in worst}}
 
 
-def aggregate(root):
+#: A heartbeat older than this with an unreachable endpoint reads as
+#: "the run ENDED" (heartbeats refresh every watchdog poll, seconds
+#: apart, while the process lives) — the post-hoc artifacts are then
+#: authoritative and the host is NOT flagged live-unhealthy. A FRESH
+#: heartbeat with a dead endpoint is the live anomaly.
+ENDED_HEARTBEAT_AGE_S = 60.0
+
+
+def _scrape_host(host_dir):
+    """Live-endpoint probe for one host dir: the advertised host+port
+    are read from the heartbeat file the watchdog already writes
+    (``heartbeat.json`` carries them when ``--obs-port`` is armed),
+    then ``/healthz`` is scraped. Returns ``None`` when the host never
+    advertised a port; otherwise ``{'port', 'pid', 'healthy',
+    'heartbeat_age_s', ...}``. An endpoint that does not answer (or
+    answers without a verdict — an errored handler's 500) is
+    ``'unreachable'`` only while the heartbeat is fresh; with a stale
+    heartbeat it is ``'ended'`` — a completed run's leftover
+    advertisement, not a live anomaly."""
+    try:
+        with open(os.path.join(host_dir, 'heartbeat.json')) as f:
+            hb = json.load(f)
+    except (OSError, ValueError):
+        return None
+    port = hb.get('port')
+    if not port:
+        return None
+    out = {'port': port, 'pid': hb.get('pid')}
+    from dgmc_tpu.obs.live import probe_healthz
+    res = probe_healthz(port, host=hb.get('host') or '127.0.0.1')
+    verdict = None
+    if res is not None:
+        code, payload = res
+        if 'healthy' in payload:
+            verdict = bool(payload['healthy'])
+        elif code == 200:
+            verdict = True
+        else:
+            out['scrape_error'] = code
+    if verdict is None:
+        import time
+        if time.time() - hb.get('time', 0) > ENDED_HEARTBEAT_AGE_S:
+            out['ended'] = True
+        else:
+            out['unreachable'] = True
+        return out
+    out['healthy'] = verdict
+    for k in ('heartbeat_age_s', 'steps_completed', 'in_flight',
+              'gauges'):
+        if res[1].get(k) is not None:
+            out[k] = res[1][k]
+    return out
+
+
+def aggregate(root, scrape=False):
     """Merge ``root``'s host subdirectories into one skew summary.
 
     Returns ``None`` when ``root`` holds no run artifacts at all;
@@ -103,6 +157,11 @@ def aggregate(root):
     per (host, device) with mean step-completion time and memory peak),
     ``step_time``, ``memory``, ``wall`` spreads and the condensed
     ``skew`` block the report/diff layers read.
+
+    ``scrape=True`` additionally probes each host's LIVE ``/healthz``
+    endpoint (port discovered from its ``heartbeat.json``) — the
+    distributed-run view of a run still in flight: per-host
+    ``live`` blocks plus top-level ``live_unhealthy_hosts``.
     """
     hosts = find_host_dirs(root)
     if not hosts:
@@ -123,6 +182,10 @@ def aggregate(root):
                           if k in s}
         if s.get('hang_report'):
             per_host[name]['hang_report'] = s['hang_report']
+        if scrape:
+            live = _scrape_host(d)
+            if live is not None:
+                per_host[name]['live'] = live
         host_rows.append({'host': name,
                           'step_p50_s': s.get('step_p50_s'),
                           'wall_s': s.get('wall_s')})
@@ -169,6 +232,11 @@ def aggregate(root):
         'hung_hosts': [name for name, p in per_host.items()
                        if 'hang_report' in p],
     }
+    if scrape:
+        out['live_unhealthy_hosts'] = [
+            name for name, p in per_host.items()
+            if 'live' in p and (p['live'].get('unreachable')
+                                or p['live'].get('healthy') is False)]
     attribution = {
         name: _attribute_hang(root, name, per_host[name]['hang_report'])
         for name in out['hung_hosts']}
@@ -256,6 +324,15 @@ def render(summary):
         peak = p.get('peak_memory_bytes')
         peak = f'{peak / 2**30:.3f} GiB' if peak else '-'
         hang = '  ** HUNG **' if 'hang_report' in p else ''
+        live = p.get('live')
+        if live:
+            if live.get('ended'):
+                hang += f'  [live :{live["port"]} ended]'
+            elif live.get('unreachable'):
+                hang += f'  [live :{live["port"]} UNREACHABLE]'
+            else:
+                state = 'ok' if live.get('healthy') else 'STALE'
+                hang += f'  [live :{live["port"]} {state}]'
         lines.append(f'  {name:<10} {p.get("steps", "-"):>6} '
                      f'{_fmt_s(p.get("step_p50_s")):>10} '
                      f'{_fmt_s(p.get("wall_s")):>10} {peak:>12}{hang}')
@@ -286,6 +363,10 @@ def render(summary):
                      f'[{mem["source"]}]')
     else:
         lines.append('  (no memory peaks recorded)')
+    if summary.get('live_unhealthy_hosts'):
+        lines.append(f'  LIVE-UNHEALTHY HOSTS: '
+                     f'{summary["live_unhealthy_hosts"]} '
+                     f'(/healthz 503 or unreachable)')
     if summary.get('hung_hosts'):
         lines.append(f'  HUNG HOSTS: {summary["hung_hosts"]} '
                      f'(see their hang_report.json)')
@@ -315,9 +396,15 @@ def main(argv=None):
                         help='print the machine-readable summary')
     parser.add_argument('--no-write', action='store_true',
                         help="don't write <root>/aggregate.json")
+    parser.add_argument('--scrape', action='store_true',
+                        help='also probe each host\'s live /healthz '
+                             'endpoint (port discovered from its '
+                             'heartbeat.json — the --obs-port '
+                             'advertisement) and report per-host live '
+                             'health for a run still in flight')
     args = parser.parse_args(argv)
 
-    summary = aggregate(args.root)
+    summary = aggregate(args.root, scrape=args.scrape)
     if summary is None:
         print(f'aggregate: no obs artifacts under {args.root}',
               file=sys.stderr)

@@ -88,6 +88,24 @@ REGISTRY = Registry()
 
 DISPATCH_COUNTER = 'pallas_dispatch'
 
+#: Live dispatch sinks: callables ``(kernel, outcome, reason)`` invoked
+#: on every decision — how the flight recorder sees dispatch events as
+#: they happen rather than as post-hoc table deltas. Guarded by its own
+#: lock; a raising sink is dropped from the event, never from the run.
+_dispatch_lock = threading.Lock()
+_dispatch_sinks = []
+
+
+def add_dispatch_sink(fn):
+    with _dispatch_lock:
+        _dispatch_sinks.append(fn)
+
+
+def remove_dispatch_sink(fn):
+    with _dispatch_lock:
+        if fn in _dispatch_sinks:
+            _dispatch_sinks.remove(fn)
+
 
 def record_dispatch(kernel, outcome, reason):
     """Record one kernel-dispatch decision.
@@ -103,6 +121,13 @@ def record_dispatch(kernel, outcome, reason):
     """
     REGISTRY.inc(DISPATCH_COUNTER, kernel=kernel, outcome=outcome,
                  reason=reason)
+    with _dispatch_lock:
+        sinks = tuple(_dispatch_sinks)
+    for fn in sinks:
+        try:
+            fn(kernel, outcome, reason)
+        except Exception:
+            pass
 
 
 def dispatch_table():
@@ -196,17 +221,29 @@ class CompileWatcher:
     Use as a context manager; events are collected between ``__enter__``
     and ``close()``/``__exit__`` (the module listener stays installed —
     there is no unregister API — but a closed watcher stops recording).
+
+    ``on_event`` (optional) is called with each labelled event record
+    as it lands — the flight recorder's live view of compile activity.
+    It runs under the listener lock, so it must be cheap and must not
+    re-enter this module; a raising callback is swallowed.
     """
 
-    def __init__(self):
+    def __init__(self, on_event=None):
         self._events = []
         self._label = 'run'
         self._open = False
+        self._on_event = on_event
 
     # -- listener callback (under _listener_lock) --
     def _record(self, rec):
         if self._open:
-            self._events.append(dict(rec, label=self._label))
+            rec = dict(rec, label=self._label)
+            self._events.append(rec)
+            if self._on_event is not None:
+                try:
+                    self._on_event(rec)
+                except Exception:
+                    pass
 
     def __enter__(self):
         _ensure_listener()

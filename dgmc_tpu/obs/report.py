@@ -91,6 +91,7 @@ def load_run(path):
             'aggregate': _read_json(os.path.join(path, 'aggregate.json')),
             'hang': _read_json(os.path.join(path, 'hang_report.json')),
             'recovery': _read_json(os.path.join(path, 'recovery.json')),
+            'flight': _read_json(os.path.join(path, 'flight.json')),
         }
         if run['timings'] is None and not run['metrics']:
             from dgmc_tpu.resilience.supervisor import (ATTEMPT_PREFIX,
@@ -129,7 +130,8 @@ def load_run(path):
         return run
     return {'path': path, 'metrics': _read_jsonl(path), 'timings': None,
             'memory': None, 'dispatch': None, 'efficiency': None,
-            'aggregate': None, 'hang': None, 'recovery': None}
+            'aggregate': None, 'hang': None, 'recovery': None,
+            'flight': None}
 
 
 def peak_memory(memory):
@@ -255,6 +257,21 @@ def summarize(run):
             if val is not None:
                 out[key] = val
 
+    flight = run.get('flight')
+    if flight:
+        out['flight'] = {
+            'reason': flight.get('reason'),
+            'events_recorded': flight.get('events_recorded'),
+            'events_truncated': flight.get('events_truncated'),
+        }
+        events = flight.get('events') or []
+        if events:
+            out['flight']['last_event'] = events[-1]
+            spans = [e for e in events
+                     if str(e.get('kind', '')).startswith('span')]
+            if spans:
+                out['flight']['last_span'] = spans[-1]
+
     hang = run.get('hang')
     if hang:
         out['hang_report'] = {
@@ -364,6 +381,23 @@ def render(run):
                 + (f' after {steps_done} step(s)'
                    if steps_done is not None else '')
                 + (f' ({dur}s)' if dur is not None else ''))
+
+    flight = run.get('flight')
+    if flight:
+        lines.append('-- flight recorder (trailing context) --')
+        lines.append(
+            f'  dumped on        {flight.get("reason")}   '
+            f'({flight.get("events_recorded", 0)} events kept, '
+            f'{flight.get("events_truncated", 0)} evicted by the ring)')
+        events = flight.get('events') or []
+        t_end = events[-1].get('time', 0.0) if events else 0.0
+        for ev in events[-12:]:
+            dt = (ev.get('time') or t_end) - t_end
+            detail = ' '.join(
+                f'{k}={v}' for k, v in ev.items()
+                if k not in ('time', 'kind') and v is not None)
+            lines.append(f'  {dt:+9.3f}s  {ev.get("kind", "?"):<10} '
+                         f'{detail}'.rstrip())
 
     steps = s.get('steps')
     lines.append('-- step timing --')

@@ -18,6 +18,17 @@ single directory:
 - ``hang_report.json`` — written only when the run stalls past the
   watchdog deadline or dies to SIGTERM/SIGALRM
   (:mod:`dgmc_tpu.obs.watchdog`).
+- ``flight.json`` — the anomaly flight recorder's dump
+  (:mod:`dgmc_tpu.obs.live`): the last N span completions, probe
+  values, dispatch decisions and compile events, written on any
+  anomaly (watchdog trip, fence timeout, guard rollback, signal
+  teardown) — the trailing-context record ``hang_report.json``'s
+  stack dump lacks.
+
+With ``--obs-port`` the observer additionally serves the live
+telemetry plane (``/healthz`` + ``/metrics`` + ``/status``, see
+:mod:`dgmc_tpu.obs.live`) and advertises the bound port in
+``heartbeat.json``.
 
 Every method is a no-op when constructed with a falsy directory, so CLIs
 call the observer unconditionally::
@@ -88,6 +99,20 @@ def add_obs_flag(parser):
              'rc 67 (FENCE_TIMEOUT_RC) so the supervisor restarts '
              'elastically instead of the run hanging to rc:124. '
              '--supervise arms it automatically; 0 opts out')
+    parser.add_argument(
+        '--obs-port', '--obs_port', dest='obs_port', type=int,
+        default=None, metavar='PORT',
+        help='serve the live telemetry plane on this port '
+             '(dgmc_tpu/obs/live.py): GET /healthz (200, or 503 when '
+             'the watchdog heartbeat is stale — the same staleness '
+             'definition the supervisor applies), GET /metrics '
+             '(Prometheus text exposition: streaming step-latency '
+             'histogram, throughput, per-label compile counters, '
+             'kernel-dispatch outcomes, probe gauges, MFU/intensity '
+             'from the last efficiency snapshot), GET /status (the '
+             'live timings.json summary). 0 picks a free port; the '
+             'chosen port is advertised in heartbeat.json so the '
+             'supervisor and obs.aggregate can discover it')
     return parser
 
 
@@ -115,7 +140,7 @@ class RunObserver:
 
     def __init__(self, obs_dir, probes=False, watchdog_deadline_s=None,
                  watchdog_signals=None, fence_deadline_s=None,
-                 host_channel=None):
+                 host_channel=None, obs_port=None):
         self.dir = obs_dir
         self.enabled = bool(obs_dir)
         #: Collective-fence deadline (``--fence-deadline``): every
@@ -152,8 +177,25 @@ class RunObserver:
         self._probe_lock = threading.Lock()
         self._probe_agg = probes_mod.Aggregator()
         self._probe_records = collections.deque(maxlen=MAX_TRACE_PROBES)
+        #: Probe records DELIVERED (vs kept in the bounded timeline
+        #: deque): `timings.json`/`trace.json` publish the difference
+        #: as ``probes_truncated`` so an aggregate over a clipped
+        #: window is visibly partial, never silently so.
+        self._probe_seen = 0
         self.first_nonfinite = None
         self._probes_enabled_by_me = False
+        #: Live plane state: the always-on flight recorder + streaming
+        #: latency histogram (both O(1)-memory, armed with the obs
+        #: dir), and the optional HTTP endpoints (armed by --obs-port).
+        self.flight = None
+        self.live_port = None
+        self._live_hist = None
+        self._server = None
+        self._live_gauges = {}
+        self._last_efficiency = None
+        self._last_activity = time.time()
+        self._dispatch_sink = None
+        self._compile_sink = None
         if probes:
             self._probes_enabled_by_me = not probes_mod.enabled()
             if self.enabled:
@@ -166,6 +208,14 @@ class RunObserver:
             print('RunObserver: --watchdog-deadline is ignored without '
                   '--obs-dir (hang_report.json needs an obs directory)',
                   file=sys.stderr)
+        if obs_port is not None and not self.enabled:
+            # Same contract: the flight recorder and the /status
+            # endpoint are views over the artifact state an obs dir
+            # holds — serving a plane with nothing behind it would
+            # report an empty run as healthy forever.
+            print('RunObserver: --obs-port is ignored without '
+                  '--obs-dir (the live plane serves the obs-dir '
+                  'telemetry)', file=sys.stderr)
         # mode='w': an obs dir describes ONE run — a reused --obs-dir must
         # not append a second run's metrics to artifacts the observer
         # rewrites from scratch.
@@ -174,12 +224,48 @@ class RunObserver:
             mode='w')
         if self.enabled:
             os.makedirs(obs_dir, exist_ok=True)
+            from dgmc_tpu.obs import live as live_mod
+            self._live_mod = live_mod
+            # Always-on: the ring buffer is O(capacity) memory and a
+            # record is one deque append — the trailing context must
+            # exist BEFORE anyone knows an anomaly is coming.
+            self.flight = live_mod.FlightRecorder(
+                os.path.join(obs_dir, 'flight.json'))
+            self._live_hist = live_mod.StreamingHistogram()
             # Registry counters are process-lifetime; baseline them here so
             # the artifacts attribute only THIS run's activity (the same
             # scoping CompileWatcher gives compile events).
             self._dispatch_base = self._count_index(dispatch_table())
             self._buckets_base = self._count_index(padding_bucket_table())
-            self._watcher = CompileWatcher().__enter__()
+            self._watcher = CompileWatcher(
+                on_event=self._on_compile_event).__enter__()
+            self._dispatch_sink = self._on_dispatch
+            from dgmc_tpu.obs.registry import add_dispatch_sink
+            add_dispatch_sink(self._dispatch_sink)
+            if obs_port is not None:
+                # Started BEFORE the watchdog so the bound port can be
+                # advertised in every heartbeat from the first poll on.
+                # A failed bind (fixed port already taken — e.g. two
+                # host processes of one machine given the same
+                # --obs-port) degrades to no plane with a warning:
+                # telemetry must never take the run down.
+                try:
+                    self._server = live_mod.TelemetryServer(
+                        obs_port, health_fn=self.health,
+                        metrics_fn=self.prometheus_metrics,
+                        status_fn=self.timings,
+                        # All interfaces by default (external probers
+                        # are the point); DGMC_TPU_OBS_BIND narrows it
+                        # (e.g. 127.0.0.1 on multi-tenant machines).
+                        host=os.environ.get('DGMC_TPU_OBS_BIND',
+                                            '')).start()
+                    self.live_port = self._server.port
+                except OSError as e:
+                    print(f'RunObserver: could not bind the live '
+                          f'telemetry plane on port {obs_port} ({e}); '
+                          f'continuing without it (pass --obs-port 0 '
+                          f'for a free port per process)',
+                          file=sys.stderr)
             if watchdog_deadline_s:
                 from dgmc_tpu.obs.watchdog import DEFAULT_SIGNALS, Watchdog
                 self.watchdog = Watchdog(
@@ -191,7 +277,19 @@ class RunObserver:
                     # Liveness file for the out-of-process run
                     # supervisor (resilience/supervisor.py).
                     heartbeat_path=os.path.join(
-                        obs_dir, 'heartbeat.json')).start()
+                        obs_dir, 'heartbeat.json'),
+                    # Endpoint discovery: the supervisor/aggregate read
+                    # the host+port from the heartbeat they already
+                    # watch. The hostname matters on shared obs
+                    # filesystems: a scraper on another machine must
+                    # not probe 127.0.0.1 and mistake its OWN local
+                    # plane for this host's.
+                    advertise=({'port': self.live_port,
+                                'host': self._advertise_host()}
+                               if self.live_port else None),
+                    # Anomaly trigger: every hang-report dump (deadline
+                    # or signal path) also dumps the flight recorder.
+                    on_dump=self.flight_dump).start()
             self.snapshot_memory('start')
 
     # -- collection --------------------------------------------------------
@@ -205,11 +303,23 @@ class RunObserver:
             return
         if self.watchdog is not None:
             self.watchdog.beat('step', self._step_index)
+        if self.flight is not None:
+            self.flight.record('span-start', phase='step',
+                               step=self._step_index)
         self.timer.start()
         try:
             yield
         finally:
-            self.timer.stop(fence=fence)
+            dur = self.timer.stop(fence=fence)
+            if self.flight is not None:
+                self.flight.record('span-end', phase='step',
+                                   step=self._step_index,
+                                   duration_s=round(dur, 6))
+            if self._live_hist is not None:
+                # O(1)-memory latency account for /metrics — the
+                # serving-scale counterpart of the timer's full list.
+                self._live_hist.observe(dur)
+            self._last_activity = time.time()
             # Probe records are attributed to this counter; with async
             # dispatch the attribution is approximate within the dispatch
             # pipeline depth (see obs/probes.py).
@@ -261,6 +371,9 @@ class RunObserver:
             return None
         if self.watchdog is not None:
             self.watchdog.beat('fence', f'{phase}@{tag}')
+        if self.flight is not None:
+            self.flight.record('span-start', phase='fence',
+                               name=f'{phase}@{tag}')
         guard = contextlib.nullcontext()
         if self.fence_deadline_s:
             from dgmc_tpu.resilience.distributed_guard import FenceGuard
@@ -268,7 +381,10 @@ class RunObserver:
                 os.path.join(self.dir, 'hang_report.json'),
                 self.fence_deadline_s, phase=phase, step=tag,
                 channel=self.host_channel,
-                context_fn=self._watchdog_context)
+                context_fn=self._watchdog_context,
+                # A fence timeout is an anomaly: dump the flight
+                # recorder's trailing context before the rc-67 exit.
+                on_dump=self.flight_dump)
         with guard:
             if self.fence_hook is not None:
                 # collective-stall@N injection point: the stall happens
@@ -281,6 +397,12 @@ class RunObserver:
                     time.perf_counter() - t0, 6)
         if self.host_channel is not None:
             self.host_channel.record_fence(phase, tag)
+        if self.flight is not None:
+            self.flight.record('span-end', phase='fence',
+                               name=f'{phase}@{tag}',
+                               duration_s=round(
+                                   max(times.values(), default=0.0), 6))
+        self._last_activity = time.time()
         for dev, dt in times.items():
             self._device_times.setdefault(dev, []).append(dt)
         self._fence_records.append((time.time(), times))
@@ -346,16 +468,29 @@ class RunObserver:
                 else:
                     return
             # deque(maxlen=...): O(1) eviction once the timeline cap is
-            # hit (metrics.jsonl still holds the full series).
+            # hit (metrics.jsonl still holds the full series, and the
+            # _probe_seen counter makes the eviction visible as
+            # `probes_truncated` in timings.json / trace.json).
             self._probe_records.append(rec)
+            self._probe_seen += 1
             self._metrics.log(self._step_index, probe=name, value=value,
                               **meta)
+        if self.flight is not None:
+            try:
+                fval = float(value)
+            except (TypeError, ValueError):
+                fval = value
+            self.flight.record('probe', name=name, value=fval, **meta)
 
     def record_section(self, name, start_s, duration_s):
         """Register one labelled wall-clock span (e.g. a bench section)
         for the ``trace.json`` timeline."""
         if self.enabled:
             self._sections.append((name, start_s, duration_s))
+            if self.flight is not None:
+                self.flight.record('section', name=name,
+                                   duration_s=round(duration_s, 6))
+            self._last_activity = time.time()
             if self.watchdog is not None:
                 # A completed section is both a heartbeat and the
                 # last-completed span a hang report should name.
@@ -373,6 +508,7 @@ class RunObserver:
         # logs its epoch record.
         with self._probe_lock:
             self._metrics.log(step, **metrics)
+        self._last_activity = time.time()
         if self.watchdog is not None:
             # Epoch-boundary host work (eval loops, checkpointing) beats
             # through its log calls, so only genuine stalls trip the
@@ -456,6 +592,227 @@ class RunObserver:
             }
         return out
 
+    # -- live plane --------------------------------------------------------
+
+    @staticmethod
+    def _advertise_host():
+        """Hostname peers should scrape this plane at (loopback when
+        the hostname cannot be determined — the single-host case)."""
+        import socket
+        try:
+            return socket.gethostname() or '127.0.0.1'
+        except OSError:
+            return '127.0.0.1'
+
+    def _on_dispatch(self, kernel, outcome, reason):
+        """Registry dispatch sink: every kernel decision lands in the
+        flight recorder as it happens."""
+        if self.flight is not None:
+            self.flight.record('dispatch', kernel=kernel,
+                               outcome=outcome, reason=reason)
+
+    def _on_compile_event(self, rec):
+        """CompileWatcher event sink (runs under the listener lock:
+        keep it to one ring append)."""
+        if self.flight is not None:
+            self.flight.record('compile', compile_kind=rec.get('kind'),
+                               duration_s=rec.get('duration_s'),
+                               label=rec.get('label'))
+
+    def set_gauge(self, name, value):
+        """Publish one named live gauge (e.g. the guard's
+        ``skip_count``/``consec_bad`` counters fetched at the CLI's
+        print boundary): shown in ``/healthz`` and exported as
+        ``dgmc_<name>`` in ``/metrics``."""
+        if not self.enabled:
+            return
+        self._live_gauges[str(name)] = value
+
+    def flight_dump(self, reason, extra=None):
+        """Dump the flight recorder now (``flight.json``); the anomaly
+        trigger shared by the watchdog, the fence guard and the
+        rollback guard. No-op (returns ``None``) when disabled; never
+        raises (and must not take locks — the watchdog may call it on
+        the signal path)."""
+        if self.flight is None:
+            return None
+        return self.flight.dump(reason, extra=extra)
+
+    def _recovery_summary(self):
+        """Condensed supervisor state for ``/healthz``: a supervised
+        child's obs dir is ``<root>/attempt_<k>[/host_<i>]`` and
+        ``recovery.json`` lives at the root — walk up only through
+        those supervisor-named levels so an unrelated file is never
+        picked up."""
+        cur = os.path.abspath(self.dir)
+        for _ in range(3):
+            path = os.path.join(cur, 'recovery.json')
+            try:
+                with open(path) as f:
+                    rec = json.load(f)
+            except (OSError, ValueError):
+                rec = None
+            if rec:
+                return {'outcome': rec.get('outcome'),
+                        'restarts': rec.get('restarts'),
+                        'degradations': len(rec.get('degradations', [])),
+                        'elastic': len(rec.get('elastic', []))}
+            name = os.path.basename(cur)
+            if not (name.startswith('attempt_')
+                    or name.startswith('host_')):
+                break
+            cur = os.path.dirname(cur)
+        return None
+
+    def health(self):
+        """The ``/healthz`` payload. ``healthy`` goes false — the
+        endpoint answers 503 — when the watchdog heartbeat is older
+        than ``STALE_AFTER_FACTOR x deadline``, the SAME definition
+        the supervisor applies to the heartbeat file: one health
+        verdict, two vantage points. Without an armed deadline there
+        is no staleness definition and the plane reports healthy."""
+        now = time.time()
+        wd = self.watchdog
+        deadline = wd.deadline_s if wd is not None else None
+        last = wd._last_event if wd is not None else self._last_activity
+        age = now - last
+        stale_after = (self._live_mod.STALE_AFTER_FACTOR * deadline
+                       if deadline else None)
+        out = {
+            'healthy': stale_after is None or age <= stale_after,
+            'time': now,
+            'pid': os.getpid(),
+            'port': self.live_port,
+            'heartbeat_age_s': round(age, 3),
+            'stale_after_s': stale_after,
+            'steps_completed': self._step_index,
+        }
+        if wd is not None:
+            in_flight = dict(wd._in_flight)
+            in_flight['since_s'] = round(
+                now - in_flight.pop('since'), 3)
+            out['in_flight'] = in_flight
+            out['watchdog_deadline_s'] = deadline
+            out['hang_dumps'] = wd.dump_count
+        if self._live_gauges:
+            out['gauges'] = dict(self._live_gauges)
+        if self.flight is not None:
+            out['flight'] = self.flight.counters()
+        recovery = self._recovery_summary()
+        if recovery:
+            out['recovery'] = recovery
+        return out
+
+    def _efficiency_headline(self):
+        """(mfu, arith_intensity) from the last flushed efficiency
+        snapshot, the same headline convention ``obs.report`` uses."""
+        eff = self._last_efficiency or {}
+        mfu = eff.get('mfu')
+        intensity = None
+        programs = eff.get('programs', {})
+        for name in ('train_step', *sorted(programs)):
+            ai = programs.get(name, {}).get('arith_intensity')
+            if ai is not None:
+                intensity = ai
+                break
+        return mfu, intensity
+
+    def prometheus_metrics(self):
+        """The ``/metrics`` exposition text (Prometheus 0.0.4)."""
+        live = self._live_mod
+        steps = self.timer.summary()
+        health = self.health()
+        families = [
+            ('dgmc_up', 'gauge', 'Run observer alive.', [('', {}, 1)]),
+            ('dgmc_healthy', 'gauge',
+             'Health verdict (the /healthz 200-vs-503 bit).',
+             [('', {}, 1 if health['healthy'] else 0)]),
+            ('dgmc_heartbeat_age_seconds', 'gauge',
+             'Seconds since the last watchdog heartbeat event.',
+             [('', {}, health['heartbeat_age_s'])]),
+            ('dgmc_steps_total', 'counter', 'Completed steps.',
+             [('', {}, self._step_index)]),
+            live.histogram_family(
+                'dgmc_step_latency_seconds',
+                'Step wall-clock latency (streaming fixed buckets).',
+                self._live_hist.snapshot()),
+        ]
+        if steps.get('mean_s'):
+            families.append((
+                'dgmc_step_throughput_steps_per_sec', 'gauge',
+                'Reciprocal mean step time over the run.',
+                [('', {}, 1.0 / steps['mean_s'])]))
+        comp = self._watcher.summary() if self._watcher else {}
+        by_label = comp.get('by_label') or {}
+        if by_label:
+            families.append((
+                'dgmc_compile_events_total', 'counter',
+                'XLA compile events (incl. cache hits) per label.',
+                [('', {'label': lb}, d['events'])
+                 for lb, d in sorted(by_label.items())]))
+            families.append((
+                'dgmc_compile_seconds_total', 'counter',
+                'XLA compile seconds per label.',
+                [('', {'label': lb}, d['compile_s'])
+                 for lb, d in sorted(by_label.items())]))
+        rows = self._since(dispatch_table(), self._dispatch_base)
+        if rows:
+            families.append((
+                'dgmc_kernel_dispatch_total', 'counter',
+                'Kernel-dispatch decisions by site/outcome/reason.',
+                [('', {'kernel': r.get('kernel', '?'),
+                       'outcome': r.get('outcome', '?'),
+                       'reason': r.get('reason', '?')}, r['count'])
+                 for r in rows]))
+        probe_summary = self.probe_summary()
+        if probe_summary:
+            last_samples, count_samples = [], []
+            for name, agg in sorted(probe_summary.items()):
+                count_samples.append(
+                    ('', {'probe': name}, agg.get('count', 0)))
+                if isinstance(agg.get('last'), (int, float)):
+                    last_samples.append(
+                        ('', {'probe': name}, agg['last']))
+            families.append((
+                'dgmc_probe_events_total', 'counter',
+                'In-graph probe events per probe.', count_samples))
+            if last_samples:
+                families.append((
+                    'dgmc_probe_last', 'gauge',
+                    'Most recent value per in-graph probe.',
+                    last_samples))
+        mfu, intensity = self._efficiency_headline()
+        if mfu is not None:
+            families.append((
+                'dgmc_mfu', 'gauge',
+                'Model FLOPs utilization (last efficiency snapshot).',
+                [('', {}, mfu)]))
+        if intensity is not None:
+            families.append((
+                'dgmc_arith_intensity', 'gauge',
+                'Achieved arithmetic intensity, FLOPs/byte (last '
+                'efficiency snapshot).', [('', {}, intensity)]))
+        if self.flight is not None:
+            counters = self.flight.counters()
+            families.append((
+                'dgmc_flight_events_total', 'counter',
+                'Events recorded by the flight recorder.',
+                [('', {}, counters['events_seen'])]))
+            families.append((
+                'dgmc_flight_events_dropped_total', 'counter',
+                'Flight-recorder events evicted by the ring cap.',
+                [('', {}, counters['events_truncated'])]))
+            families.append((
+                'dgmc_flight_dumps_total', 'counter',
+                'flight.json anomaly dumps.',
+                [('', {}, counters['dumps'])]))
+        for name, value in sorted(self._live_gauges.items()):
+            if isinstance(value, (int, float)):
+                families.append((
+                    f'dgmc_{name}', 'gauge',
+                    f'Run-published gauge {name}.', [('', {}, value)]))
+        return live.prometheus_exposition(families)
+
     def _watchdog_context(self):
         """Run-state snapshot for the hang report (called from the
         watchdog thread; cached there for the lock-free signal path)."""
@@ -491,6 +848,19 @@ class RunObserver:
             out['device_steps'] = self.device_step_summary()
         if self._probe_agg:
             out['probes'] = self.probe_summary()
+            # The trace timeline keeps a bounded window of the probe
+            # series (MAX_TRACE_PROBES); publish how much the window
+            # clipped so a consumer of trace.json knows the timeline
+            # is partial (the aggregates above still cover everything).
+            with self._probe_lock:
+                out['probes_truncated'] = max(
+                    0, self._probe_seen - len(self._probe_records))
+        if self.flight is not None:
+            # Same silent-cap contract for the flight ring: the counts
+            # make an evicted window visible in timings.json.
+            counters = self.flight.counters()
+            out['flight'] = counters
+            out['events_truncated'] = counters['events_truncated']
         if self.first_nonfinite is not None:
             out['first_nonfinite'] = self.first_nonfinite
         return out
@@ -507,13 +877,20 @@ class RunObserver:
         if self._costs:
             from dgmc_tpu.obs import cost as cost_mod
             steps = self.timer.summary()
-            self._write('efficiency.json', cost_mod.efficiency_payload(
-                self._costs, fallback_step_time_s=steps.get('p50_s')))
+            payload = cost_mod.efficiency_payload(
+                self._costs, fallback_step_time_s=steps.get('p50_s'))
+            # The live plane's "last efficiency snapshot": /metrics
+            # serves MFU/intensity from exactly what efficiency.json
+            # last said.
+            self._last_efficiency = payload
+            self._write('efficiency.json', payload)
         from dgmc_tpu.obs.trace import export_chrome_trace
         with self._probe_lock:
             # Snapshot: the deque may receive callback-thread appends
             # while the exporter iterates.
             probe_records = list(self._probe_records)
+            probes_truncated = max(
+                0, self._probe_seen - len(probe_records))
         export_chrome_trace(
             os.path.join(self.dir, 'trace.json'),
             step_spans=self.timer.spans,
@@ -521,7 +898,10 @@ class RunObserver:
             compile_events=self._watcher.events if self._watcher else (),
             sections=self._sections,
             device_fences=self._fence_records,
-            metadata={'argv': sys.argv})
+            # The timeline is a bounded window over the probe series;
+            # the count makes the clipping visible to trace consumers.
+            metadata={'argv': sys.argv,
+                      'probes_truncated': probes_truncated})
 
     def close(self):
         # Probe teardown first, and independent of `enabled`: a
@@ -549,10 +929,20 @@ class RunObserver:
         if self.watchdog is not None:
             self.watchdog.close()
             self.watchdog = None
+        if self._dispatch_sink is not None:
+            from dgmc_tpu.obs.registry import remove_dispatch_sink
+            remove_dispatch_sink(self._dispatch_sink)
+            self._dispatch_sink = None
         self.snapshot_memory('end')
         self.flush()
         self._metrics.close()
         self._watcher.close()
+        if self._server is not None:
+            # Last: the plane keeps answering through the final flush,
+            # so a prober never sees the port die before the artifacts
+            # settle.
+            self._server.close()
+            self._server = None
         self.enabled = False
 
     def __enter__(self):

@@ -1,0 +1,209 @@
+"""Longitudinal bench trajectory: the committed rounds as one table.
+
+``obs.diff`` compares exactly two runs; the repo's performance HISTORY
+lives in the committed round records — ``BENCH_r*.json`` (single-chip),
+``MULTICHIP_r*.json`` (sharded), ``SCALE_r*.json`` (streamed
+million-entity) — and has so far been invisible except by opening each
+file. This CLI walks one or more directories, parses every round record
+it finds (both the modern structured schema of r06+ and the legacy
+``{'cmd', 'rc', 'tail', 'parsed'}`` driver capture of r01–r05), and
+renders the trajectory per family::
+
+    python -m dgmc_tpu.obs.timeline benchmarks/          # table
+    python -m dgmc_tpu.obs.timeline benchmarks/ . --json # machine-readable
+
+Columns are the headline series the ROADMAP tracks: throughput
+(pairs/s), step p50, MFU, modeled overlap fraction, skew, device count,
+and the round's outcome (``rc:124`` rounds — the silent-hang era — show
+up as exactly that). Like every other obs reader, this module has **no
+jax import**: it renders committed evidence on any box.
+"""
+
+import argparse
+import json
+import os
+import re
+import sys
+
+from dgmc_tpu.obs.observe import fmt_seconds
+
+__all__ = ['collect_rounds', 'parse_round', 'render', 'main']
+
+_ROUND_FILE = re.compile(r'^(BENCH|MULTICHIP|SCALE)_r(\d+)\.json$')
+#: Family render order (matches the chronology: single-chip first).
+_FAMILIES = ('BENCH', 'MULTICHIP', 'SCALE')
+
+
+def _get(d, *path):
+    for key in path:
+        if not isinstance(d, dict):
+            return None
+        d = d.get(key)
+    return d
+
+
+def _first(*vals):
+    for v in vals:
+        if v is not None:
+            return v
+    return None
+
+
+def parse_round(family, number, path):
+    """One normalized row from a round record (any schema vintage).
+
+    Returns ``{'family', 'round', 'file', 'outcome', 'devices',
+    'pairs_per_sec', 'step_p50_ms', 'mfu', 'overlap', 'skew',
+    'device'}`` — absent measurements are ``None``, never guessed.
+    """
+    try:
+        with open(path) as f:
+            d = json.load(f)
+    except (OSError, ValueError) as e:
+        return {'family': family, 'round': number,
+                'file': os.path.basename(path),
+                'outcome': f'unreadable ({type(e).__name__})'}
+    # r01-r05 driver captures keep the measurement under 'parsed';
+    # r06+ structured records keep it under 'result' (BENCH) or at the
+    # top level (MULTICHIP/SCALE).
+    parsed = d.get('parsed') or {}
+    result = d.get('result') or {}
+    rc = d.get('rc')
+    outcome = _first(_get(d, 'supervision', 'outcome'),
+                     _get(d, 'supervision', 'outcome_8dev'),
+                     d.get('outcome'))
+    if outcome is None:
+        if rc == 0 or d.get('ok'):
+            outcome = 'completed'
+        elif d.get('skipped'):
+            outcome = 'skipped'
+        elif rc is not None:
+            outcome = f'rc:{rc}'
+        else:
+            outcome = '?'
+    restarts = _first(_get(d, 'supervision', 'restarts'),
+                      _get(d, 'supervision', 'restarts_8dev'))
+    if restarts:
+        outcome = f'{outcome} ({restarts} restarts)'
+    row = {
+        'family': family,
+        'round': number,
+        'file': os.path.basename(path),
+        'outcome': outcome,
+        'devices': d.get('n_devices'),
+        'device': _first(result.get('device'), parsed.get('device'),
+                         _get(d, 'environment', 'platform')),
+        'pairs_per_sec': _first(
+            result.get('value') if result.get('metric')
+            == 'train_pairs_per_sec' else None,
+            parsed.get('value') if parsed.get('metric')
+            == 'train_pairs_per_sec' else None),
+        'step_p50_ms': _first(
+            _get(d, 'timing', 'step_p50_ms_8dev'),
+            _get(d, 'timing', 'step_p50_ms'),
+            _get(result, 'sparse_dbp15k', 'f32', 'step_ms'),
+            _get(result, 'sparse_dbp15k', 'step_ms'),
+            _get(parsed, 'sparse_dbp15k', 'step_ms')),
+        'mfu': _first(_get(result, 'dense_perf', 'mfu'),
+                      _get(parsed, 'dense_perf', 'mfu'),
+                      d.get('mfu')),
+        'overlap': _first(
+            _get(d, 'analysis_fields', 'overlap_fraction'),
+            _get(result, 'dense_perf', 'overlap_fraction'),
+            d.get('overlap_fraction'),
+            _get(d, 'timing', 'overlap_fraction')),
+        'skew': _get(d, 'timing', 'per_device_step_skew_ratio'),
+    }
+    # Truncate the long prose device/platform strings to their lead.
+    if isinstance(row['device'], str):
+        row['device'] = row['device'].split('(')[0].strip() or None
+    return row
+
+
+def collect_rounds(paths):
+    """All round rows under ``paths`` (files or directories, searched
+    non-recursively), sorted by (family, round). Duplicate
+    family/round pairs keep every file (distinct directories can
+    legitimately both hold a round — the table shows the file)."""
+    rows = []
+    for p in paths:
+        if os.path.isfile(p):
+            m = _ROUND_FILE.match(os.path.basename(p))
+            if m:
+                rows.append(parse_round(m.group(1), int(m.group(2)), p))
+            continue
+        try:
+            names = sorted(os.listdir(p))
+        except OSError:
+            continue
+        for name in names:
+            m = _ROUND_FILE.match(name)
+            if m:
+                rows.append(parse_round(m.group(1), int(m.group(2)),
+                                        os.path.join(p, name)))
+    fam_rank = {f: i for i, f in enumerate(_FAMILIES)}
+    rows.sort(key=lambda r: (fam_rank.get(r['family'], len(fam_rank)),
+                             r['round'], r['file']))
+    return rows
+
+
+def _fmt(v, spec='{:.4g}'):
+    return '-' if v is None else spec.format(v)
+
+
+def render(rows):
+    lines = []
+    for family in _FAMILIES:
+        fam_rows = [r for r in rows if r['family'] == family]
+        if not fam_rows:
+            continue
+        lines.append(f'== {family} trajectory ==')
+        lines.append(f'  {"round":>5} {"pairs/s":>9} {"step p50":>11} '
+                     f'{"MFU":>8} {"overlap":>8} {"skew":>7} '
+                     f'{"dev":>4}  outcome')
+        for r in fam_rows:
+            p50 = r.get('step_p50_ms')
+            p50 = fmt_seconds(p50 / 1e3) if p50 is not None else '-'
+            mfu = r.get('mfu')
+            mfu = f'{mfu:.2%}' if mfu is not None else '-'
+            lines.append(
+                f'  {r["round"]:>5} {_fmt(r.get("pairs_per_sec")):>9} '
+                f'{p50:>11} {mfu:>8} {_fmt(r.get("overlap")):>8} '
+                f'{_fmt(r.get("skew"), "{:.3f}x"):>7} '
+                f'{_fmt(r.get("devices"), "{:d}"):>4}  '
+                f'{r.get("outcome", "?")}')
+    if not lines:
+        lines.append('(no BENCH_r*/MULTICHIP_r*/SCALE_r*.json rounds '
+                     'found)')
+    return '\n'.join(lines)
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        prog='python -m dgmc_tpu.obs.timeline',
+        description='Render the longitudinal trajectory of committed '
+                    'bench rounds (BENCH_r*/MULTICHIP_r*/SCALE_r*.json) '
+                    'across directories.')
+    parser.add_argument('paths', nargs='*', default=None,
+                        help='directories (or round files) to scan; '
+                             'default: benchmarks/ and the current '
+                             'directory')
+    parser.add_argument('--json', action='store_true',
+                        help='print the machine-readable rows')
+    args = parser.parse_args(argv)
+
+    paths = args.paths or ['benchmarks', '.']
+    rows = collect_rounds(paths)
+    if args.json:
+        print(json.dumps(rows, indent=1))
+    else:
+        print(render(rows))
+    if not rows:
+        print(f'timeline: no round records under {paths}',
+              file=sys.stderr)
+        return 2
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

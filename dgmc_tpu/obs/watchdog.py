@@ -106,12 +106,25 @@ class Watchdog:
             ``dgmc_tpu/resilience/supervisor.py``) watches its age — a
             process too wedged to run even this thread goes stale and
             gets killed, the layer below the in-process deadline dump.
+        advertise: extra keys merged into every heartbeat payload —
+            how the run advertises its live-telemetry ``port``
+            (``--obs-port``) so the supervisor and ``obs.aggregate``
+            can discover per-attempt endpoints from the heartbeat file
+            alone, without out-of-band configuration.
+        on_dump: callable ``(reason)`` invoked after every hang-report
+            dump (deadline and signal paths alike) — the flight
+            recorder's anomaly trigger. Runs on the dumping thread,
+            possibly the lock-free signal path, so it must not take
+            locks the main thread could hold; exceptions are swallowed.
     """
 
     def __init__(self, report_path, deadline_s=None, context_fn=None,
-                 signals=(), poll_s=None, heartbeat_path=None):
+                 signals=(), poll_s=None, heartbeat_path=None,
+                 advertise=None, on_dump=None):
         self.report_path = report_path
         self.heartbeat_path = heartbeat_path
+        self.advertise = dict(advertise or {})
+        self._on_dump = on_dump
         self.deadline_s = deadline_s or None
         self._context_fn = context_fn
         self._signals = tuple(signals)
@@ -228,6 +241,11 @@ class Watchdog:
             ctx = self._cached_context or {}
             if 'steps_completed' in ctx:
                 payload['steps_completed'] = ctx['steps_completed']
+            if self.advertise:
+                # The live-plane port (and anything else the owner
+                # advertises): endpoint discovery rides the existing
+                # liveness file instead of a side channel.
+                payload.update(self.advertise)
             from dgmc_tpu.utils.io import write_json_atomic
             write_json_atomic(self.heartbeat_path, payload, quiet=True)
         except Exception:
@@ -312,12 +330,23 @@ class Watchdog:
         }
         if extra:
             report.update(extra)
+        path = None
         try:
             tmp = f'{self.report_path}.tmp.{os.getpid()}'
             with open(tmp, 'w') as f:
                 json.dump(report, f, indent=1, default=str)
             os.replace(tmp, self.report_path)
+            path = self.report_path
+            self.dump_count += 1
         except Exception:
-            return None
-        self.dump_count += 1
-        return self.report_path
+            pass
+        if self._on_dump is not None:
+            # Anomaly fan-out (the flight recorder): fires even when
+            # the report write itself failed — the trailing-context
+            # record is independent evidence, and on the signal path
+            # the callee must already be lock-free by contract.
+            try:
+                self._on_dump(reason)
+            except Exception:
+                pass
+        return path

@@ -391,7 +391,8 @@ class FenceGuard:
     """
 
     def __init__(self, report_path, deadline_s, phase, step=None,
-                 channel=None, on_timeout='exit', context_fn=None):
+                 channel=None, on_timeout='exit', context_fn=None,
+                 on_dump=None):
         if on_timeout not in ('exit', 'report'):
             raise ValueError(f'on_timeout must be "exit" or "report", '
                              f'got {on_timeout!r}')
@@ -402,6 +403,11 @@ class FenceGuard:
         self.channel = channel
         self.on_timeout = on_timeout
         self._context_fn = context_fn
+        #: Anomaly fan-out (the flight recorder's fence-timeout
+        #: trigger): called with the report's reason string after the
+        #: report is written, BEFORE any os._exit — the last code this
+        #: process runs, so it must never raise (and is wrapped anyway).
+        self._on_dump = on_dump
         self._timer = None
         self._entered_at = None
         self._lock = threading.Lock()
@@ -472,6 +478,11 @@ class FenceGuard:
             except Exception:
                 pass
         write_json_atomic(self.report_path, report, indent=1, quiet=True)
+        if self._on_dump is not None:
+            try:
+                self._on_dump(report['reason'])
+            except Exception:
+                pass
         if self.on_timeout == 'exit':
             os._exit(FENCE_TIMEOUT_RC)
 

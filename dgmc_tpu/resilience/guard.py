@@ -75,4 +75,13 @@ class RollbackGuard:
                          event='rollback',
                          rollback_to=self._snapshot_step,
                          consec_bad=int(consec_bad))
+            # A rollback is an anomaly: dump the flight recorder's
+            # trailing context (the probe values and spans that led
+            # into the non-finite streak) next to the rollback record.
+            flight_dump = getattr(self.obs, 'flight_dump', None)
+            if flight_dump is not None:
+                flight_dump('guard-rollback', extra={
+                    'rollback_to': self._snapshot_step,
+                    'consec_bad': int(consec_bad),
+                    'rollbacks': self.rollbacks})
         return rolled, True

@@ -16,6 +16,12 @@ CLI command in a child process and closes the detection→recovery loop:
   The layering matters: the in-process watchdog thread catches a main
   thread wedged in one XLA call; the out-of-process heartbeat watch
   catches a process too far gone to run even its watchdog thread.
+  A child that advertises a live-telemetry port (``--obs-port``; the
+  port rides in ``heartbeat.json``) is additionally monitored through
+  its ``/healthz`` endpoint — the SAME staleness verdict, evaluated
+  in-process by the child's own plane — with the file heartbeats as
+  the fallback whenever the scrape fails; a 503 kills the child as
+  ``healthz-stale``.
 - **Repeated failure at the same step**: a graceful-degradation ladder
   rewrites the child's command before the next restart —
   ``DGMC_TPU_DISABLE_FUSED=1`` (every Pallas gate picks its XLA
@@ -339,6 +345,8 @@ class Supervisor:
         self.restarts = 0
         self.outcome = 'running'
         self._stop_signal = None
+        #: port -> (scrape_time, verdict) for the /healthz watch.
+        self._healthz_cache = {}
 
     # -- recording ---------------------------------------------------------
 
@@ -458,6 +466,32 @@ class Supervisor:
         except (OSError, ValueError):
             return None
 
+    def _healthz_verdict(self, host, port, now):
+        """Scrape a child's ``/healthz`` (at the host+port its
+        heartbeat advertises): ``True`` = endpoint says healthy,
+        ``False`` = endpoint EXPLICITLY says stale (a ``healthy:
+        false`` body — the 503), ``None`` = the scrape failed
+        (unreachable, garbage, or an errored handler answering 500
+        with no verdict) — fall back to the heartbeat file; a failed
+        scrape must never condemn the child on its own. Scrapes are
+        throttled per endpoint so a tight poll loop does not hammer
+        the child's plane; a cached verdict under 1 s old is reused."""
+        key = (host, port)
+        cached = self._healthz_cache.get(key)
+        if cached is not None and now - cached[0] < 1.0:
+            return cached[1]
+        from dgmc_tpu.obs.live import probe_healthz
+        res = probe_healthz(port, host=host, timeout_s=2.0)
+        verdict = None
+        if res is not None:
+            code, payload = res
+            if 'healthy' in payload:
+                verdict = bool(payload['healthy'])
+            elif code == 200:
+                verdict = True
+        self._healthz_cache[key] = (now, verdict)
+        return verdict
+
     def _latest_ckpt_step(self):
         if self.ckpt_dir and os.path.isdir(self.ckpt_dir):
             steps = [int(d) for d in os.listdir(self.ckpt_dir)
@@ -558,7 +592,7 @@ class Supervisor:
         ladder still reaches the shrink rung."""
         from dgmc_tpu.resilience.distributed_guard import FENCE_TIMEOUT_RC
         return (reason.startswith(('peer-death', 'hang-report',
-                                   'heartbeat-stale'))
+                                   'heartbeat-stale', 'healthz-stale'))
                 or reason == f'exit:{FENCE_TIMEOUT_RC}')
 
     def _adopt_ledger_mesh(self, argv, attempt):
@@ -588,7 +622,11 @@ class Supervisor:
     def _watch(self, proc, heartbeat_path, hang_report_path,
                control_dir=None):
         """Wait for child exit; return a hang reason if WE killed it."""
-        stale_after = (2.0 * self.hang_deadline_s
+        # One health definition: the same factor the child's /healthz
+        # endpoint applies (obs/live.py) — a 503 from the plane and a
+        # heartbeat-file staleness kill are the same verdict.
+        from dgmc_tpu.obs.live import STALE_AFTER_FACTOR
+        stale_after = (STALE_AFTER_FACTOR * self.hang_deadline_s
                        if self.hang_deadline_s else None)
         first_beat_by = None
         if stale_after and heartbeat_path:
@@ -631,11 +669,27 @@ class Supervisor:
                 beats = [hb for hb in map(
                     self._read_heartbeat,
                     self._candidate_paths(heartbeat_path)) if hb]
-                if beats and any(
-                        time.time() - hb.get('time', 0) > stale_after
-                        for hb in beats):
-                    self._kill(proc, 'heartbeat-stale')
-                    return 'heartbeat-stale'
+                now = time.time()
+                for hb in beats:
+                    # Endpoint-aware first: a heartbeat advertising a
+                    # live port gets its verdict from /healthz — the
+                    # child's own plane evaluating the SAME staleness
+                    # definition live, immune to heartbeat-file write
+                    # lag. The file age is the fallback whenever the
+                    # scrape fails (no plane, port gone, timeout).
+                    port = hb.get('port')
+                    verdict = None
+                    if port:
+                        verdict = self._healthz_verdict(
+                            hb.get('host') or '127.0.0.1', port, now)
+                    if verdict is True:
+                        continue
+                    if verdict is False:
+                        self._kill(proc, 'healthz-stale')
+                        return 'healthz-stale'
+                    if now - hb.get('time', 0) > stale_after:
+                        self._kill(proc, 'heartbeat-stale')
+                        return 'heartbeat-stale'
                 # ...but the doubt is bounded: a child wedged BEFORE its
                 # watchdog thread exists (imports, distributed init with
                 # a host that never joins) writes neither heartbeat nor

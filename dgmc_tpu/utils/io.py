@@ -16,17 +16,19 @@ __all__ = ['write_json_atomic']
 
 
 def write_json_atomic(path, payload, *, indent=None, sort_keys=False,
-                      quiet=False):
+                      quiet=False, default=None):
     """Write ``payload`` as JSON to ``path`` via tmp+rename (atomic on
     POSIX within one filesystem). Creates parent directories. With
     ``quiet=True`` an ``OSError`` is swallowed and reported as a
     ``False`` return — for telemetry writers that must never take the
-    run down with them."""
+    run down with them. ``default`` passes through to ``json.dump``
+    (e.g. ``str`` for payloads that may carry arbitrary objects)."""
     tmp = f'{path}.tmp.{os.getpid()}'
     try:
         os.makedirs(os.path.dirname(path) or '.', exist_ok=True)
         with open(tmp, 'w') as f:
-            json.dump(payload, f, indent=indent, sort_keys=sort_keys)
+            json.dump(payload, f, indent=indent, sort_keys=sort_keys,
+                      default=default)
         os.replace(tmp, path)
         return True
     except OSError:

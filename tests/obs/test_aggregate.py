@@ -227,3 +227,65 @@ def test_fence_devices_noops(tmp_path):
     assert disabled.fence_devices(jnp.ones(())) is None
     with RunObserver(str(tmp_path / 'obs')) as obs:
         assert obs.fence_devices(3.5) is None       # non-jax input
+
+
+def test_scrape_probes_advertised_endpoints(tmp_path):
+    """--scrape: per-host live /healthz verdicts discovered from the
+    port each heartbeat.json advertises; an unreachable endpoint is
+    flagged, a host without a port is untouched."""
+    from dgmc_tpu.obs.live import TelemetryServer
+    h0 = _host(tmp_path, 'host_0', device_means=(0.1,))
+    h1 = _host(tmp_path, 'host_1', device_means=(0.1,))
+    _host(tmp_path, 'host_2', device_means=(0.1,))
+    srv_ok = TelemetryServer(
+        0, health_fn=lambda: {'healthy': True,
+                              'heartbeat_age_s': 0.5}).start()
+    srv_bad = TelemetryServer(
+        0, health_fn=lambda: {'healthy': False}).start()
+    dead_port = srv_bad.port
+    try:
+        json.dump({'time': 1.0, 'pid': 1, 'port': srv_ok.port},
+                  open(os.path.join(h0, 'heartbeat.json'), 'w'))
+        json.dump({'time': 1.0, 'pid': 2, 'port': srv_bad.port},
+                  open(os.path.join(h1, 'heartbeat.json'), 'w'))
+        s = agg_mod.aggregate(str(tmp_path), scrape=True)
+        live0 = s['per_host']['host_0']['live']
+        assert live0['healthy'] is True
+        assert live0['heartbeat_age_s'] == 0.5
+        live1 = s['per_host']['host_1']['live']
+        assert live1['healthy'] is False
+        assert 'live' not in s['per_host']['host_2']
+        assert s['live_unhealthy_hosts'] == ['host_1']
+        text = agg_mod.render(s)
+        assert 'LIVE-UNHEALTHY HOSTS' in text
+        assert f':{srv_ok.port} ok' in text
+    finally:
+        srv_ok.close()
+        srv_bad.close()
+    # Endpoint gone with a FRESH heartbeat -> a live anomaly
+    # (unreachable); with a STALE heartbeat -> the run simply ended
+    # (leftover advertisement), NOT flagged live-unhealthy.
+    import time as _time
+    json.dump({'time': _time.time(), 'pid': 2, 'port': dead_port},
+              open(os.path.join(h1, 'heartbeat.json'), 'w'))
+    s = agg_mod.aggregate(str(tmp_path), scrape=True)
+    live1 = s['per_host']['host_1']['live']
+    assert live1.get('unreachable') is True
+    assert live1['port'] == dead_port
+    assert 'host_1' in s['live_unhealthy_hosts']
+    json.dump({'time': 1.0, 'pid': 2, 'port': dead_port},
+              open(os.path.join(h1, 'heartbeat.json'), 'w'))
+    s = agg_mod.aggregate(str(tmp_path), scrape=True)
+    live1 = s['per_host']['host_1']['live']
+    assert live1.get('ended') is True
+    assert 'host_1' not in s['live_unhealthy_hosts']
+    assert f':{dead_port} ended' in agg_mod.render(s)
+
+
+def test_without_scrape_no_live_blocks(tmp_path):
+    h0 = _host(tmp_path, 'host_0', device_means=(0.1,))
+    json.dump({'time': 1.0, 'pid': 1, 'port': 1},
+              open(os.path.join(h0, 'heartbeat.json'), 'w'))
+    s = agg_mod.aggregate(str(tmp_path))
+    assert 'live' not in s['per_host']['host_0']
+    assert 'live_unhealthy_hosts' not in s

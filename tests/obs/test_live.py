@@ -1,0 +1,543 @@
+"""Live telemetry plane: streaming histogram vs the exact percentile,
+Prometheus exposition pinned by a strict line-grammar parser (not a
+substring check), /healthz 200→503 on a stalled heartbeat, heartbeat
+port advertisement, and the flight recorder's ring/dump semantics.
+jax-free except the RunObserver integration tests (which run no jitted
+code — the plane is host-side by construction).
+"""
+
+import json
+import math
+import os
+import random
+import re
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from dgmc_tpu.obs.live import (DEFAULT_LATENCY_BOUNDS, FlightRecorder,
+                               STALE_AFTER_FACTOR, StreamingHistogram,
+                               TelemetryServer, histogram_family,
+                               probe_healthz, prometheus_exposition)
+from dgmc_tpu.obs.observe import percentile
+
+# ---------------------------------------------------------------------------
+# Strict Prometheus text-format parser (the 0.0.4 line grammar). Every
+# line must be a comment, blank, or a sample matching the grammar —
+# anything else raises. This is the pin the acceptance criteria ask
+# for: /metrics output must PARSE, not merely contain substrings.
+# ---------------------------------------------------------------------------
+
+_METRIC_RE = re.compile(r'^[a-zA-Z_:][a-zA-Z0-9_:]*$')
+_LABEL_RE = re.compile(r'^[a-zA-Z_][a-zA-Z0-9_]*$')
+_VALUE_RE = re.compile(
+    r'^(?:[+-]?Inf|NaN|[+-]?(?:\d+\.?\d*|\.\d+)(?:[eE][+-]?\d+)?)$')
+
+
+def _parse_labels(text):
+    """Parse ``{k="v",...}`` handling escapes; returns (labels, rest)."""
+    assert text.startswith('{'), text
+    i, labels = 1, {}
+    while True:
+        if text[i] == '}':
+            return labels, text[i + 1:]
+        m = re.match(r'[a-zA-Z_][a-zA-Z0-9_]*', text[i:])
+        assert m, f'bad label name at {text[i:]!r}'
+        name = m.group(0)
+        assert _LABEL_RE.match(name)
+        i += len(name)
+        assert text[i] == '=', text[i:]
+        assert text[i + 1] == '"', text[i:]
+        i += 2
+        val = []
+        while text[i] != '"':
+            if text[i] == '\\':
+                esc = text[i + 1]
+                assert esc in ('\\', '"', 'n'), f'bad escape \\{esc}'
+                val.append({'\\': '\\', '"': '"', 'n': '\n'}[esc])
+                i += 2
+            else:
+                assert text[i] != '\n'
+                val.append(text[i])
+                i += 1
+        i += 1
+        labels[name] = ''.join(val)
+        if text[i] == ',':
+            i += 1
+
+
+def parse_exposition(text):
+    """{metric_base: {'type', 'help', 'samples': [(name, labels, value)]}}
+    — raises AssertionError on any line violating the grammar."""
+    assert text.endswith('\n'), 'exposition must end with a newline'
+    families = {}
+    current = None
+    for line in text.split('\n')[:-1]:
+        if not line:
+            continue
+        if line.startswith('# HELP '):
+            rest = line[len('# HELP '):]
+            name, _, help_text = rest.partition(' ')
+            assert _METRIC_RE.match(name), name
+            current = families.setdefault(
+                name, {'type': None, 'help': None, 'samples': []})
+            current['help'] = help_text
+            continue
+        if line.startswith('# TYPE '):
+            parts = line.split(' ')
+            assert len(parts) == 4, line
+            name, mtype = parts[2], parts[3]
+            assert _METRIC_RE.match(name), name
+            assert mtype in ('counter', 'gauge', 'histogram', 'summary',
+                             'untyped'), mtype
+            current = families.setdefault(
+                name, {'type': None, 'help': None, 'samples': []})
+            current['type'] = mtype
+            continue
+        assert not line.startswith('#'), f'unknown comment: {line!r}'
+        m = re.match(r'[a-zA-Z_:][a-zA-Z0-9_:]*', line)
+        assert m, f'bad sample line: {line!r}'
+        name = m.group(0)
+        rest = line[len(name):]
+        labels = {}
+        if rest.startswith('{'):
+            labels, rest = _parse_labels(rest)
+        assert rest.startswith(' '), f'bad sample line: {line!r}'
+        value = rest[1:]
+        assert _VALUE_RE.match(value), f'bad value: {value!r} in {line!r}'
+        base = re.sub(r'_(bucket|sum|count)$', '', name)
+        fam = families.get(base) or families.get(name)
+        assert fam is not None, f'sample {name} without TYPE/HELP'
+        fam['samples'].append((name, labels, float(value)))
+    return families
+
+
+# ---------------------------------------------------------------------------
+# StreamingHistogram
+# ---------------------------------------------------------------------------
+
+def test_histogram_bucket_counts_are_exact():
+    bounds = (0.1, 1.0, 10.0)
+    h = StreamingHistogram(bounds)
+    values = [0.05, 0.1, 0.3, 1.0, 5.0, 50.0]
+    for v in values:
+        h.observe(v)
+    snap = h.snapshot()
+    # Prometheus le semantics: count of values <= bound, cumulative.
+    assert snap['buckets'] == [
+        (0.1, 2),          # 0.05, 0.1 (le is inclusive)
+        (1.0, 4),          # + 0.3, 1.0
+        (10.0, 5),         # + 5.0
+        (math.inf, 6),     # everything
+    ]
+    assert snap['count'] == 6
+    assert snap['sum'] == pytest.approx(sum(values))
+
+
+def test_histogram_rejects_bad_bounds():
+    with pytest.raises(ValueError):
+        StreamingHistogram(())
+    with pytest.raises(ValueError):
+        StreamingHistogram((1.0, 1.0))
+    with pytest.raises(ValueError):
+        StreamingHistogram((1.0, 0.5))
+    with pytest.raises(ValueError):
+        StreamingHistogram((1.0, math.inf))
+
+
+def test_histogram_matches_exact_percentile_on_same_series():
+    """The O(1) histogram against observe.percentile on the identical
+    series: every cumulative bucket count must equal the exact count of
+    values <= the bound, and the histogram quantile (a bucket upper
+    edge) must bracket the exact percentile from below-neighbor to
+    itself — the resolution contract of fixed buckets."""
+    rng = random.Random(7)
+    values = [rng.lognormvariate(-2.0, 2.0) for _ in range(500)]
+    h = StreamingHistogram()
+    for v in values:
+        h.observe(v)
+    snap = h.snapshot()
+    for bound, cum in snap['buckets'][:-1]:
+        assert cum == sum(v <= bound for v in values), bound
+    ordered = sorted(values)
+    bounds = (0.0,) + DEFAULT_LATENCY_BOUNDS
+    for q in (0.5, 0.9, 0.95, 0.99):
+        exact = percentile(ordered, q)
+        upper = h.quantile(q)
+        assert exact <= upper
+        lower = max((b for b in bounds if b < upper), default=0.0)
+        # The exact percentile sits in the quantile's bucket (strictly
+        # above its lower edge unless ties straddle the boundary).
+        assert exact > lower or exact == upper
+
+
+def test_histogram_count_equals_step_count():
+    h = StreamingHistogram()
+    for i in range(37):
+        h.observe(0.01 * (i + 1))
+    assert h.count == 37
+    assert h.snapshot()['buckets'][-1][1] == 37
+
+
+# ---------------------------------------------------------------------------
+# Exposition rendering
+# ---------------------------------------------------------------------------
+
+def test_exposition_parses_and_escapes_labels():
+    nasty = 'quo"te back\\slash new\nline'
+    text = prometheus_exposition([
+        ('dgmc_test_gauge', 'gauge', 'help with "quotes" and \\ stuff',
+         [('', {'label': nasty, 'other': 'plain'}, 1.5)]),
+        ('dgmc_test_total', 'counter', None, [('', {}, 7)]),
+    ])
+    fams = parse_exposition(text)
+    g = fams['dgmc_test_gauge']
+    assert g['type'] == 'gauge'
+    (name, labels, value), = g['samples']
+    assert name == 'dgmc_test_gauge'
+    # Round trip: the strict parser recovers the original value.
+    assert labels == {'label': nasty, 'other': 'plain'}
+    assert value == 1.5
+    assert fams['dgmc_test_total']['samples'] == [
+        ('dgmc_test_total', {}, 7.0)]
+
+
+def test_exposition_sanitizes_bad_metric_and_label_names():
+    text = prometheus_exposition([
+        ('bad-metric.name', 'gauge', None,
+         [('', {'bad-label.name': 'v', '0numeric': 'w'}, 1)])])
+    fams = parse_exposition(text)    # must not raise
+    (name, labels, _), = fams['bad_metric_name']['samples']
+    assert name == 'bad_metric_name'
+    assert set(labels) == {'bad_label_name', '_0numeric'}
+
+
+def test_exposition_histogram_family_shape():
+    h = StreamingHistogram((0.5, 2.0))
+    for v in (0.1, 1.0, 10.0):
+        h.observe(v)
+    text = prometheus_exposition(
+        [histogram_family('dgmc_lat_seconds', 'latency', h.snapshot())])
+    fams = parse_exposition(text)
+    fam = fams['dgmc_lat_seconds']
+    assert fam['type'] == 'histogram'
+    buckets = [(labels['le'], v) for name, labels, v in fam['samples']
+               if name.endswith('_bucket')]
+    assert buckets == [('0.5', 1.0), ('2.0', 2.0), ('+Inf', 3.0)]
+    by_name = {name: v for name, labels, v in fam['samples']
+               if not name.endswith('_bucket')}
+    assert by_name['dgmc_lat_seconds_count'] == 3.0
+    assert by_name['dgmc_lat_seconds_sum'] == pytest.approx(11.1)
+    # Cumulative counts are monotone and end at _count.
+    vals = [v for _, v in buckets]
+    assert vals == sorted(vals) and vals[-1] == 3.0
+
+
+# ---------------------------------------------------------------------------
+# FlightRecorder
+# ---------------------------------------------------------------------------
+
+def test_flight_ring_evicts_and_counts(tmp_path):
+    path = str(tmp_path / 'flight.json')
+    fr = FlightRecorder(path, capacity=8)
+    for i in range(20):
+        fr.record('step', step=i)
+    assert fr.seen == 20
+    assert fr.recorded == 8
+    assert fr.truncated == 12
+    out = fr.dump('test-anomaly', extra={'detail': 'x'})
+    assert out == path
+    payload = json.load(open(path))
+    assert payload['reason'] == 'test-anomaly'
+    assert payload['events_seen'] == 20
+    assert payload['events_recorded'] == 8
+    assert payload['events_truncated'] == 12
+    assert payload['detail'] == 'x'
+    # The ring kept the LAST events — trailing context, not leading.
+    assert [e['step'] for e in payload['events']] == list(range(12, 20))
+
+
+def test_flight_dump_sanitizes_nonfinite(tmp_path):
+    fr = FlightRecorder(str(tmp_path / 'flight.json'))
+    fr.record('probe', name='grad_norm', value=float('nan'))
+    fr.record('probe', name='loss', value=float('inf'))
+    payload = json.load(open(fr.dump('nan-probe')))   # strict parse
+    assert payload['events'][0]['value'] is None
+    assert payload['events'][1]['value'] is None
+
+
+def test_flight_dump_without_path_is_noop():
+    fr = FlightRecorder(None)
+    fr.record('x')
+    assert fr.dump('r') is None
+    assert fr.dump_count == 0
+
+
+# ---------------------------------------------------------------------------
+# TelemetryServer + probe_healthz
+# ---------------------------------------------------------------------------
+
+def test_server_endpoints_and_health_codes():
+    state = {'healthy': True, 'detail': 'fine'}
+    srv = TelemetryServer(
+        0, health_fn=lambda: dict(state),
+        metrics_fn=lambda: prometheus_exposition(
+            [('dgmc_up', 'gauge', None, [('', {}, 1)])]),
+        status_fn=lambda: {'steps': 3}).start()
+    try:
+        code, payload = probe_healthz(srv.port)
+        assert code == 200 and payload['healthy'] is True
+        state['healthy'] = False
+        code, payload = probe_healthz(srv.port)
+        assert code == 503 and payload['healthy'] is False
+        resp = urllib.request.urlopen(
+            f'http://127.0.0.1:{srv.port}/metrics')
+        assert resp.headers['Content-Type'].startswith(
+            'text/plain; version=0.0.4')
+        parse_exposition(resp.read().decode())
+        status = json.loads(urllib.request.urlopen(
+            f'http://127.0.0.1:{srv.port}/status').read())
+        assert status == {'steps': 3}
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(f'http://127.0.0.1:{srv.port}/nope')
+        assert err.value.code == 404
+    finally:
+        srv.close()
+    assert probe_healthz(srv.port) is None
+
+
+def test_server_callback_error_is_a_500_not_a_crash():
+    def broken():
+        raise RuntimeError('boom')
+    srv = TelemetryServer(0, health_fn=broken,
+                          status_fn=lambda: {'ok': 1}).start()
+    try:
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(
+                f'http://127.0.0.1:{srv.port}/healthz')
+        assert err.value.code == 500
+        # The server survives and keeps answering.
+        status = json.loads(urllib.request.urlopen(
+            f'http://127.0.0.1:{srv.port}/status').read())
+        assert status == {'ok': 1}
+    finally:
+        srv.close()
+
+
+# ---------------------------------------------------------------------------
+# RunObserver integration (host-side only; no jitted code)
+# ---------------------------------------------------------------------------
+
+def _observer(tmp_path, **kw):
+    from dgmc_tpu.obs.run import RunObserver
+    return RunObserver(str(tmp_path / 'obs'), **kw)
+
+
+def test_observer_serves_live_plane(tmp_path):
+    obs = _observer(tmp_path, obs_port=0)
+    try:
+        assert obs.live_port
+        for _ in range(3):
+            with obs.step():
+                time.sleep(0.002)
+        obs.set_gauge('guard_skip_count', 2)
+        obs.log(1, loss=0.25)
+        code, hz = probe_healthz(obs.live_port)
+        assert code == 200 and hz['healthy']
+        assert hz['steps_completed'] == 3
+        assert hz['gauges'] == {'guard_skip_count': 2}
+        assert hz['flight']['events_seen'] >= 6   # 3 span pairs
+        text = urllib.request.urlopen(
+            f'http://127.0.0.1:{obs.live_port}/metrics').read().decode()
+        fams = parse_exposition(text)
+        assert fams['dgmc_steps_total']['samples'] == [
+            ('dgmc_steps_total', {}, 3.0)]
+        hist = fams['dgmc_step_latency_seconds']
+        count = [v for n, _, v in hist['samples']
+                 if n.endswith('_count')]
+        assert count == [3.0]
+        assert fams['dgmc_guard_skip_count']['samples'][0][2] == 2.0
+        assert fams['dgmc_healthy']['samples'][0][2] == 1.0
+        status = json.loads(urllib.request.urlopen(
+            f'http://127.0.0.1:{obs.live_port}/status').read())
+        assert status['steps']['steps'] == 3
+        assert status['flight']['events_recorded'] >= 6
+        port = obs.live_port
+    finally:
+        obs.close()
+    # The plane dies with the observer.
+    assert probe_healthz(port) is None
+
+
+def test_healthz_goes_503_on_stalled_heartbeat_and_dumps_flight(
+        tmp_path):
+    """The acceptance transition: 200 while beating, 503 once the
+    heartbeat is older than STALE_AFTER_FACTOR x deadline — and the
+    deadline trip dumps flight.json whose trailing events are the
+    run's last spans."""
+    deadline = 0.2
+    obs = _observer(tmp_path, obs_port=0,
+                    watchdog_deadline_s=deadline, watchdog_signals=())
+    try:
+        with obs.step():
+            time.sleep(0.002)
+        code, hz = probe_healthz(obs.live_port)
+        assert code == 200 and hz['healthy']
+        assert hz['stale_after_s'] == pytest.approx(
+            STALE_AFTER_FACTOR * deadline)
+        # Stall: no beats. Wait past the stale bound (and the dump).
+        deadline_hit = time.time() + 10.0
+        while time.time() < deadline_hit:
+            code, hz = probe_healthz(obs.live_port)
+            if code == 503:
+                break
+            time.sleep(0.05)
+        assert code == 503 and not hz['healthy'], hz
+        flight_path = os.path.join(obs.dir, 'flight.json')
+        for _ in range(100):          # the watchdog thread dumps async
+            if os.path.exists(flight_path):
+                break
+            time.sleep(0.05)
+        flight = json.load(open(flight_path))
+        assert flight['reason'] == 'deadline'
+        kinds = [e['kind'] for e in flight['events']]
+        assert 'span-start' in kinds and 'span-end' in kinds
+        assert os.path.exists(os.path.join(obs.dir, 'hang_report.json'))
+    finally:
+        obs.close()
+
+
+def test_heartbeat_advertises_port_and_pid(tmp_path):
+    obs = _observer(tmp_path, obs_port=0, watchdog_deadline_s=60.0,
+                    watchdog_signals=())
+    try:
+        hb_path = os.path.join(obs.dir, 'heartbeat.json')
+        for _ in range(100):
+            if os.path.exists(hb_path):
+                break
+            time.sleep(0.02)
+        hb = json.load(open(hb_path))
+        assert hb['port'] == obs.live_port
+        assert hb['pid'] == os.getpid()
+        # The scrape address for peers on shared obs filesystems: a
+        # remote aggregate/supervisor must not probe 127.0.0.1 and
+        # find its own plane.
+        assert hb['host']
+    finally:
+        obs.close()
+
+
+def test_truncation_counters_reach_timings_and_trace(tmp_path,
+                                                     monkeypatch):
+    """Satellite: the bounded probe timeline and the flight ring must
+    record how much they clipped — aggregates over a partial window
+    are visibly partial."""
+    import dgmc_tpu.obs.run as run_mod
+    monkeypatch.setattr(run_mod, 'MAX_TRACE_PROBES', 4)
+    obs = _observer(tmp_path)
+    try:
+        import collections
+        obs._probe_records = collections.deque(maxlen=4)
+        for i in range(10):
+            obs._on_probe({'probe': 'corr_entropy', 'value': float(i),
+                           'time': time.time()})
+        t = obs.timings()
+        assert t['probes_truncated'] == 6
+        assert t['flight']['events_seen'] == 10
+        obs.flush()
+        trace = json.load(open(os.path.join(obs.dir, 'trace.json')))
+        assert trace['otherData']['probes_truncated'] == 6
+        timings = json.load(open(os.path.join(obs.dir,
+                                              'timings.json')))
+        assert timings['probes_truncated'] == 6
+        assert timings['events_truncated'] == 0
+    finally:
+        obs.close()
+
+
+def test_flight_records_dispatch_decisions(tmp_path):
+    from dgmc_tpu.obs.registry import record_dispatch
+    obs = _observer(tmp_path)
+    try:
+        record_dispatch('topk', 'fallback', 'backend=cpu')
+        events = obs.flight.snapshot()
+        assert {'kind': 'dispatch', 'kernel': 'topk',
+                'outcome': 'fallback', 'reason': 'backend=cpu'} == {
+                    k: v for k, v in events[-1].items() if k != 'time'}
+    finally:
+        obs.close()
+    # Closed observer detaches its sink: no more flight growth.
+    seen = obs.flight.seen
+    record_dispatch('topk', 'fallback', 'backend=cpu')
+    assert obs.flight.seen == seen
+
+
+def test_flight_dump_on_observer_api(tmp_path):
+    obs = _observer(tmp_path)
+    try:
+        with obs.step():
+            pass
+        path = obs.flight_dump('guard-rollback',
+                               extra={'consec_bad': 3})
+        payload = json.load(open(path))
+        assert payload['reason'] == 'guard-rollback'
+        assert payload['consec_bad'] == 3
+    finally:
+        obs.close()
+
+
+def test_port_collision_degrades_to_no_plane(tmp_path):
+    """Two processes handed the same fixed --obs-port must not die:
+    the loser keeps observing without a plane (telemetry never takes
+    the run down)."""
+    a = _observer(tmp_path / 'a', obs_port=0)
+    try:
+        b = _observer(tmp_path / 'b', obs_port=a.live_port)
+        try:
+            assert b.live_port is None
+            assert b.enabled
+            with b.step():
+                pass                      # still fully functional
+        finally:
+            b.close()
+    finally:
+        a.close()
+
+
+def test_disabled_observer_flight_dump_is_noop():
+    from dgmc_tpu.obs.run import RunObserver
+    obs = RunObserver(None)
+    assert obs.flight_dump('anything') is None
+    obs.set_gauge('x', 1)    # no-op, no raise
+    obs.close()
+
+
+def test_rollback_guard_dumps_flight(tmp_path, monkeypatch):
+    """The guard-rollback anomaly trigger: RollbackGuard reaches the
+    observer's flight_dump hook (duck-typed) when it restores a
+    snapshot."""
+    from dgmc_tpu.resilience.guard import RollbackGuard
+
+    class _State:
+        step = 5
+
+        def replace(self, **kw):
+            return self
+
+    import dgmc_tpu.train.checkpoint as ckpt
+    monkeypatch.setattr(ckpt, 'snapshot_params', lambda s: {'p': 1})
+    monkeypatch.setattr(ckpt, 'restore_params', lambda s, snap: s)
+    obs = _observer(tmp_path)
+    try:
+        guard = RollbackGuard(2, obs=obs)
+        state = _State()
+        guard.note_good(state, step=3)
+        _, rolled = guard.maybe_rollback(state, consec_bad=2, step=5)
+        assert rolled
+        flight = json.load(open(os.path.join(obs.dir, 'flight.json')))
+        assert flight['reason'] == 'guard-rollback'
+        assert flight['rollback_to'] == 3
+    finally:
+        obs.close()

@@ -155,6 +155,8 @@ def test_nonfinite_attribution_uses_pipeline_order_not_arrival():
     obs._probe_lock = threading.Lock()
     obs._probe_agg = probes.Aggregator()
     obs._probe_records = collections.deque(maxlen=10)
+    obs._probe_seen = 0
+    obs.flight = None
     obs._step_index = 0
     obs.first_nonfinite = None
     from dgmc_tpu.obs.observe import MetricLogger

@@ -1,0 +1,122 @@
+"""obs.timeline: longitudinal round trajectory over both round-record
+schemas (the legacy r01–r05 driver captures and the structured r06+
+records), plus the CLI contract against the repo's own committed
+rounds."""
+
+import json
+import os
+import subprocess
+import sys
+
+from dgmc_tpu.obs.timeline import collect_rounds, parse_round, render
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _write(tmp_path, name, payload):
+    p = tmp_path / name
+    p.write_text(json.dumps(payload))
+    return str(p)
+
+
+def test_parses_legacy_driver_capture(tmp_path):
+    _write(tmp_path, 'BENCH_r04.json', {
+        'n': 4, 'cmd': 'python bench.py', 'rc': 0, 'tail': '...',
+        'parsed': {'metric': 'train_pairs_per_sec', 'value': 1248.9,
+                   'device': 'TPU v5 lite',
+                   'dense_perf': {'mfu': 0.0194},
+                   'sparse_dbp15k': {'step_ms': 306.5}}})
+    _write(tmp_path, 'BENCH_r05.json', {
+        'n': 5, 'cmd': 'python bench.py', 'rc': 124, 'tail': ''})
+    rows = collect_rounds([str(tmp_path)])
+    assert [r['round'] for r in rows] == [4, 5]
+    r4, r5 = rows
+    assert r4['pairs_per_sec'] == 1248.9
+    assert r4['mfu'] == 0.0194
+    assert r4['step_p50_ms'] == 306.5
+    assert r4['outcome'] == 'completed'
+    assert r5['outcome'] == 'rc:124'
+    assert r5['pairs_per_sec'] is None
+
+
+def test_parses_structured_rounds(tmp_path):
+    _write(tmp_path, 'BENCH_r06.json', {
+        'round': 6, 'rc': 0, 'ok': True,
+        'supervision': {'outcome': 'completed', 'restarts': 2},
+        'result': {'metric': 'train_pairs_per_sec', 'value': 16.97,
+                   'device': 'cpu',
+                   'dense_perf': {'mfu': 1.09},
+                   'sparse_dbp15k': {'f32': {'step_ms': 11507.9}}}})
+    _write(tmp_path, 'MULTICHIP_r08.json', {
+        'round': 8, 'n_devices': 8, 'rc': 0, 'ok': True,
+        'supervision': {'outcome': 'completed', 'restarts': 0},
+        'timing': {'step_p50_ms_8dev': 659.1,
+                   'per_device_step_skew_ratio': 1.0}})
+    _write(tmp_path, 'SCALE_r07.json', {
+        'round': 7, 'n_devices': 8,
+        'supervision': {'outcome_8dev': 'completed',
+                        'restarts_8dev': 0},
+        'timing': {'step_p50_ms_8dev': 412275.0,
+                   'per_device_step_skew_ratio': 1.0}})
+    rows = collect_rounds([str(tmp_path)])
+    assert [(r['family'], r['round']) for r in rows] == [
+        ('BENCH', 6), ('MULTICHIP', 8), ('SCALE', 7)]
+    bench, multi, scale = rows
+    assert bench['pairs_per_sec'] == 16.97
+    assert bench['step_p50_ms'] == 11507.9
+    assert bench['outcome'] == 'completed (2 restarts)'
+    assert multi['step_p50_ms'] == 659.1
+    assert multi['skew'] == 1.0
+    assert multi['devices'] == 8
+    assert scale['step_p50_ms'] == 412275.0
+    text = render(rows)
+    assert 'BENCH trajectory' in text
+    assert 'MULTICHIP trajectory' in text
+    assert 'SCALE trajectory' in text
+
+
+def test_unreadable_round_is_a_row_not_a_crash(tmp_path):
+    (tmp_path / 'BENCH_r09.json').write_text('{not json')
+    rows = collect_rounds([str(tmp_path)])
+    assert rows[0]['outcome'].startswith('unreadable')
+    render(rows)    # must not raise
+
+
+def test_non_round_files_ignored(tmp_path):
+    _write(tmp_path, 'BENCH_BASELINE.json', {'value': 1})
+    _write(tmp_path, 'corr_shard_memory.json', {'x': 1})
+    assert collect_rounds([str(tmp_path)]) == []
+
+
+def test_parse_round_single_file(tmp_path):
+    p = _write(tmp_path, 'MULTICHIP_r01.json', {
+        'n_devices': 8, 'rc': 1, 'tail': ''})
+    row = parse_round('MULTICHIP', 1, p)
+    assert row['outcome'] == 'rc:1'
+    assert row['devices'] == 8
+
+
+def test_cli_over_committed_repo_rounds():
+    """The committed evidence itself: the repo's benchmarks/ and root
+    hold the r01+ rounds, and the CLI must render them — BENCH r06's
+    headline throughput included."""
+    out = subprocess.run(
+        [sys.executable, '-m', 'dgmc_tpu.obs.timeline',
+         'benchmarks', '.', '--json'],
+        cwd=REPO, capture_output=True, text=True)
+    assert out.returncode == 0, out.stderr
+    rows = json.loads(out.stdout)
+    by_key = {(r['family'], r['round']): r for r in rows}
+    assert by_key[('BENCH', 6)]['pairs_per_sec'] == 16.97
+    assert by_key[('MULTICHIP', 8)]['step_p50_ms'] == 659.1
+    assert by_key[('SCALE', 7)]['outcome'].startswith('completed')
+    # The rc:124 era is visible, not hidden: r05 of both families.
+    assert by_key[('BENCH', 5)]['outcome'] == 'rc:124'
+
+
+def test_cli_empty_dir_exits_2(tmp_path):
+    out = subprocess.run(
+        [sys.executable, '-m', 'dgmc_tpu.obs.timeline', str(tmp_path)],
+        capture_output=True, text=True, cwd=REPO)
+    assert out.returncode == 2

@@ -1,0 +1,130 @@
+"""Flight recorder end to end through the real CLI: an injected stall
+under the supervisor must leave a ``flight.json`` whose last recorded
+span matches the span ``hang_report.json`` names — the acceptance
+criterion of the live-telemetry plane. Slow: each test is a full jax
+bring-up in a child process (same harness as test_elastic.py)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from dgmc_tpu.resilience.distributed_guard import FENCE_TIMEOUT_RC
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+SYN = ['--synthetic', '--syn_nodes_s', '48', '--syn_nodes_t', '64',
+       '--syn_edges_s', '160', '--syn_edges_t', '224', '--syn_dim', '16',
+       '--dim', '16', '--rnd_dim', '8', '--num_layers', '1',
+       '--num_steps', '2', '--k', '5', '--phase1_epochs', '1',
+       '--epochs', '3', '--seed', '11']
+
+
+def _run_cli(tmp_path, tag, extra, timeout=900, expect_rc=0):
+    env = dict(os.environ, JAX_PLATFORMS='cpu',
+               JAX_ENABLE_COMPILATION_CACHE='false')
+    log = tmp_path / f'{tag}.log'
+    with open(log, 'w') as fh:
+        proc = subprocess.run(
+            [sys.executable, '-m', 'dgmc_tpu.experiments.dbp15k']
+            + SYN + extra,
+            cwd=REPO, env=env, stdout=fh, stderr=subprocess.STDOUT,
+            timeout=timeout)
+    out = log.read_text()
+    assert proc.returncode == expect_rc, (tag, proc.returncode,
+                                          out[-3000:])
+    return out
+
+
+def _report(obs):
+    rep = subprocess.run(
+        [sys.executable, '-m', 'dgmc_tpu.obs.report', str(obs)],
+        cwd=REPO, capture_output=True, text=True,
+        env=dict(os.environ, JAX_PLATFORMS='cpu'), timeout=120)
+    assert rep.returncode == 0, rep.stderr[-2000:]
+    return rep.stdout
+
+
+@pytest.mark.slow
+def test_collective_stall_flight_matches_hang_report_fence(tmp_path):
+    """collective-stall@2 inside the epoch fence under --supervise:
+    the fence guard exits rc 67 AND dumps flight.json, whose last
+    recorded span is the very fence hang_report.json's in-flight span
+    names — the trailing-context + stack-dump pair."""
+    obs = tmp_path / 'obs'
+    out = _run_cli(
+        tmp_path, 'stall',
+        ['--obs-dir', str(obs),
+         '--watchdog-deadline', '120', '--fence-deadline', '3',
+         '--inject-fault', 'collective-stall@2:90',
+         '--supervise', '--max-restarts', '0',
+         '--restart-backoff', '0.1'],
+        expect_rc=FENCE_TIMEOUT_RC)
+    assert 'firing collective-stall@2 inside the step-2 fence' in out
+
+    attempt = obs / 'attempt_0'
+    hang = json.load(open(attempt / 'hang_report.json'))
+    assert hang['reason'].startswith('fence-deadline')
+    assert hang['in_flight']['phase'] == 'fence'
+
+    flight = json.load(open(attempt / 'flight.json'))
+    assert flight['reason'].startswith('fence-deadline')
+    spans = [e for e in flight['events']
+             if str(e.get('kind', '')).startswith('span')]
+    last = spans[-1]
+    # The flight's last recorded span IS the wedged fence: an
+    # un-exited span-start whose name carries the fence phase@step
+    # hang_report attributes the stall to.
+    assert last['kind'] == 'span-start'
+    assert last['phase'] == hang['in_flight']['phase'] == 'fence'
+    assert last['name'] == (f"{hang['fence']['phase']}"
+                            f"@{hang['fence']['step']}")
+
+    rec = json.load(open(obs / 'recovery.json'))
+    assert rec['attempts'][0]['reason'] == f'exit:{FENCE_TIMEOUT_RC}'
+
+    # obs.report renders the flight timeline for the supervised root.
+    text = _report(obs)
+    assert 'flight recorder' in text
+    assert 'fence-deadline' in text
+
+
+@pytest.mark.slow
+def test_host_stall_flight_matches_hang_report_last_span(tmp_path):
+    """Plain stall@2 (a host-side wedge between steps) under the
+    supervisor: the watchdog deadline dumps hang_report + flight; the
+    flight's last completed span equals hang_report's last_completed,
+    and the supervisor kills on the hang report."""
+    obs = tmp_path / 'obs'
+    _run_cli(
+        tmp_path, 'hoststall',
+        ['--obs-dir', str(obs),
+         '--watchdog-deadline', '30',
+         '--inject-fault', 'stall@2:600',
+         '--supervise', '--max-restarts', '0',
+         '--restart-backoff', '0.1'],
+        expect_rc=1)
+
+    attempt = obs / 'attempt_0'
+    hang = json.load(open(attempt / 'hang_report.json'))
+    # The watchdog dumps on the DEADLINE first (what the supervisor
+    # keys its kill on); the supervisor's SIGTERM then re-dumps via
+    # the signal path, replacing the file — both spellings are the
+    # same stall, and which one survives is a race we don't pin.
+    assert hang['reason'].startswith(('deadline', 'signal:'))
+    last_completed = hang['last_completed']
+    assert last_completed['phase'] == 'step'
+
+    flight = json.load(open(attempt / 'flight.json'))
+    assert flight['reason'].startswith(('deadline', 'signal:'))
+    ends = [e for e in flight['events'] if e.get('kind') == 'span-end'
+            and e.get('phase') == 'step']
+    assert ends, flight['events']
+    assert ends[-1]['step'] == last_completed['name']
+
+    rec = json.load(open(obs / 'recovery.json'))
+    assert rec['outcome'] == 'gave-up'
+    assert rec['attempts'][0]['reason'] == 'hang-report'

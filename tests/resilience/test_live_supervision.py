@@ -1,0 +1,161 @@
+"""Endpoint-aware supervision: a child that advertises a live
+``/healthz`` port in its heartbeat is monitored through the endpoint —
+a 503 kills it as ``healthz-stale`` even while its heartbeat FILE stays
+fresh, and a healthy endpoint keeps it alive even when the file is
+stale (write lag must not kill a provably-live child). File heartbeats
+remain the fallback when the scrape fails. jax-free on both sides,
+like test_supervisor.py."""
+
+import json
+import sys
+
+from dgmc_tpu.resilience.supervisor import Supervisor
+
+#: Toy child serving a real /healthz with a scripted verdict while
+#: keeping (or aging) its heartbeat FILE independently — the two
+#: vantage points the supervisor must rank correctly. Attempt index
+#: persists in a counter file; attempt >= 1 exits clean so kill tests
+#: end in completion.
+CHILD = r'''
+import http.server, json, os, sys, threading, time
+counter_path, mode = sys.argv[1], sys.argv[2]
+argv = sys.argv[3:]
+obs_dir = None
+for i, tok in enumerate(argv):
+    if tok in ('--obs-dir', '--obs_dir'):
+        obs_dir = argv[i + 1]
+k = 0
+if os.path.exists(counter_path):
+    k = json.load(open(counter_path))['attempt'] + 1
+json.dump({'attempt': k}, open(counter_path, 'w'))
+if k >= 1:
+    sys.exit(0)
+
+healthy = (mode == 'healthy')
+
+
+class H(http.server.BaseHTTPRequestHandler):
+    def log_message(self, *a):
+        pass
+
+    def do_GET(self):
+        if mode == 'erroring':
+            # An errored handler: 500 with no 'healthy' verdict —
+            # must read as a FAILED scrape, not as "stale".
+            body = json.dumps({'error': 'boom'}).encode()
+            self.send_response(500)
+        else:
+            body = json.dumps({'healthy': healthy}).encode()
+            self.send_response(200 if healthy else 503)
+        self.send_header('Content-Type', 'application/json')
+        self.send_header('Content-Length', str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+
+srv = http.server.ThreadingHTTPServer(('127.0.0.1', 0), H)
+threading.Thread(target=srv.serve_forever, daemon=True).start()
+port = srv.server_address[1]
+os.makedirs(obs_dir, exist_ok=True)
+hb = os.path.join(obs_dir, 'heartbeat.json')
+
+
+def beat(t):
+    json.dump({'time': t, 'pid': os.getpid(), 'port': port},
+              open(hb, 'w'))
+
+
+if mode == 'unhealthy':
+    # FRESH file heartbeats forever: only the endpoint says stale —
+    # the kill must be attributed to /healthz, not the file.
+    end = time.time() + 60
+    while time.time() < end:
+        beat(time.time())
+        time.sleep(0.05)
+    sys.exit(1)
+elif mode == 'erroring':
+    # 500-answering endpoint + FRESH file heartbeats: the failed
+    # scrape must fall back to the (healthy) file — no kill; the
+    # child completes on its own.
+    end = time.time() + 1.2
+    while time.time() < end:
+        beat(time.time())
+        time.sleep(0.05)
+    sys.exit(0)
+elif mode == 'healthy':
+    # Endpoint healthy, file heartbeat ANCIENT: the live verdict must
+    # outrank the stale file, and the run completes untouched.
+    beat(time.time() - 3600)
+    time.sleep(1.2)
+    sys.exit(0)
+elif mode == 'dead-port':
+    # Advertises a port nothing listens on: scrape fails -> file
+    # fallback; the file is stale -> heartbeat-stale, as before.
+    srv.shutdown()
+    srv.server_close()
+    json.dump({'time': time.time() - 3600, 'pid': os.getpid(),
+               'port': port}, open(hb, 'w'))
+    time.sleep(60)
+'''
+
+
+def _supervise(tmp_path, mode, **kw):
+    child = tmp_path / 'child.py'
+    child.write_text(CHILD)
+    obs = tmp_path / 'obs'
+    sup = Supervisor(
+        [sys.executable, str(child), str(tmp_path / 'counter.json'),
+         mode],
+        ['--obs-dir', str(obs)],
+        obs_dir=str(obs), max_restarts=3, backoff_s=0.05,
+        grace_s=2.0, poll_s=0.05, hang_deadline_s=0.3, **kw)
+    rc = sup.run()
+    recovery = json.load(open(obs / 'recovery.json'))
+    return rc, recovery
+
+
+def test_healthz_503_kills_despite_fresh_file_heartbeat(tmp_path):
+    rc, rec = _supervise(tmp_path, 'unhealthy')
+    assert rc == 0
+    assert rec['outcome'] == 'completed'
+    assert rec['attempts'][0]['reason'] == 'healthz-stale'
+    assert rec['attempts'][1]['reason'] == 'completed'
+
+
+def test_healthy_endpoint_outranks_stale_file(tmp_path):
+    """heartbeat.json is an hour old, but /healthz answers 200: the
+    child must NOT be killed (write lag is not a hang when the plane
+    itself answers healthy) and completes on attempt 0."""
+    rc, rec = _supervise(tmp_path, 'healthy')
+    assert rc == 0
+    assert rec['outcome'] == 'completed'
+    assert rec['restarts'] == 0
+    assert rec['attempts'][0]['reason'] == 'completed'
+
+
+def test_500_endpoint_is_a_failed_scrape_not_a_stale_child(tmp_path):
+    """An erroring health handler (500, no 'healthy' key) must NOT be
+    read as a stale verdict: the supervisor falls back to the fresh
+    file heartbeat and the healthy child completes untouched."""
+    rc, rec = _supervise(tmp_path, 'erroring')
+    assert rc == 0
+    assert rec['outcome'] == 'completed'
+    assert rec['restarts'] == 0
+    assert rec['attempts'][0]['reason'] == 'completed'
+
+
+def test_unreachable_port_falls_back_to_file_heartbeat(tmp_path):
+    rc, rec = _supervise(tmp_path, 'dead-port')
+    assert rc == 0
+    assert rec['outcome'] == 'completed'
+    assert rec['attempts'][0]['reason'] == 'heartbeat-stale'
+
+
+def test_healthz_stale_is_a_distributed_failure(tmp_path):
+    """The elastic classifier treats the endpoint verdict like the
+    file verdict: a wedged collective looks identical through both."""
+    sup = Supervisor([sys.executable, '-c', 'pass'], [],
+                     obs_dir=str(tmp_path / 'obs'))
+    assert sup._is_distributed_failure('healthz-stale')
+    assert sup._is_distributed_failure('heartbeat-stale')
+    assert not sup._is_distributed_failure('exit:3')
