@@ -150,6 +150,22 @@ from dgmc_tpu.obs.cost import (PEAK_FLOPS,  # noqa: E402,F401  (re-export)
 _PROGRESS = {'sections': {}, 'current': None, 'current_t0': None,
              'in_body': False, 'start': time.time()}
 _OBS = None  # RunObserver when --obs-dir is set
+_PROF = None  # ProfileHandle when --profile-dir is set
+
+
+def _prof_step():
+    """One measured-step boundary: advances the ``--profile-steps``
+    window (bench has no RunObserver.step loop, so the measured
+    iterations themselves are the boundaries) and returns the per-step
+    trace annotation — a null context while the profiler is not
+    capturing. Warmup iterations count as boundaries too, but no
+    compile can land in-window: every wrapped step is AOT-compiled in
+    its build step, and the topk variants compile on the unwrapped
+    first fence."""
+    if _PROF is None:
+        return contextlib.nullcontext()
+    _PROF.on_step()
+    return _PROF.step_annotation()
 #: Per-section deadline budget in seconds (0 = off); set by
 #: --section-timeout. While a section runs with a budget, SIGALRM means
 #: "this section blew its budget" and raises SectionTimeout into the
@@ -402,7 +418,8 @@ def bench_dense(bf16=False):
 
     for _ in range(WARMUP):
         key, sub = jax.random.split(key)
-        state, out = step(state, batch, sub)
+        with _prof_step():
+            state, out = step(state, batch, sub)
     _fence(out['loss'])
 
     loss = np.nan
@@ -411,7 +428,8 @@ def bench_dense(bf16=False):
         nonlocal state, key, loss
         for _ in range(ITERS):
             key, sub = jax.random.split(key)
-            state, out = step(state, batch, sub)
+            with _prof_step():
+                state, out = step(state, batch, sub)
         loss = _fence(out['loss'])
 
     dt = _best_of(window)
@@ -479,7 +497,8 @@ def _bench_sparse_leg(bf16, pairs=1):
     key = jax.random.key(1)
     for _ in range(2):
         key, sub = jax.random.split(key)
-        state, out = step(state, batch, sub)
+        with _prof_step():
+            state, out = step(state, batch, sub)
     _fence(out['loss'])
 
     loss = np.nan
@@ -488,7 +507,8 @@ def _bench_sparse_leg(bf16, pairs=1):
         nonlocal state, key, loss
         for _ in range(SP_ITERS):
             key, sub = jax.random.split(key)
-            state, out = step(state, batch, sub)
+            with _prof_step():
+                state, out = step(state, batch, sub)
         loss = _fence(out['loss'])
 
     step_ms = _best_of(window) / SP_ITERS * 1e3
@@ -561,7 +581,8 @@ def bench_sparse():
 
                 def window(f=f):
                     for _ in range(TOPK_ITERS):
-                        out = f(h_s, h_t)
+                        with _prof_step():
+                            out = f(h_s, h_t)
                     _fence(out[0, 0, 0])
 
                 topk_ms[name] = round(
@@ -624,7 +645,9 @@ def main(argv=None):
                            fence_deadline_s=args.fence_deadline,
                            watchdog_signals=(signal.SIGTERM,),
                            obs_port=args.obs_port)
-    prof = start_profile(args.profile_dir)
+    global _PROF
+    _PROF = prof = start_profile(args.profile_dir,
+                                 steps=args.profile_steps)
 
     # Sparse first: the allocator's peak_bytes_in_use is process-lifetime,
     # so the sparse leg must run before anything else allocates if its

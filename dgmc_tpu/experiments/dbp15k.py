@@ -514,7 +514,8 @@ def main(argv=None):
         if args.epochs > args.phase1_epochs and start_epoch <= args.epochs:
             phase2 = aot('train_step', phase2, state, train_batch, key0)
             eval2 = aot('eval_step', eval2, state, test_batch, key0)
-    prof = start_profile(args.profile_dir)
+    prof = obs.attach_profiler(
+        start_profile(args.profile_dir, steps=args.profile_steps))
     if start_epoch > 1:
         logger.log(start_epoch - 1, event='resume')
     if is_coordinator():

@@ -192,7 +192,8 @@ def main(argv=None):
     # account covers the collective-carrying program.
     obs.record_cost('train_step', step, state, feed(batch0),
                     jax.random.key(args.seed + 3))
-    prof = start_profile(args.profile_dir)
+    prof = obs.attach_profiler(
+        start_profile(args.profile_dir, steps=args.profile_steps))
     if start_epoch > 1:
         logger.log(start_epoch - 1, event='resume')
     for epoch in range(start_epoch, args.epochs + 1):

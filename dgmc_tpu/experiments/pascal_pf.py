@@ -133,7 +133,8 @@ def main(argv=None):
     # MFU account in <obs-dir>/efficiency.json (obs/cost.py).
     obs.record_cost('train_step', step, state, batch0,
                     jax.random.key(args.seed + 2))
-    prof = start_profile(args.profile_dir)
+    prof = obs.attach_profiler(
+        start_profile(args.profile_dir, steps=args.profile_steps))
     profile_epoch = min(2, args.epochs)
     key = jax.random.key(args.seed + 1)
     for epoch in range(1, args.epochs + 1):

@@ -157,7 +157,8 @@ def main(argv=None):
     # trace, no extra XLA compile — obs/cost.py).
     obs.record_cost('train_step', step, state, batch0,
                     jax.random.key(args.seed + 4))
-    prof = start_profile(args.profile_dir)
+    prof = obs.attach_profiler(
+        start_profile(args.profile_dir, steps=args.profile_steps))
     ckpt = Checkpointer(args.ckpt_dir) if args.ckpt_dir else None
     runs_path = (os.path.join(args.ckpt_dir, 'runs.json')
                  if args.ckpt_dir else None)

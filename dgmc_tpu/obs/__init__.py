@@ -66,6 +66,23 @@ measurements come from:
   ``python -m dgmc_tpu.obs.timeline benchmarks/`` renders the
   committed ``BENCH_r*``/``MULTICHIP_r*``/``SCALE_r*.json`` rounds as
   one throughput/p50/MFU/overlap table (``--json`` for rows).
+- :mod:`~dgmc_tpu.obs.trace_events` — jax-free parser for the
+  profiler's trace-event exports (``--profile-dir``'s
+  ``plugins/profile/*/*.trace.json.gz``): device/host track
+  classification, busy-interval algebra, stage/collective event
+  classification shared with the static models.
+- :mod:`~dgmc_tpu.obs.attribution` — measured-runtime attribution:
+  ``python -m dgmc_tpu.obs.attribution <profile-dir|obs-dir>`` (also
+  ``dgmc-obs-attribution``) turns a captured profiler trace into
+  per-stage device wall-clock, comm/compute occupancy with a
+  *measured* overlap fraction, idle/gap analysis, and a
+  static-vs-measured reconciliation (measured MFU vs ``obs/cost``'s,
+  measured overlap vs ``hlo_sched``'s model) — the
+  ``attribution.json`` artifact, with headline fields merged into
+  ``efficiency.json`` for the report and the diff gates
+  (``--min-measured-overlap``, ``--max-idle-regression``). Device-less
+  captures degrade to host-track attribution with device fields
+  marked unavailable.
 
 Model code carries :func:`jax.named_scope` annotations for the matching
 pipeline's stages (``psi1``, ``initial_corr``, ``topk``,
@@ -80,7 +97,8 @@ from dgmc_tpu.obs.registry import (REGISTRY, CompileWatcher, Registry,
 from dgmc_tpu.obs.memory import memory_snapshot
 from dgmc_tpu.obs.watchdog import Watchdog
 from dgmc_tpu.obs.run import RunObserver, add_obs_flag
-from dgmc_tpu.obs.trace import (add_profile_flag, export_chrome_trace,
+from dgmc_tpu.obs.trace import (ProfileHandle, add_profile_flag,
+                                export_chrome_trace, parse_step_window,
                                 profile_span, start_profile)
 # Imported LAST: binding the trace() *function* must win over the package
 # attribute the `dgmc_tpu.obs.trace` submodule import set just above —
@@ -108,4 +126,6 @@ __all__ = [
     'export_chrome_trace',
     'profile_span',
     'start_profile',
+    'ProfileHandle',
+    'parse_step_window',
 ]

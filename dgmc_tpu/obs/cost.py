@@ -65,8 +65,26 @@ __all__ = [
     'PEAK_FLOPS', 'CPU_PEAK_FLOPS', 'STAGE_NAMES', 'COLLECTIVE_OPS',
     'peak_flops_entry', 'stage_table', 'collective_table',
     'analysis_totals', 'cost_summary', 'efficiency_payload',
-    'specimen_costs', 'main',
+    'headline_of', 'specimen_costs', 'main',
 ]
+
+
+def headline_of(payload, key):
+    """The efficiency payload's headline value for one per-program
+    ``key`` (``arith_intensity``, ``overlap_fraction``,
+    ``static_peak_bytes``, ``flops``, ...): the ``train_step``
+    program's when present, else the first program carrying one. The
+    ONE convention ``obs.report``, ``obs.diff`` (via the summary) and
+    ``obs.attribution``'s reconciliation share — so the static and
+    measured sides of a comparison always pick the same program."""
+    programs = (payload or {}).get('programs') or {}
+    ts = programs.get('train_step') or {}
+    if ts.get(key) is not None:
+        return ts[key]
+    for p in programs.values():
+        if p.get(key) is not None:
+            return p[key]
+    return None
 
 #: Documented dense-matmul peak FLOP/s per chip (bf16, public TPU spec
 #: sheets). MFU = flops / (step_time * peak) is an honest ceiling ratio:

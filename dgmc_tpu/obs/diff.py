@@ -62,10 +62,33 @@ regression thresholds:
   ``--max-peak-regression`` fails; unlike the runtime memory row it
   needs no matching measurement source, because the bound is computed
   from the compiled program alone. Lost-from-candidate fails.
+- **measured overlap** — the *measured* comm/compute overlap fraction
+  (``efficiency.json``, from the profiler-trace attribution
+  ``obs.attribution``) dropping below the ``--min-measured-overlap``
+  floor fails. Same absolute-floor / lost-account semantics as
+  ``--min-overlap``: this is the runtime truth the static model only
+  bounds — a candidate that lost the measurement the baseline had
+  fails, and the floor only gates when configured (device-less CPU
+  captures have no measured overlap to gate).
+- **idle fraction** — the attribution plane's idle headline
+  (``efficiency.json``: device idle inside the profiled window, or
+  host idle on device-less captures) growing past
+  ``--max-idle-regression`` fails; like the memory row, the two runs
+  must report the same ``idle_source`` (device idle and host idle are
+  not comparable). Lost-from-candidate fails; a zero-idle baseline
+  gates the candidate's absolute idle fraction against the threshold
+  directly (a ratio against 0 is undefined, and "we used to have no
+  idle" is exactly the baseline worth defending).
+
 - **skew** — the device step-time skew ratio (``aggregate.json``, see
   ``obs.aggregate``) growing past ``--max-skew-regression`` fails;
   runs without aggregation skip the row (the artifact is produced by a
   separate tool, so absence is not evidence of regression).
+
+When a gated key is absent from one side, the row's note names WHICH
+run lacks it and lists the gated keys that run *does* carry, so a CI
+failure is diagnosable from the log alone (is the artifact missing, or
+just this account?).
 
 Exit codes: 0 = no regression, 1 = regression, 2 = usage/missing input.
 Like the report CLI, this module has **no jax import** — it must gate CI
@@ -95,7 +118,28 @@ DEFAULT_THRESHOLDS = {
     #: default).
     'min_overlap': None,
     'static_peak': 0.25,
+    #: Absolute measured-overlap floor (obs.attribution); None = gate
+    #: off unless asked, same contract as min_overlap.
+    'min_measured_overlap': None,
+    'idle': 0.25,
 }
+
+#: Keys the gates read from a run summary — listed in missing-metric
+#: notes so a failing CI log names what the lacking run DID record.
+GATED_KEYS = (
+    'step_p50_s', 'step_p95_s', 'steps_per_sec', 'compile_events',
+    'peak_memory_bytes', 'mfu', 'arith_intensity', 'overlap_fraction',
+    'static_peak_bytes', 'measured_overlap_fraction', 'idle_fraction',
+)
+
+
+def _missing_note(side, summary):
+    """``'missing from candidate; candidate has: mfu, step_p50_s'`` —
+    the diagnosable form of a lost-account failure: which side lacks
+    the gated key, and which gated keys that run does carry."""
+    have = [k for k in GATED_KEYS if summary.get(k) is not None]
+    return (f'missing from {side}; {side} has: '
+            + (', '.join(have) if have else 'no gated metrics at all'))
 
 
 def _rel(a, b):
@@ -139,11 +183,11 @@ def diff_runs(a, b, thresholds=None, allow_kernel_fallback=False):
         va, vb = a.get(key), b.get(key)
         if va is None:
             rows.append(_row(key, va, vb, None, thr[thr_key], 'skipped',
-                             'missing from baseline'))
+                             _missing_note('baseline', a)))
             return
         if vb is None:
             rows.append(_row(key, va, vb, None, thr[thr_key], 'REGRESSION',
-                             'missing from candidate'))
+                             _missing_note('candidate', b)))
             return
         d = _rel(va, vb)
         if d is None:  # zero baseline: no meaningful ratio
@@ -221,10 +265,10 @@ def diff_runs(a, b, thresholds=None, allow_kernel_fallback=False):
     mfu_a, mfu_b = a.get('mfu'), b.get('mfu')
     if mfu_a is not None and mfu_b is None:
         rows.append(_row('mfu', mfu_a, mfu_b, None, thr['mfu'],
-                         'REGRESSION', 'missing from candidate'))
+                         'REGRESSION', _missing_note('candidate', b)))
     elif mfu_a is None and mfu_b is not None:
         rows.append(_row('mfu', mfu_a, mfu_b, None, thr['mfu'], 'skipped',
-                         'missing from baseline'))
+                         _missing_note('baseline', a)))
     elif mfu_a is not None:
         d = _rel(mfu_a, mfu_b)
         if d is None:
@@ -241,11 +285,11 @@ def diff_runs(a, b, thresholds=None, allow_kernel_fallback=False):
     if ai_a is not None and ai_b is None:
         rows.append(_row('arith_intensity', ai_a, ai_b, None,
                          thr['intensity'], 'REGRESSION',
-                         'missing from candidate'))
+                         _missing_note('candidate', b)))
     elif ai_a is None and ai_b is not None:
         rows.append(_row('arith_intensity', ai_a, ai_b, None,
                          thr['intensity'], 'skipped',
-                         'missing from baseline'))
+                         _missing_note('baseline', a)))
     elif ai_a is not None:
         d = _rel(ai_a, ai_b)
         if d is None:
@@ -264,7 +308,7 @@ def diff_runs(a, b, thresholds=None, allow_kernel_fallback=False):
     floor = thr.get('min_overlap')
     if ov_a is not None and ov_b is None:
         rows.append(_row('overlap_fraction', ov_a, ov_b, None, floor,
-                         'REGRESSION', 'missing from candidate'))
+                         'REGRESSION', _missing_note('candidate', b)))
     elif ov_b is not None and floor is not None:
         gate('overlap_fraction', ov_a, ov_b,
              None if ov_a is None else round(ov_b - ov_a, 4), floor,
@@ -277,6 +321,59 @@ def diff_runs(a, b, thresholds=None, allow_kernel_fallback=False):
                          else round(ov_b - ov_a, 4), floor, 'info',
                          'no --min-overlap floor configured'))
 
+    # -- measured comm/compute overlap ------------------------------------
+    # The profiler-trace counterpart of the modeled floor above, same
+    # semantics: absolute floor (0.0 = genuinely serialized hardware),
+    # lost-account fails, floor gates only when configured.
+    mo_a = a.get('measured_overlap_fraction')
+    mo_b = b.get('measured_overlap_fraction')
+    mfloor = thr.get('min_measured_overlap')
+    if mo_a is not None and mo_b is None:
+        rows.append(_row('measured_overlap_fraction', mo_a, mo_b, None,
+                         mfloor, 'REGRESSION',
+                         _missing_note('candidate', b)))
+    elif mo_b is not None and mfloor is not None:
+        gate('measured_overlap_fraction', mo_a, mo_b,
+             None if mo_a is None else round(mo_b - mo_a, 4), mfloor,
+             mo_b < mfloor,
+             'hardware ran the chunk loop below the measured floor'
+             if mo_b < mfloor else '')
+    elif mo_a is not None or mo_b is not None:
+        rows.append(_row('measured_overlap_fraction', mo_a, mo_b,
+                         None if None in (mo_a, mo_b)
+                         else round(mo_b - mo_a, 4), mfloor, 'info',
+                         'no --min-measured-overlap floor configured'))
+
+    # -- idle fraction (measured attribution) ------------------------------
+    # Source-matched like the memory row: device idle and host idle are
+    # different quantities. A zero-idle baseline gates the candidate's
+    # ABSOLUTE idle against the threshold (no ratio exists against 0,
+    # and a perfectly-fed baseline is the one worth defending).
+    id_a, id_b = a.get('idle_fraction'), b.get('idle_fraction')
+    isrc_a, isrc_b = a.get('idle_source'), b.get('idle_source')
+    if id_a is not None and id_b is None:
+        rows.append(_row('idle_fraction', id_a, id_b, None, thr['idle'],
+                         'REGRESSION', _missing_note('candidate', b)))
+    elif id_a is None and id_b is not None:
+        rows.append(_row('idle_fraction', id_a, id_b, None, thr['idle'],
+                         'skipped', _missing_note('baseline', a)))
+    elif id_a is not None:
+        if isrc_a != isrc_b:
+            rows.append(_row('idle_fraction', id_a, id_b, None,
+                             thr['idle'], 'skipped',
+                             f'sources differ ({isrc_a} vs {isrc_b})'))
+        else:
+            d = _rel(id_a, id_b)
+            if d is not None:
+                gate('idle_fraction', id_a, id_b, round(d, 4),
+                     thr['idle'], d > thr['idle'],
+                     f'source={isrc_a}')
+            else:
+                gate('idle_fraction', id_a, id_b, round(id_b, 4),
+                     thr['idle'], id_b > thr['idle'],
+                     f'zero-idle baseline: absolute gate, '
+                     f'source={isrc_a}')
+
     # -- static peak-live bytes -------------------------------------------
     # The liveness model's bound needs no matching measurement source
     # (it is computed from the compiled program alone), so unlike the
@@ -285,11 +382,11 @@ def diff_runs(a, b, thresholds=None, allow_kernel_fallback=False):
     if pk_a is not None and pk_b is None:
         rows.append(_row('static_peak_bytes', pk_a, pk_b, None,
                          thr['static_peak'], 'REGRESSION',
-                         'missing from candidate'))
+                         _missing_note('candidate', b)))
     elif pk_a is None and pk_b is not None:
         rows.append(_row('static_peak_bytes', pk_a, pk_b, None,
                          thr['static_peak'], 'skipped',
-                         'missing from baseline'))
+                         _missing_note('baseline', a)))
     elif pk_a is not None:
         d = _rel(pk_a, pk_b)
         if d is None:
@@ -324,10 +421,10 @@ def diff_runs(a, b, thresholds=None, allow_kernel_fallback=False):
     src_a, src_b = (a.get('peak_memory_source'), b.get('peak_memory_source'))
     if ma is not None and mb is None:
         rows.append(_row('peak_memory_bytes', ma, mb, None, thr['memory'],
-                         'REGRESSION', 'missing from candidate'))
+                         'REGRESSION', _missing_note('candidate', b)))
     elif ma is None or mb is None:
         rows.append(_row('peak_memory_bytes', ma, mb, None, thr['memory'],
-                         'skipped', 'missing from baseline'))
+                         'skipped', _missing_note('baseline', a)))
     elif src_a != src_b:
         rows.append(_row('peak_memory_bytes', ma, mb, None, thr['memory'],
                          'skipped',
@@ -455,6 +552,23 @@ def main(argv=None):
                              'a candidate below it serialized the chunk '
                              'loop (default: floor off; a lost overlap '
                              'account still fails)')
+    parser.add_argument('--min-measured-overlap', type=float,
+                        default=None, metavar='FRAC',
+                        help='absolute floor on the MEASURED '
+                             'comm/compute overlap fraction '
+                             '(efficiency.json, from the profiler-'
+                             'trace attribution obs.attribution); '
+                             'same lost-account semantics as '
+                             '--min-overlap (default: floor off)')
+    parser.add_argument('--max-idle-regression', type=float,
+                        default=DEFAULT_THRESHOLDS['idle'],
+                        metavar='FRAC',
+                        help='allowed fractional increase of the '
+                             'measured idle fraction (efficiency.json, '
+                             'obs.attribution; device idle when the '
+                             'capture has device tracks, host idle '
+                             'otherwise — sources must match to '
+                             'compare; default %(default)s)')
     parser.add_argument('--max-peak-regression', type=float,
                         default=DEFAULT_THRESHOLDS['static_peak'],
                         metavar='FRAC',
@@ -509,6 +623,8 @@ def main(argv=None):
             'restarts': args.max_restarts_regression,
             'min_overlap': args.min_overlap,
             'static_peak': args.max_peak_regression,
+            'min_measured_overlap': args.min_measured_overlap,
+            'idle': args.max_idle_regression,
         },
         allow_kernel_fallback=args.allow_kernel_fallback)
 

@@ -43,6 +43,18 @@ def trace(log_dir):
         yield
 
 
+def read_json_artifact(path):
+    """Best-effort obs-artifact read: the parsed JSON, or ``None`` on a
+    missing/torn/unparsable file — the shared contract of every
+    artifact consumer (report, diff, attribution): absence is data,
+    never an exception. jax-free."""
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
 def fmt_seconds(v):
     """``41.2 ms`` / ``3.100 s`` / ``-`` — the one duration formatter
     shared by the report, aggregate and cost renderers (jax-free)."""

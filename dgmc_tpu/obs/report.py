@@ -22,12 +22,7 @@ import os
 import sys
 
 
-def _read_json(path):
-    try:
-        with open(path) as f:
-            return json.load(f)
-    except (OSError, ValueError):
-        return None
+from dgmc_tpu.obs.observe import read_json_artifact as _read_json
 
 
 def _read_jsonl(path):
@@ -48,9 +43,10 @@ def _read_jsonl(path):
 
 
 #: Artifacts written AT a root dir by their tools (specimen-merged
-#: efficiency.json, aggregate.json, recovery.json) that must outrank the
-#: subdir's copies when a root loads as one of its subruns.
-_ROOT_ARTIFACTS = ('recovery', 'aggregate', 'efficiency')
+#: efficiency.json, aggregate.json, recovery.json, the attribution
+#: CLI's attribution.json) that must outrank the subdir's copies when a
+#: root loads as one of its subruns.
+_ROOT_ARTIFACTS = ('recovery', 'aggregate', 'efficiency', 'attribution')
 
 
 def _load_as_subrun(run, root_path, subdir):
@@ -92,6 +88,8 @@ def load_run(path):
             'hang': _read_json(os.path.join(path, 'hang_report.json')),
             'recovery': _read_json(os.path.join(path, 'recovery.json')),
             'flight': _read_json(os.path.join(path, 'flight.json')),
+            'attribution': _read_json(
+                os.path.join(path, 'attribution.json')),
         }
         if run['timings'] is None and not run['metrics']:
             from dgmc_tpu.resilience.supervisor import (ATTEMPT_PREFIX,
@@ -131,7 +129,7 @@ def load_run(path):
     return {'path': path, 'metrics': _read_jsonl(path), 'timings': None,
             'memory': None, 'dispatch': None, 'efficiency': None,
             'aggregate': None, 'hang': None, 'recovery': None,
-            'flight': None}
+            'flight': None, 'attribution': None}
 
 
 def peak_memory(memory):
@@ -231,31 +229,33 @@ def summarize(run):
         ts = eff.get('programs', {}).get('train_step', {})
         if ts.get('flops'):
             out['flops_per_step'] = ts['flops']
-        # Headline achieved arithmetic intensity (FLOPs/byte): the
-        # train_step program's when present, else the first program
-        # carrying one — mirrors the headline-MFU convention so
-        # obs.diff can gate roofline position alongside utilization.
-        ai = ts.get('arith_intensity')
-        if ai is None:
-            for p in eff.get('programs', {}).values():
-                if p.get('arith_intensity') is not None:
-                    ai = p['arith_intensity']
-                    break
-        if ai is not None:
-            out['arith_intensity'] = ai
-        # Headline schedule/liveness fields (same convention): the
-        # modeled collective overlap fraction and the static peak-live
-        # bound from efficiency.json, so obs.diff can gate "the chunk
-        # loop serialized" / "peak memory regressed" from artifacts.
-        for key in ('overlap_fraction', 'static_peak_bytes'):
-            val = ts.get(key)
-            if val is None:
-                for p in eff.get('programs', {}).values():
-                    if p.get(key) is not None:
-                        val = p[key]
-                        break
+        # Headline per-program fields (arithmetic intensity, the
+        # modeled overlap fraction, the static peak-live bound): one
+        # shared picking convention (cost.headline_of — train_step
+        # first) so obs.diff and the attribution reconciliation can
+        # never gate on different programs than this summary reports.
+        from dgmc_tpu.obs.cost import headline_of
+        for key in ('arith_intensity', 'overlap_fraction',
+                    'static_peak_bytes'):
+            val = headline_of(eff, key)
             if val is not None:
                 out[key] = val
+        # Measured headline (obs.attribution's efficiency merge): the
+        # profiler-trace truth next to the static models, so obs.diff
+        # can gate measured overlap and idle growth from artifacts.
+        # TOP-LEVEL keys only, deliberately: the merge pops a headline
+        # whose measurement vanished, and falling back into the
+        # `measured` block here would resurrect the stale value and
+        # silence the diff's lost-account rule.
+        for key in ('measured_overlap_fraction', 'measured_mfu',
+                    'device_idle_fraction', 'idle_fraction',
+                    'idle_source'):
+            if eff.get(key) is not None:
+                out[key] = eff[key]
+        meas = eff.get('measured') or {}
+        if meas:
+            out['measured_device_available'] = meas.get(
+                'device_available')
 
     flight = run.get('flight')
     if flight:
@@ -471,6 +471,16 @@ def render(run):
             for cname, row in coll.items():
                 lines.append(f'    collective {cname:<14} x{row["count"]} '
                              f'{_fmt_bytes(row["bytes"])}')
+
+    attribution = run.get('attribution')
+    if attribution:
+        # The measured account (profiler trace): the attribution CLI's
+        # renderer, indented into the run report so the stage table,
+        # occupancy and static-vs-measured reconciliation appear next
+        # to the static cost/efficiency block they reconcile against.
+        from dgmc_tpu.obs.attribution import render_attribution
+        lines.append('-- measured attribution (profiler trace) --')
+        lines.extend(render_attribution(attribution).splitlines()[1:])
 
     if s.get('device_steps'):
         lines.append('-- per-device step completion --')

@@ -196,6 +196,7 @@ class RunObserver:
         self._last_activity = time.time()
         self._dispatch_sink = None
         self._compile_sink = None
+        self._profiler = None
         if probes:
             self._probes_enabled_by_me = not probes_mod.enabled()
             if self.enabled:
@@ -294,12 +295,33 @@ class RunObserver:
 
     # -- collection --------------------------------------------------------
 
+    def attach_profiler(self, profiler):
+        """Drive a :class:`~dgmc_tpu.obs.trace.ProfileHandle` from this
+        observer's step boundaries: each :meth:`step` entry calls
+        ``profiler.on_step()`` (arming/stopping a ``--profile-steps``
+        window) and the step body runs under
+        ``profiler.step_annotation()`` so the exported trace carries
+        per-step markers the attribution CLI can normalize by. Works
+        even when the observer itself is disabled (profiling does not
+        require ``--obs-dir``)."""
+        self._profiler = profiler
+        return profiler
+
     @contextlib.contextmanager
     def step(self, fence=None):
         """Time one training/eval step (host-observed; pass ``fence`` a
         device scalar to time actual execution)."""
+        prof = self._profiler
+        if prof is not None:
+            # Window boundary FIRST (it may stop the span), then the
+            # annotation (which is a no-op outside an open span).
+            prof.on_step()
+        ann = (prof.step_annotation(None if not self.enabled
+                                    else self._step_index)
+               if prof is not None else contextlib.nullcontext())
         if not self.enabled:
-            yield
+            with ann:
+                yield
             return
         if self.watchdog is not None:
             self.watchdog.beat('step', self._step_index)
@@ -308,7 +330,8 @@ class RunObserver:
                                step=self._step_index)
         self.timer.start()
         try:
-            yield
+            with ann:
+                yield
         finally:
             dur = self.timer.stop(fence=fence)
             if self.flight is not None:

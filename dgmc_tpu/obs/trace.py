@@ -27,11 +27,14 @@ complete events with microsecond ``ts``/``dur``, ``ph: 'C'`` counters,
 ``ph: 'i'`` instants.
 """
 
+import argparse
 import atexit
 import contextlib
 import json
 import math
 import os
+import re
+import sys
 
 #: Track ids inside the single "dgmc run" process row.
 _TID_STEPS = 1
@@ -159,16 +162,54 @@ def export_chrome_trace(path, step_spans=(), probe_records=(),
 
 
 def add_profile_flag(parser):
-    """Register the standard ``--profile-dir`` flag on an argparse
-    parser (the whole-run ``jax.profiler.trace`` switch)."""
+    """Register the standard ``--profile-dir`` / ``--profile-steps``
+    flags on an argparse parser (the ``jax.profiler.trace`` switch:
+    whole-run by default, a step window with ``--profile-steps``)."""
     parser.add_argument(
         '--profile-dir', '--profile_dir', dest='profile_dir', type=str,
         default=None,
-        help='capture a jax.profiler trace of the whole run into this '
-             'directory (open in TensorBoard or ui.perfetto.dev; the '
-             'psi1/initial_corr/topk/consensus_iter/psi2 named scopes '
-             'label the pipeline stages)')
+        help='capture a jax.profiler trace into this directory (open in '
+             'TensorBoard or ui.perfetto.dev, or feed it to `python -m '
+             'dgmc_tpu.obs.attribution`; the psi1/initial_corr/topk/'
+             'consensus_iter/psi2 named scopes label the pipeline '
+             'stages). Whole-run by default; see --profile-steps')
+    parser.add_argument(
+        '--profile-steps', '--profile_steps', dest='profile_steps',
+        type=_step_window_arg, default=None, metavar='A:B',
+        help='window the --profile-dir capture to steps [A, B): the '
+             'trace starts at step boundary A and stops at boundary B '
+             '(whole-run traces are unboundedly large on long runs). '
+             'Armed at the existing step boundaries; pick A >= 1 to '
+             'keep the first step\'s JIT compile out of the window. '
+             'The run ending early still finalizes a readable trace')
     return parser
+
+
+def _step_window_arg(spec):
+    """argparse ``type=`` wrapper: a typo'd window must fail at PARSE
+    time with the parser's usage message, not minutes later when
+    ``start_profile`` runs after dataset load and the first lowering."""
+    try:
+        return parse_step_window(spec)
+    except ValueError as e:
+        raise argparse.ArgumentTypeError(str(e))
+
+
+def parse_step_window(spec):
+    """``'A:B'`` -> ``(A, B)``, the half-open step window ``[A, B)``
+    (python-slice convention: ``0:4`` captures the first four steps).
+    Raises ``ValueError`` on malformed or empty windows — a typo'd
+    window must fail the CLI at parse time, not silently profile
+    nothing."""
+    m = re.fullmatch(r'(\d+):(\d+)', str(spec).strip())
+    if not m:
+        raise ValueError(
+            f'--profile-steps expects A:B step indices (e.g. 10:14), '
+            f'got {spec!r}')
+    a, b = int(m.group(1)), int(m.group(2))
+    if b <= a:
+        raise ValueError(f'--profile-steps window [{a}, {b}) is empty')
+    return a, b
 
 
 @contextlib.contextmanager
@@ -185,14 +226,104 @@ def profile_span(profile_dir):
         yield
 
 
-def start_profile(profile_dir):
-    """CLI-shaped :func:`profile_span`: enter the span now, return a
-    handle whose ``close()`` ends it — and finalize at process exit if
-    the run dies first (an exception mid-training must still leave a
-    readable trace; that failing run is exactly the one worth
-    profiling). ``close()`` is idempotent, so the success path's
-    explicit call and the ``atexit`` hook coexist."""
-    stack = contextlib.ExitStack()
-    stack.enter_context(profile_span(profile_dir))
-    atexit.register(stack.close)
-    return stack
+class ProfileHandle:
+    """The CLI-shaped profiler switch behind ``--profile-dir`` /
+    ``--profile-steps``.
+
+    Whole-run mode (``steps=None``, the default): the span is entered
+    at construction and ``close()`` (or process exit, via ``atexit``)
+    ends it — an exception mid-training must still leave a readable
+    trace; that failing run is exactly the one worth profiling.
+
+    Step-window mode (``steps='A:B'`` or ``(A, B)``): nothing starts
+    at construction. :meth:`on_step` — called at every step boundary
+    (``RunObserver.attach_profiler`` wires it on the experiment CLIs;
+    ``bench.py`` calls it from its measured loops) — enters the span
+    at boundary ``A`` and stops it at boundary ``B``, so the capture
+    covers exactly the steps ``[A, B)``. Pick ``A >= 1`` to keep
+    startup compiles out on CLIs whose first step JIT-compiles
+    (boundary 0 opens the span *before* step 0 runs).
+    A run that ends inside the window still finalizes the trace via
+    ``close()``/``atexit``; a window the run never reaches records
+    nothing. The window fires once — it never re-arms.
+
+    :meth:`step_annotation` wraps a step body in
+    ``jax.profiler.StepTraceAnnotation`` while the span is open, so
+    the exported trace carries per-step markers
+    (:data:`dgmc_tpu.obs.attribution.STEP_ANNOTATION`) the attribution
+    CLI normalizes device-active time by.
+    """
+
+    def __init__(self, profile_dir, steps=None):
+        self._dir = profile_dir
+        if isinstance(steps, str):
+            steps = parse_step_window(steps)
+        self._window = steps
+        if steps is not None and not profile_dir:
+            print('start_profile: --profile-steps is ignored without '
+                  '--profile-dir (there is no capture to window)',
+                  file=sys.stderr)
+            self._window = None
+        self._seen = 0
+        self._stack = None
+        self._fired = False
+        if self._dir and self._window is None:
+            self._enter()
+        atexit.register(self.close)
+
+    @property
+    def active(self):
+        """True while the profiler span is open."""
+        return self._stack is not None
+
+    def _enter(self):
+        if self._stack is None and not self._fired:
+            self._fired = True
+            stack = contextlib.ExitStack()
+            stack.enter_context(profile_span(self._dir))
+            self._stack = stack
+
+    def _exit(self):
+        if self._stack is not None:
+            stack, self._stack = self._stack, None
+            stack.close()
+
+    def on_step(self):
+        """Advance the step counter; open/close the windowed span at
+        its boundaries (a no-op switch in whole-run mode)."""
+        i = self._seen
+        self._seen += 1
+        if not self._dir or self._window is None:
+            return
+        a, b = self._window
+        if i >= b:
+            self._exit()
+        elif i >= a:
+            self._enter()
+
+    def step_annotation(self, step=None):
+        """Context manager marking one step inside an open span
+        (``jax.profiler.StepTraceAnnotation``); a no-op while the
+        profiler is not capturing. ``step`` defaults to the handle's
+        own boundary counter."""
+        if self._stack is None:
+            return contextlib.nullcontext()
+        if step is None:
+            step = max(self._seen - 1, 0)
+        import jax
+        from dgmc_tpu.obs.attribution import STEP_ANNOTATION
+        return jax.profiler.StepTraceAnnotation(STEP_ANNOTATION,
+                                                step_num=step)
+
+    def close(self):
+        """Finalize the trace if a span is open. Idempotent, so the
+        success path's explicit call and the ``atexit`` hook coexist."""
+        self._exit()
+
+
+def start_profile(profile_dir, steps=None):
+    """Build the profiler handle for a CLI: whole-run capture when
+    ``steps`` is None (the long-standing behavior), a ``[A, B)`` step
+    window when ``steps`` is ``'A:B'``/``(A, B)`` — see
+    :class:`ProfileHandle`."""
+    return ProfileHandle(profile_dir, steps=steps)

@@ -1,0 +1,329 @@
+"""Trace-event parsing for measured-runtime attribution (jax-free).
+
+``jax.profiler.trace`` (the ``--profile-dir`` flag every CLI carries)
+drops its trace-event export under
+``<profile_dir>/plugins/profile/<session>/<host>.trace.json.gz`` — a
+Chrome-trace JSON whose ``ph: 'X'`` complete events record, with
+microsecond timestamps, what each *device* and *host thread* actually
+spent its time on. That file is the only artifact in the repo that
+holds measured on-chip wall-clock; everything else is host-side step
+timing or a static model. This module turns it into structured tracks
+so :mod:`dgmc_tpu.obs.attribution` can build the measured account:
+
+- :func:`read_trace_file` — one ``.trace.json``/``.trace.json.gz``
+  payload (gzip detected by magic bytes, not extension); corrupt or
+  truncated content raises :class:`TraceParseError` with the reason,
+  so a half-written capture degrades to a named error instead of a
+  fabricated zero table.
+- :func:`find_profiler_traces` — the newest profiler session's trace
+  exports under a ``--profile-dir`` (one file per host on multi-host
+  captures).
+- :func:`build_tracks` — events grouped per ``(pid, tid)`` with the
+  ``process_name``/``thread_name`` metadata resolved and device
+  processes (``/device:TPU:0``-style names — the XLA profiler's
+  spelling) flagged, sorted slices per track.
+- Interval algebra (:func:`merge_intervals`, :func:`sum_intervals`,
+  :func:`intersect_intervals`) — busy-time unions that are robust to
+  the overlapping/nested slices real traces contain (an async
+  collective's in-flight window overlaps the ops it runs under;
+  summing raw durations would double-count it).
+- Classification shared with the static models: stage attribution
+  reuses :func:`dgmc_tpu.analysis.hlo_comm.stage_of` over the same
+  ``jax.named_scope`` paths already pinned in lowered HLO
+  (tests/obs/test_scopes.py), and comm-vs-compute splits on the same
+  :data:`~dgmc_tpu.analysis.hlo_comm.COLLECTIVE_OPS` table the lint
+  SHD tier and ``obs/cost.py`` count — so the measured account and the
+  static account can never disagree about what counts as a stage or a
+  collective.
+
+The trace-event grammar this parser accepts is pinned by golden
+fixtures in ``tests/obs/test_attribution.py`` the way SHD/SCH rules
+pin golden HLO.
+"""
+
+import dataclasses
+import glob
+import gzip
+import json
+import os
+import re
+from typing import Dict, List, Tuple
+
+# Shared vocabulary with the static models: the SAME scope names the
+# lowered HLO pins and the SAME collective-op table the SHD/SCH tiers
+# walk. (hlo_comm is pure text analysis — no jax import.)
+from dgmc_tpu.analysis.hlo_comm import (COLLECTIVE_OPS, STAGE_NAMES,
+                                        stage_of)
+
+__all__ = [
+    'TraceParseError', 'Track', 'read_trace_file', 'find_profiler_traces',
+    'build_tracks', 'merge_intervals', 'sum_intervals',
+    'intersect_intervals', 'event_stage', 'is_comm_event',
+    'is_host_wait_event', 'STAGE_NAMES', 'COLLECTIVE_OPS',
+]
+
+
+class TraceParseError(ValueError):
+    """One trace file could not be parsed; carries the path + reason."""
+
+    def __init__(self, path, reason):
+        super().__init__(f'{path}: {reason}')
+        self.path = path
+        self.reason = reason
+
+
+#: XLA profiler device-process naming (``/device:TPU:0``, plus the
+#: ``(pid N)``-suffixed spellings some exporters use). Host processes
+#: are ``/host:CPU`` or anything else.
+_DEVICE_PROCESS = re.compile(r'^/device:')
+
+#: Event names whose base opcode marks cross-device communication:
+#: the shared collective table plus point-to-point send/recv (HLO
+#: lowers device-to-device permute edges onto them).
+_COMM_OPCODES = frozenset(COLLECTIVE_OPS) | {'send', 'recv'}
+
+#: Host-side slices that mean "the host is blocked on the device" —
+#: the host-waiting-on-device half of the gap analysis. Matched as
+#: lowercase substrings of the event name (python-stack events arrive
+#: as ``$file.py:123 block_until_ready``).
+_HOST_WAIT_MARKERS = (
+    'block_until_ready', 'blockhostuntilready', 'transferfromdevice',
+    'copyfromdevice', 'device_get', 'awaitcomputation',
+    'wait for completion',
+)
+
+#: args keys searched (in order) for a scope path before the event
+#: name itself: device op events carry the full ``jit(f)/.../psi1/...``
+#: path in their metadata, not in the short display name.
+_SCOPE_ARG_KEYS = ('long_name', 'op_name', 'tf_op', 'hlo_op', 'name')
+
+
+@dataclasses.dataclass
+class Track:
+    """All ``ph: 'X'`` slices of one ``(pid, tid)`` row.
+
+    ``slices`` are ``(ts_us, dur_us, name, args)`` tuples sorted by
+    start time; ``device`` marks tracks owned by a device process.
+    """
+    pid: object
+    tid: object
+    process: str
+    thread: str
+    device: bool
+    slices: List[Tuple[float, float, str, dict]]
+
+    def busy_intervals(self):
+        """Merged busy intervals of this track (handles nesting)."""
+        return merge_intervals([(t, t + d) for t, d, _, _ in self.slices])
+
+
+def read_trace_file(path):
+    """Load one Chrome-trace JSON payload (gzipped or plain).
+
+    Returns the payload dict (must carry a ``traceEvents`` list).
+    Raises :class:`TraceParseError` on unreadable files, bad gzip
+    streams, truncated/corrupt JSON, or payloads without events — the
+    caller records the error and degrades instead of crashing.
+    """
+    try:
+        with open(path, 'rb') as f:
+            raw = f.read()
+    except OSError as e:
+        raise TraceParseError(path, f'unreadable: {e}')
+    if raw[:2] == b'\x1f\x8b':
+        try:
+            raw = gzip.decompress(raw)
+        except (OSError, EOFError) as e:
+            raise TraceParseError(path, f'bad gzip stream: {e}')
+    try:
+        payload = json.loads(raw.decode('utf-8', errors='replace'))
+    except ValueError as e:
+        raise TraceParseError(path, f'truncated or corrupt JSON: {e}')
+    if not isinstance(payload, dict) \
+            or not isinstance(payload.get('traceEvents'), list):
+        raise TraceParseError(path, 'no traceEvents list in payload')
+    return payload
+
+
+def find_profiler_traces(profile_dir):
+    """Trace-event exports under a ``--profile-dir``.
+
+    Looks for ``<dir>/plugins/profile/<session>/*.trace.json[.gz]``
+    and returns the NEWEST session's files (sorted; one per host on a
+    multi-host capture). Also accepts a session directory itself, or
+    any directory holding ``*.trace.json[.gz]`` files directly — so
+    ``python -m dgmc_tpu.obs.attribution`` works on a copied-out
+    session as well as the capture root. Returns ``[]`` when nothing
+    matches (the caller decides whether that is an error).
+    """
+    profile_dir = os.fspath(profile_dir)
+
+    def traces_in(d):
+        return sorted(glob.glob(os.path.join(d, '*.trace.json.gz'))
+                      + glob.glob(os.path.join(d, '*.trace.json')))
+
+    direct = traces_in(profile_dir)
+    if direct:
+        return direct
+    root = os.path.join(profile_dir, 'plugins', 'profile')
+    if not os.path.isdir(root):
+        return []
+    sessions = sorted(d for d in glob.glob(os.path.join(root, '*'))
+                      if os.path.isdir(d))
+    for session in reversed(sessions):   # newest session dir first
+        found = traces_in(session)
+        if found:
+            return found
+    return []
+
+
+def build_tracks(events):
+    """Group trace events into per-``(pid, tid)`` :class:`Track` rows.
+
+    Resolves ``ph: 'M'`` ``process_name``/``thread_name`` metadata,
+    flags device processes, and keeps only ``ph: 'X'`` complete slices
+    with a numeric ``ts`` (counter/instant/metadata events carry no
+    wall-clock to attribute). Slices are sorted by start time.
+    """
+    process_names: Dict[object, str] = {}
+    thread_names: Dict[Tuple[object, object], str] = {}
+    slices: Dict[Tuple[object, object],
+                 List[Tuple[float, float, str, dict]]] = {}
+    for e in events:
+        if not isinstance(e, dict):
+            continue
+        ph = e.get('ph')
+        pid, tid = e.get('pid'), e.get('tid')
+        if ph == 'M':
+            args = e.get('args') or {}
+            if e.get('name') == 'process_name':
+                process_names[pid] = str(args.get('name', ''))
+            elif e.get('name') == 'thread_name':
+                thread_names[(pid, tid)] = str(args.get('name', ''))
+            continue
+        if ph != 'X':
+            continue
+        ts, dur = e.get('ts'), e.get('dur', 0.0)
+        if not isinstance(ts, (int, float)) \
+                or not isinstance(dur, (int, float)) or dur < 0:
+            continue
+        args = dict(e.get('args') or {})
+        if e.get('cat'):
+            # The top-level Chrome 'cat' rides along in args so
+            # downstream classification (e.g. the host run-trace's
+            # cat: 'step' spans) sees one metadata dict.
+            args.setdefault('cat', e['cat'])
+        slices.setdefault((pid, tid), []).append(
+            (float(ts), float(dur), str(e.get('name', '')), args))
+    tracks = []
+    for (pid, tid), rows in sorted(slices.items(),
+                                   key=lambda kv: (str(kv[0][0]),
+                                                   str(kv[0][1]))):
+        process = process_names.get(pid, '')
+        tracks.append(Track(
+            pid=pid, tid=tid, process=process,
+            thread=thread_names.get((pid, tid), ''),
+            device=bool(_DEVICE_PROCESS.match(process)),
+            slices=sorted(rows, key=lambda s: (s[0], -s[1]))))
+    return tracks
+
+
+# ---------------------------------------------------------------------------
+# Interval algebra (all times in the trace's microsecond clock)
+# ---------------------------------------------------------------------------
+
+
+def merge_intervals(intervals):
+    """Union of ``(start, end)`` intervals as a sorted disjoint list.
+
+    Overlapping and nested slices (async in-flight windows over the
+    ops they cover) collapse to their cover — the reason busy time is
+    computed on unions, never on raw duration sums.
+    """
+    ivs = sorted((s, e) for s, e in intervals if e > s)
+    merged = []
+    for s, e in ivs:
+        if merged and s <= merged[-1][1]:
+            if e > merged[-1][1]:
+                merged[-1] = (merged[-1][0], e)
+        else:
+            merged.append((s, e))
+    return merged
+
+
+def sum_intervals(merged):
+    """Total covered time of a merged interval list."""
+    return sum(e - s for s, e in merged)
+
+
+def intersect_intervals(a, b):
+    """Merged intersection of two MERGED interval lists (two-pointer
+    sweep) — the measured-overlap primitive: comm busy ∩ compute busy."""
+    out = []
+    i = j = 0
+    while i < len(a) and j < len(b):
+        s = max(a[i][0], b[j][0])
+        e = min(a[i][1], b[j][1])
+        if e > s:
+            out.append((s, e))
+        if a[i][1] <= b[j][1]:
+            i += 1
+        else:
+            j += 1
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Event classification (shared vocabulary with the static models)
+# ---------------------------------------------------------------------------
+
+
+def _opcode_of(name):
+    """Base HLO opcode of an op-event display name:
+    ``all-reduce-start.3`` -> ``all-reduce``; ``fusion.12`` ->
+    ``fusion``. Strips the ``.N`` instance suffix and async
+    ``-start``/``-done`` halves (an async pair's in-flight window is
+    the same communication)."""
+    base = name.strip().lstrip('%').split('(')[0].strip()
+    base = re.sub(r'\.\d+$', '', base)
+    for suffix in ('-start', '-done'):
+        if base.endswith(suffix):
+            base = base[:-len(suffix)]
+    return base
+
+
+def event_stage(name, args):
+    """Pipeline stage of one trace event, via the SAME
+    :func:`~dgmc_tpu.analysis.hlo_comm.stage_of` scope-path rule the
+    static cost model applies to lowered HLO. Device op events carry
+    the full scope path in their args metadata (``long_name`` /
+    ``op_name`` / ``tf_op``); the display name is the fallback.
+    Returns ``'other'`` when no stage scope matches."""
+    for key in _SCOPE_ARG_KEYS:
+        v = args.get(key)
+        if isinstance(v, str) and v:
+            s = stage_of(v)
+            if s != 'other':
+                return s
+    return stage_of(name)
+
+
+def is_comm_event(name, args):
+    """True when the event is cross-device communication: its base
+    opcode is in the shared collective table (plus send/recv), or its
+    exporter category says so."""
+    if _opcode_of(name) in _COMM_OPCODES:
+        return True
+    for key in ('hlo_category', 'category'):
+        v = args.get(key)
+        if isinstance(v, str) and 'collective' in v.lower():
+            return True
+    return False
+
+
+def is_host_wait_event(name):
+    """True when a host-track slice means the host is blocked on the
+    device (fetches, ``block_until_ready``, transfer waits) — the
+    host-waiting-on-device half of the idle/gap analysis."""
+    low = name.lower()
+    return any(marker in low for marker in _HOST_WAIT_MARKERS)

@@ -1,0 +1,516 @@
+"""Measured-runtime attribution contract.
+
+Golden trace-event fixtures pin the parser grammar the way SHD/SCH
+rules pin golden HLO: a device+host capture (plain and gzipped), an
+empty device track, truncated/corrupt JSON, and overlapping async
+slices — each exercised through attribution with EXACT expected stage
+tables. Plus a strict schema pin on ``attribution.json`` in the style
+of ``test_live.py``'s Prometheus line-grammar parser: every key at
+every level is enumerated, so a field can neither vanish nor appear
+without this test noticing.
+
+Everything here is jax-free (the modules under test must run on a box
+that only has the artifacts).
+"""
+
+import gzip
+import json
+import os
+
+import pytest
+
+from dgmc_tpu.obs import attribution as attr_mod
+from dgmc_tpu.obs import trace_events as te
+
+# ---------------------------------------------------------------------------
+# Fixture builders (times in trace microseconds)
+# ---------------------------------------------------------------------------
+
+
+def _x(pid, tid, ts, dur, name, args=None):
+    e = {'ph': 'X', 'pid': pid, 'tid': tid, 'ts': ts, 'dur': dur,
+         'name': name}
+    if args:
+        e['args'] = args
+    return e
+
+
+def _meta(pid, name, tid=None):
+    e = {'ph': 'M', 'pid': pid,
+         'name': 'thread_name' if tid is not None else 'process_name',
+         'args': {'name': name}}
+    if tid is not None:
+        e['tid'] = tid
+    return e
+
+
+def device_host_events():
+    """The canonical device+host capture:
+
+    device ``/device:TPU:0`` (XLA Ops):
+      psi1 compute        [   0, 1000)
+      consensus compute   [1500, 2500)
+      all-reduce comm     [2000, 3000)   (overlaps compute by 500us)
+    host ``/host:CPU`` (python):
+      run span            [   0, 4000)
+      block_until_ready   [3000, 3500)
+      dgmc_step x2        [   0, 2000), [2000, 4000)
+    """
+    scope = 'jit(train_step)/jit(main)/'
+    return [
+        _meta(1, '/device:TPU:0'),
+        _meta(1, 'XLA Ops', tid=1),
+        _meta(2, '/host:CPU'),
+        _meta(2, 'python', tid=1),
+        _x(1, 1, 0, 1000, 'fusion.1',
+           {'long_name': scope + 'psi1/dot_general'}),
+        _x(1, 1, 1500, 1000, 'fusion.2',
+           {'long_name': scope + 'consensus_iter/add'}),
+        _x(1, 1, 2000, 1000, 'all-reduce.3',
+           {'hlo_category': 'collective communication'}),
+        _x(2, 1, 0, 4000, '$train.py:10 run'),
+        _x(2, 1, 3000, 500, '$array.py:50 block_until_ready'),
+        _x(2, 1, 0, 2000, attr_mod.STEP_ANNOTATION,
+           {'step_num': '0'}),
+        _x(2, 1, 2000, 2000, attr_mod.STEP_ANNOTATION,
+           {'step_num': '1'}),
+    ]
+
+
+#: The exact stage table device_host_events() must attribute to —
+#: the golden pin for the grammar (scope path in args.long_name, the
+#: comm op without a stage scope lands in 'other').
+GOLDEN_STAGES = {
+    'psi1': {'wall_s': 0.001, 'events': 1, 'share': 0.3333},
+    'consensus_iter': {'wall_s': 0.001, 'events': 1, 'share': 0.3333},
+    'other': {'wall_s': 0.001, 'events': 1, 'share': 0.3333},
+}
+
+
+def write_trace(tmp_path, events, name='host0.trace.json', gz=False,
+                session='2026_01_01_00_00_00'):
+    """Write a trace-event payload into the profiler's directory
+    layout (``<root>/plugins/profile/<session>/``)."""
+    d = os.path.join(str(tmp_path), 'plugins', 'profile', session)
+    os.makedirs(d, exist_ok=True)
+    payload = json.dumps({'traceEvents': events,
+                          'displayTimeUnit': 'ms'}).encode()
+    path = os.path.join(d, name + ('.gz' if gz else ''))
+    if gz:
+        with gzip.open(path, 'wb') as f:
+            f.write(payload)
+    else:
+        with open(path, 'wb') as f:
+            f.write(payload)
+    return path
+
+
+# ---------------------------------------------------------------------------
+# Interval algebra
+# ---------------------------------------------------------------------------
+
+
+def test_merge_and_intersect_intervals():
+    merged = te.merge_intervals([(0, 10), (5, 15), (20, 30), (30, 31),
+                                 (2, 3)])
+    assert merged == [(0, 15), (20, 31)]
+    assert te.sum_intervals(merged) == 26
+    other = te.merge_intervals([(12, 22), (25, 40)])
+    inter = te.intersect_intervals(merged, other)
+    assert inter == [(12, 15), (20, 22), (25, 31)]
+    assert te.sum_intervals(inter) == 11
+    assert te.merge_intervals([]) == []
+    assert te.intersect_intervals([], merged) == []
+
+
+# ---------------------------------------------------------------------------
+# Golden fixtures through attribution
+# ---------------------------------------------------------------------------
+
+
+def test_device_host_golden_stage_table(tmp_path):
+    write_trace(tmp_path, device_host_events())
+    payload, _ = attr_mod.build_attribution(str(tmp_path))
+    assert payload['device_available'] is True
+    assert payload['stage_source'] == 'device'
+    assert payload['stages'] == GOLDEN_STAGES
+    occ = payload['occupancy']
+    assert occ['window_s'] == 0.004
+    assert occ['device_active_s'] == 0.0025
+    assert occ['device_idle_s'] == 0.0015
+    assert occ['device_idle_fraction'] == 0.375
+    assert occ['compute_busy_s'] == 0.002
+    assert occ['comm_busy_s'] == 0.001
+    assert occ['overlapped_s'] == 0.0005
+    assert occ['measured_overlap_fraction'] == 0.5
+    assert occ['host_busy_s'] == 0.004
+    assert occ['host_wait_s'] == 0.0005
+    assert occ['idle_fraction'] == 0.375
+    assert occ['idle_source'] == 'device'
+    assert payload['steps'] == {'observed': 2, 'wall_s': 0.004,
+                                'mean_s': 0.002}
+    assert payload['per_step'] == {'device_active_s': 0.00125,
+                                   'steps': 2}
+    assert payload['unavailable'] == []
+    assert payload['errors'] == []
+
+
+def test_gzipped_trace_is_identical(tmp_path):
+    plain = tmp_path / 'plain'
+    zipped = tmp_path / 'zipped'
+    write_trace(plain, device_host_events())
+    write_trace(zipped, device_host_events(), gz=True)
+    a, _ = attr_mod.build_attribution(str(plain))
+    b, _ = attr_mod.build_attribution(str(zipped))
+    assert a['stages'] == b['stages'] == GOLDEN_STAGES
+    assert a['occupancy'] == b['occupancy']
+
+
+def test_empty_device_track_degrades_to_host(tmp_path):
+    """A device PROCESS with no slices is not a device measurement:
+    the account degrades to host-track attribution with every device
+    field unavailable — never fabricated zeros."""
+    events = [e for e in device_host_events()
+              if not (e.get('ph') == 'X' and e.get('pid') == 1)]
+    write_trace(tmp_path, events)
+    payload, _ = attr_mod.build_attribution(str(tmp_path))
+    assert payload['device_available'] is False
+    assert payload['stage_source'] == 'host'
+    occ = payload['occupancy']
+    for key in ('device_active_s', 'device_idle_s',
+                'device_idle_fraction', 'compute_busy_s', 'comm_busy_s',
+                'overlapped_s', 'measured_overlap_fraction'):
+        assert occ[key] is None, key
+    assert occ['idle_source'] == 'host'
+    assert payload['per_step'] is None
+    assert set(attr_mod._DEVICE_FIELDS) == set(payload['unavailable'])
+    # Host attribution is still real: the wait slice and the run span.
+    assert occ['host_busy_s'] == 0.004
+    assert occ['host_wait_s'] == 0.0005
+
+
+def test_truncated_json_is_a_named_error(tmp_path):
+    path = write_trace(tmp_path, device_host_events())
+    raw = open(path, 'rb').read()
+    with open(path, 'wb') as f:
+        f.write(raw[:len(raw) // 2])      # torn mid-write
+    with pytest.raises(te.TraceParseError) as ei:
+        te.read_trace_file(path)
+    assert 'truncated or corrupt JSON' in str(ei.value)
+    # The capture root holds ONLY the torn file -> build_attribution
+    # refuses with the reason, it does not fabricate an account.
+    with pytest.raises(te.TraceParseError):
+        attr_mod.build_attribution(str(tmp_path))
+
+
+def test_one_corrupt_host_does_not_discard_the_others(tmp_path):
+    write_trace(tmp_path, device_host_events(), name='host0.trace.json')
+    bad = write_trace(tmp_path, [], name='host1.trace.json')
+    with open(bad, 'wb') as f:
+        f.write(b'{"traceEvents": [')
+    payload, _ = attr_mod.build_attribution(str(tmp_path))
+    assert payload['stages'] == GOLDEN_STAGES
+    assert len(payload['errors']) == 1
+    assert 'host1.trace.json' in payload['errors'][0]
+
+
+def test_bad_gzip_stream_is_a_named_error(tmp_path):
+    path = os.path.join(str(tmp_path), 'x.trace.json.gz')
+    with open(path, 'wb') as f:
+        f.write(b'\x1f\x8b' + b'not really gzip')
+    with pytest.raises(te.TraceParseError) as ei:
+        te.read_trace_file(path)
+    assert 'bad gzip' in str(ei.value)
+
+
+def test_overlapping_async_slices_do_not_double_count(tmp_path):
+    """Two overlapping in-flight comm windows union to their cover;
+    nested same-stage compute slices union too — busy time is interval
+    algebra, never a duration sum."""
+    scope = 'jit(train_step)/jit(main)/'
+    events = [
+        _meta(1, '/device:TPU:0'),
+        _meta(1, 'XLA Ops', tid=1),
+        # comm: [0,1000) and [500,1500) -> union 1500us
+        _x(1, 1, 0, 1000, 'all-reduce-start.1'),
+        _x(1, 1, 500, 1000, 'collective-permute.2'),
+        # compute: [200,700) nested inside [200,700)+[300,600) and
+        # [1200,1400) -> union 700us
+        _x(1, 1, 200, 500, 'fusion.3',
+           {'long_name': scope + 'psi2/dot_general'}),
+        _x(1, 1, 300, 300, 'fusion.4',
+           {'long_name': scope + 'psi2/add'}),
+        _x(1, 1, 1200, 200, 'fusion.5',
+           {'long_name': scope + 'topk/sort'}),
+    ]
+    write_trace(tmp_path, events)
+    payload, _ = attr_mod.build_attribution(str(tmp_path))
+    occ = payload['occupancy']
+    assert occ['comm_busy_s'] == 0.0015
+    assert occ['compute_busy_s'] == 0.0007
+    # overlap: comm [0,1500) covers all compute -> 700us / 1500us
+    assert occ['overlapped_s'] == 0.0007
+    assert occ['measured_overlap_fraction'] == 0.4667
+    assert payload['stages'] == {
+        'psi2': {'wall_s': 0.0005, 'events': 2, 'share': 0.2273},
+        'topk': {'wall_s': 0.0002, 'events': 1, 'share': 0.0909},
+        'other': {'wall_s': 0.0015, 'events': 2, 'share': 0.6818},
+    }
+
+
+def test_comm_without_collectives_has_no_overlap_fraction(tmp_path):
+    """A window that moved nothing between devices has an UNDEFINED
+    overlap fraction (None), not 0.0 — 0.0 would read as 'fully
+    serialized'."""
+    events = [
+        _meta(1, '/device:TPU:0'),
+        _x(1, 1, 0, 1000, 'fusion.1'),
+    ]
+    write_trace(tmp_path, events)
+    payload, _ = attr_mod.build_attribution(str(tmp_path))
+    assert payload['occupancy']['comm_busy_s'] == 0.0
+    assert payload['occupancy']['measured_overlap_fraction'] is None
+
+
+# ---------------------------------------------------------------------------
+# attribution.json schema pin (the test_live.py style: exact grammar)
+# ---------------------------------------------------------------------------
+
+_TOP_KEYS = {
+    'schema', 'source', 'errors', 'device_available', 'window_s',
+    'steps', 'stages', 'stage_source', 'occupancy', 'per_step',
+    'tracks', 'unavailable', 'reconciliation',
+}
+_SOURCE_KEYS = {'kind', 'path', 'trace_files', 'obs_dir'}
+_OCC_KEYS = {
+    'window_s', 'device_active_s', 'device_idle_s',
+    'device_idle_fraction', 'compute_busy_s', 'comm_busy_s',
+    'overlapped_s', 'measured_overlap_fraction', 'host_busy_s',
+    'host_wait_s', 'host_wait_fraction', 'idle_fraction', 'idle_source',
+}
+_STAGE_KEYS = {'wall_s', 'events', 'share'}
+_STEP_KEYS = {'observed', 'wall_s', 'mean_s'}
+_PER_STEP_KEYS = {'device_active_s', 'steps'}
+_TRACK_KEYS = {'process', 'thread', 'device', 'events', 'busy_s'}
+_REC_KEYS = {
+    'static_mfu', 'measured_mfu', 'mfu_ratio',
+    'static_overlap_fraction', 'measured_overlap_fraction',
+    'overlap_divergence', 'host_step_p50_s', 'device_step_active_s',
+    'notes',
+}
+
+
+def _num_or_none(v):
+    return v is None or (isinstance(v, (int, float))
+                         and not isinstance(v, bool))
+
+
+def check_attribution_schema(payload):
+    """Strict walk: exact key sets at every level, typed leaves.
+    Raises AssertionError on any drift — additive or subtractive."""
+    assert set(payload) == _TOP_KEYS, set(payload) ^ _TOP_KEYS
+    assert payload['schema'] == attr_mod.SCHEMA_VERSION
+    src = payload['source']
+    assert set(src) == _SOURCE_KEYS
+    assert src['kind'] in ('profiler', 'host-trace')
+    assert isinstance(src['trace_files'], list)
+    assert all(isinstance(e, str) for e in payload['errors'])
+    assert isinstance(payload['device_available'], bool)
+    assert _num_or_none(payload['window_s'])
+    if payload['steps'] is not None:
+        assert set(payload['steps']) == _STEP_KEYS
+        assert isinstance(payload['steps']['observed'], int)
+    assert payload['stage_source'] in ('device', 'host', None)
+    for stage, row in payload['stages'].items():
+        assert stage in (*te.STAGE_NAMES, 'other'), stage
+        assert set(row) == _STAGE_KEYS
+        assert isinstance(row['events'], int)
+        assert _num_or_none(row['wall_s']) and _num_or_none(row['share'])
+    occ = payload['occupancy']
+    assert set(occ) == _OCC_KEYS, set(occ) ^ _OCC_KEYS
+    assert occ['idle_source'] in ('device', 'host', 'host-trace', None)
+    for k in _OCC_KEYS - {'idle_source'}:
+        assert _num_or_none(occ[k]), (k, occ[k])
+    if payload['per_step'] is not None:
+        assert set(payload['per_step']) == _PER_STEP_KEYS
+    for t in payload['tracks']:
+        assert set(t) == _TRACK_KEYS
+        assert isinstance(t['device'], bool)
+    assert all(isinstance(u, str) for u in payload['unavailable'])
+    rec = payload['reconciliation']
+    if rec is not None:
+        assert set(rec) == _REC_KEYS, set(rec) ^ _REC_KEYS
+        assert all(isinstance(n, str) for n in rec['notes'])
+        for k in _REC_KEYS - {'notes'}:
+            assert _num_or_none(rec[k]), (k, rec[k])
+
+
+def test_schema_pin_device_and_degraded(tmp_path):
+    full = tmp_path / 'full'
+    write_trace(full, device_host_events())
+    payload, _ = attr_mod.build_attribution(str(full))
+    check_attribution_schema(payload)
+    degraded = tmp_path / 'degraded'
+    write_trace(degraded, [e for e in device_host_events()
+                           if e.get('pid') != 1])
+    payload, _ = attr_mod.build_attribution(str(degraded))
+    check_attribution_schema(payload)
+    # ...and through the CLI-written artifact byte path too.
+    assert attr_mod.main([str(full), '--out',
+                          str(tmp_path / 'a.json')]) == 0
+    check_attribution_schema(json.load(open(tmp_path / 'a.json')))
+
+
+# ---------------------------------------------------------------------------
+# Reconciliation
+# ---------------------------------------------------------------------------
+
+
+def _obs_dir_with_static(tmp_path, mfu=0.02, overlap=0.1353,
+                         flops=4.0e9, peak=197e12):
+    d = str(tmp_path / 'obs')
+    os.makedirs(d, exist_ok=True)
+    eff = {
+        'mfu': mfu,
+        'peak_flops': peak,
+        'peak_flops_ref': 'TPU v5e bf16',
+        'peak_flops_source': 'table',
+        'programs': {'train_step': {'flops': flops,
+                                    'overlap_fraction': overlap}},
+    }
+    with open(os.path.join(d, 'efficiency.json'), 'w') as f:
+        json.dump(eff, f)
+    with open(os.path.join(d, 'timings.json'), 'w') as f:
+        json.dump({'steps': {'steps': 2, 'p50_s': 0.002}}, f)
+    return d
+
+
+def test_reconciliation_measured_vs_static(tmp_path):
+    write_trace(tmp_path, device_host_events())
+    obs = _obs_dir_with_static(tmp_path)
+    payload, obs_dir = attr_mod.build_attribution(str(tmp_path),
+                                                  obs_dir=obs)
+    assert obs_dir == obs
+    rec = payload['reconciliation']
+    # measured MFU = flops / (per-step device-active * peak)
+    #             = 4e9 / (0.00125 * 197e12) = 0.01624...
+    assert rec['measured_mfu'] == pytest.approx(
+        4.0e9 / (0.00125 * 197e12), rel=1e-3)
+    assert rec['static_mfu'] == 0.02
+    assert rec['mfu_ratio'] == pytest.approx(
+        rec['measured_mfu'] / 0.02, abs=1e-4)
+    # overlap divergence is measured - modeled, a signed diagnostic
+    assert rec['static_overlap_fraction'] == 0.1353
+    assert rec['measured_overlap_fraction'] == 0.5
+    assert rec['overlap_divergence'] == pytest.approx(0.3647)
+    assert rec['host_step_p50_s'] == 0.002
+    assert rec['device_step_active_s'] == 0.00125
+    assert len(rec['notes']) == 2
+    check_attribution_schema(payload)
+
+
+def test_efficiency_merge_and_lost_measurement(tmp_path):
+    write_trace(tmp_path, device_host_events())
+    obs = _obs_dir_with_static(tmp_path)
+    assert attr_mod.main([str(tmp_path), '--obs-dir', obs]) == 0
+    assert os.path.exists(os.path.join(obs, 'attribution.json'))
+    eff = json.load(open(os.path.join(obs, 'efficiency.json')))
+    # Run rows preserved verbatim; measured block + headline merged.
+    assert eff['mfu'] == 0.02
+    assert eff['programs']['train_step']['flops'] == 4.0e9
+    assert eff['measured']['device_available'] is True
+    assert eff['measured_overlap_fraction'] == 0.5
+    assert eff['device_idle_fraction'] == 0.375
+    assert eff['idle_source'] == 'device'
+    assert eff['measured_mfu'] > 0
+    # A rerun from a DEGRADED capture must drop the stale headline:
+    # absence means absence for obs.diff's lost-account rule.
+    degraded = tmp_path / 'degraded'
+    write_trace(degraded, [e for e in device_host_events()
+                           if e.get('pid') != 1])
+    assert attr_mod.main([str(degraded), '--obs-dir', obs]) == 0
+    eff = json.load(open(os.path.join(obs, 'efficiency.json')))
+    assert 'measured_overlap_fraction' not in eff
+    assert 'device_idle_fraction' not in eff
+    assert eff['measured']['device_available'] is False
+    assert eff['idle_source'] == 'host'
+    assert eff['mfu'] == 0.02          # static rows still untouched
+
+
+# ---------------------------------------------------------------------------
+# CLI contract + host-trace degradation (the CPU-container path)
+# ---------------------------------------------------------------------------
+
+
+def _host_trace_obs_dir(tmp_path):
+    """An obs dir with only the host-side run trace (no profiler
+    capture): step spans + a gap, the graceful-degradation source."""
+    d = str(tmp_path / 'obsrun')
+    os.makedirs(d, exist_ok=True)
+    events = [
+        {'ph': 'M', 'pid': 1, 'name': 'process_name',
+         'args': {'name': 'dgmc run'}},
+        {'ph': 'X', 'pid': 1, 'tid': 1, 'name': 'step 0', 'cat': 'step',
+         'ts': 0, 'dur': 1000},
+        {'ph': 'X', 'pid': 1, 'tid': 1, 'name': 'step 1', 'cat': 'step',
+         'ts': 3000, 'dur': 1000},
+    ]
+    with open(os.path.join(d, 'trace.json'), 'w') as f:
+        json.dump({'traceEvents': events}, f)
+    with open(os.path.join(d, 'timings.json'), 'w') as f:
+        json.dump({'steps': {'steps': 2, 'p50_s': 0.001}}, f)
+    return d
+
+
+def test_cli_host_trace_mode_exits_zero_and_marks_unavailable(tmp_path,
+                                                              capsys):
+    obs = _host_trace_obs_dir(tmp_path)
+    assert attr_mod.main([obs]) == 0      # the acceptance pin: exit 0
+    out = capsys.readouterr().out
+    assert 'no device tracks' in out
+    assert 'unavailable' in out
+    payload = json.load(open(os.path.join(obs, 'attribution.json')))
+    check_attribution_schema(payload)
+    assert payload['source']['kind'] == 'host-trace'
+    assert payload['device_available'] is False
+    assert set(payload['unavailable']) == set(attr_mod._DEVICE_FIELDS)
+    assert payload['steps']['observed'] == 2
+    occ = payload['occupancy']
+    # Two 1ms steps over a 4ms window: half the host timeline is gap.
+    assert occ['idle_fraction'] == 0.5
+    assert occ['idle_source'] == 'host-trace'
+    assert occ['measured_overlap_fraction'] is None
+    eff = json.load(open(os.path.join(obs, 'efficiency.json')))
+    assert eff['measured']['device_available'] is False
+    assert eff['idle_fraction'] == 0.5
+    assert 'measured_overlap_fraction' not in eff
+
+
+def test_cli_errors(tmp_path, capsys):
+    assert attr_mod.main([str(tmp_path / 'nope')]) == 2
+    empty = tmp_path / 'empty'
+    empty.mkdir()
+    assert attr_mod.main([str(empty)]) == 2
+    err = capsys.readouterr().err
+    assert 'no readable profiler trace' in err or 'no such path' in err
+
+
+def test_report_loads_and_renders_attribution(tmp_path):
+    from dgmc_tpu.obs.report import load_run, render, summarize
+
+    write_trace(tmp_path, device_host_events())
+    obs = _obs_dir_with_static(tmp_path)
+    assert attr_mod.main([str(tmp_path), '--obs-dir', obs]) == 0
+    run = load_run(obs)
+    assert run['attribution'] is not None
+    s = summarize(run)
+    assert s['measured_overlap_fraction'] == 0.5
+    assert s['idle_fraction'] == 0.375
+    assert s['idle_source'] == 'device'
+    assert s['device_idle_fraction'] == 0.375
+    assert s['measured_mfu'] > 0
+    assert s['measured_device_available'] is True
+    text = render(run)
+    assert 'measured attribution' in text
+    assert 'psi1' in text
+    assert 'static vs measured' in text

@@ -309,3 +309,93 @@ def test_static_peak_regression_gates(tmp_path, capsys):
     assert diff_mod.main([a, b, '--max-peak-regression', '1.5']) == 0
     # Shrinking the bound passes.
     assert diff_mod.main([b, a]) == 0
+
+
+# ---------------------------------------------------------------------------
+# Measured-attribution gates (--min-measured-overlap, --max-idle-regression)
+# ---------------------------------------------------------------------------
+
+def _eff_measured(overlap=None, idle=None, idle_source='device'):
+    """efficiency.json carrying the attribution plane's merged
+    headline (obs.attribution.merge_into_efficiency shape)."""
+    eff = {'mfu': 0.02, 'programs': {'train_step': {'flops': 1e9}},
+           'measured': {'device_available': idle_source == 'device'}}
+    if overlap is not None:
+        eff['measured_overlap_fraction'] = overlap
+    if idle is not None:
+        eff['idle_fraction'] = idle
+        eff['idle_source'] = idle_source
+    return eff
+
+
+def test_measured_overlap_floor(tmp_path, capsys):
+    a = write_run(tmp_path, 'a', efficiency=_eff_measured(overlap=0.5))
+    b = write_run(tmp_path, 'b', efficiency=_eff_measured(overlap=0.1))
+    # No floor configured: informational only.
+    assert diff_mod.main([a, b]) == 0
+    assert diff_mod.main([a, b, '--min-measured-overlap', '0.3']) == 1
+    out = capsys.readouterr().out
+    assert 'below the measured floor' in out
+    assert diff_mod.main([a, b, '--min-measured-overlap', '0.05']) == 0
+    # Warn-level wiring (floor 0.0) can never fail a present value.
+    assert diff_mod.main([a, b, '--min-measured-overlap', '0.0']) == 0
+
+
+def test_measured_overlap_lost_account_fails(tmp_path, capsys):
+    a = write_run(tmp_path, 'a', efficiency=_eff_measured(overlap=0.5))
+    b = write_run(tmp_path, 'b', efficiency=_eff_measured())
+    assert diff_mod.main([a, b]) == 1
+    assert 'missing from candidate' in capsys.readouterr().out
+    # The reverse (baseline never measured) reports info, not failure.
+    assert diff_mod.main([b, a]) == 0
+
+
+def test_idle_regression_gate(tmp_path, capsys):
+    a = write_run(tmp_path, 'a', efficiency=_eff_measured(idle=0.1))
+    worse = write_run(tmp_path, 'b',
+                      efficiency=_eff_measured(idle=0.2))
+    assert diff_mod.main([a, worse]) == 1            # +100% > 25%
+    assert 'source=device' in capsys.readouterr().out
+    assert diff_mod.main([a, worse,
+                          '--max-idle-regression', '1.5']) == 0
+    # Lost idle account fails; note names the side and its keys.
+    lost = write_run(tmp_path, 'c', efficiency=_eff_measured())
+    assert diff_mod.main([a, lost]) == 1
+    out = capsys.readouterr().out
+    assert 'missing from candidate; candidate has:' in out
+    assert 'mfu' in out
+
+
+def test_idle_sources_do_not_compare(tmp_path, capsys):
+    a = write_run(tmp_path, 'a',
+                  efficiency=_eff_measured(idle=0.0,
+                                           idle_source='host-trace'))
+    b = write_run(tmp_path, 'b', efficiency=_eff_measured(idle=0.9))
+    assert diff_mod.main([a, b]) == 0
+    assert 'sources differ' in capsys.readouterr().out
+
+
+def test_idle_zero_baseline_gates_absolute(tmp_path, capsys):
+    """A zero-idle baseline has no ratio; the candidate's ABSOLUTE
+    idle gates against the threshold instead of skipping."""
+    a = write_run(tmp_path, 'a', efficiency=_eff_measured(idle=0.0))
+    b = write_run(tmp_path, 'b', efficiency=_eff_measured(idle=0.5))
+    assert diff_mod.main([a, b]) == 1
+    assert 'zero-idle baseline' in capsys.readouterr().out
+    ok = write_run(tmp_path, 'c', efficiency=_eff_measured(idle=0.2))
+    assert diff_mod.main([a, ok]) == 0
+
+
+def test_missing_note_lists_available_keys(tmp_path, capsys):
+    """The missing-metric UX fix: a lost-account failure names which
+    side lacks the key AND lists the gated keys that run does have,
+    so the CI log alone answers 'artifact gone, or just this row?'."""
+    a = write_run(tmp_path, 'a', efficiency=_eff_measured(overlap=0.5))
+    timerless = copy.deepcopy(BASE_TIMINGS)
+    timerless['steps'] = {}
+    b = write_run(tmp_path, 'b', timings=timerless)
+    assert diff_mod.main([a, b]) == 1
+    out = capsys.readouterr().out
+    assert 'missing from candidate; candidate has:' in out
+    # The candidate did keep compile counts + memory: both listed.
+    assert 'compile_events' in out and 'peak_memory_bytes' in out

@@ -105,3 +105,125 @@ def test_profile_span_noop_without_dir():
     from dgmc_tpu.obs import profile_span
     with profile_span(None):
         pass
+
+
+# ---------------------------------------------------------------------------
+# --profile-steps step-window profiling
+# ---------------------------------------------------------------------------
+
+
+def test_parse_step_window():
+    import pytest
+
+    from dgmc_tpu.obs.trace import parse_step_window
+
+    assert parse_step_window('0:4') == (0, 4)
+    assert parse_step_window(' 10:14 ') == (10, 14)
+    for bad in ('4', '4:', ':4', 'a:b', '3:3', '5:2', '-1:4', '1:2:3'):
+        with pytest.raises(ValueError):
+            parse_step_window(bad)
+
+
+def test_profile_steps_without_dir_warns_and_disarms(capsys):
+    from dgmc_tpu.obs.trace import start_profile
+
+    prof = start_profile(None, steps='0:2')
+    assert '--profile-steps is ignored' in capsys.readouterr().err
+    for _ in range(4):
+        prof.on_step()       # must be a cheap no-op, never start jax
+    assert not prof.active
+    prof.close()
+
+
+def test_windowed_profile_captures_only_the_window(tmp_path):
+    """steps='1:3' captures step boundaries [1, 3): the span opens at
+    boundary 1, closes at boundary 3, and the exported trace carries
+    the per-step annotations the attribution CLI normalizes by."""
+    from dgmc_tpu.obs.attribution import STEP_ANNOTATION
+    from dgmc_tpu.obs.trace import start_profile
+    from dgmc_tpu.obs.trace_events import (find_profiler_traces,
+                                           read_trace_file)
+
+    d = str(tmp_path / 'prof')
+    f = jax.jit(lambda x: (x * x).sum())
+    x = jax.numpy.ones((8, 8))
+    float(f(x))                     # compile OUTSIDE any window
+    prof = start_profile(d, steps='1:3')
+    assert not prof.active          # windowed: nothing starts yet
+    actives = []
+    for _ in range(5):
+        prof.on_step()
+        actives.append(prof.active)
+        with prof.step_annotation():
+            float(f(x))
+    prof.close()
+    assert actives == [False, True, True, False, False]
+    traces = find_profiler_traces(d)
+    assert traces, 'windowed capture left no trace export'
+    events = read_trace_file(traces[0])['traceEvents']
+    steps = [e for e in events
+             if e.get('ph') == 'X' and e.get('name') == STEP_ANNOTATION]
+    nums = sorted(int(e['args']['step_num']) for e in steps)
+    # Exactly the window's boundaries, numbered by the handle counter.
+    assert nums == [1, 2], nums
+
+
+def test_window_never_reached_records_nothing(tmp_path):
+    from dgmc_tpu.obs.trace import start_profile
+    from dgmc_tpu.obs.trace_events import find_profiler_traces
+
+    d = str(tmp_path / 'prof')
+    prof = start_profile(d, steps='10:12')
+    for _ in range(3):
+        prof.on_step()
+    prof.close()                    # idempotent; nothing was started
+    prof.close()
+    assert find_profiler_traces(d) == []
+
+
+def test_run_observer_drives_attached_profiler(tmp_path):
+    """RunObserver.step() advances the profiler window and wraps the
+    body in its annotation — including when the observer itself is
+    DISABLED (profiling does not require --obs-dir)."""
+    import contextlib
+
+    from dgmc_tpu.obs import RunObserver
+
+    class FakeProf:
+        def __init__(self):
+            self.boundaries = 0
+            self.annotated = 0
+
+        def on_step(self):
+            self.boundaries += 1
+
+        def step_annotation(self, step=None):
+            self.annotated += 1
+            return contextlib.nullcontext()
+
+    for obs_dir in (None, str(tmp_path / 'obs')):
+        obs = RunObserver(obs_dir)
+        prof = obs.attach_profiler(FakeProf())
+        for _ in range(3):
+            with obs.step():
+                pass
+        obs.close()
+        assert prof.boundaries == 3, obs_dir
+        assert prof.annotated == 3, obs_dir
+
+
+def test_profile_steps_rejected_at_argparse_time(capsys):
+    """A typo'd window fails the CLI at PARSE time (usage message),
+    not minutes later when start_profile runs after dataset load."""
+    import argparse
+
+    import pytest
+
+    from dgmc_tpu.obs.trace import add_profile_flag
+
+    parser = add_profile_flag(argparse.ArgumentParser())
+    with pytest.raises(SystemExit):
+        parser.parse_args(['--profile-steps', '5:2'])
+    assert 'window [5, 2) is empty' in capsys.readouterr().err
+    args = parser.parse_args(['--profile-steps', '2:5'])
+    assert args.profile_steps == (2, 5)   # pre-parsed for start_profile
