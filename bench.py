@@ -530,10 +530,13 @@ def _bench_sparse_leg(bf16, pairs=1):
 
 
 def bench_sparse():
-    """DBP15K-scale sparse training step, both precision policies, plus
-    the standalone candidate-search comparison (Pallas kernel vs the jnp
+    """DBP15K-scale sparse training step, both precision policies, the
+    standalone candidate-search comparison (Pallas kernel vs the jnp
     scan fallback — the kernel ignores tile-size knobs, so a block sweep
-    of it would measure the same kernel repeatedly; r03's did)."""
+    of it would measure the same kernel repeatedly; r03's did), and the
+    ``--pairs-per-step`` batch-scaling sweep (B ∈ {1,2,4,8} on the
+    flagship policy, ``step_ms_per_pair`` per point, fault-tolerant
+    per-variant)."""
     from dgmc_tpu.ops.topk import chunked_topk
 
     # Legs pre-initialize to None so a --section-timeout'd section
@@ -590,8 +593,39 @@ def bench_sparse():
         except Exception as e:   # SectionTimeout never escapes _section
             topk_ms[name] = {'error': f'{type(e).__name__}: {e}'}
 
+    # --pairs-per-step batch-scaling sweep (ROADMAP item 3's owed leg):
+    # step_ms_per_pair across B ∈ {1, 2, 4, 8} on the sparse flagship
+    # policy — the curve that says where batching stops buying MXU
+    # utilization. The flagship's own B=SP_PAIRS measurement anchors its
+    # point (no duplicate run); every other point is fault-tolerant
+    # per-variant exactly like the top-k sweep above — one timed-out or
+    # OOM'd batch size is recorded as such and the sweep moves on.
+    pairs_sweep = {}
+    if step_ms is not None:
+        pairs_sweep[str(SP_PAIRS)] = {
+            'step_ms': round(step_ms, 1),
+            'step_ms_per_pair': perf.get('step_ms_per_pair',
+                                         round(step_ms / SP_PAIRS, 1)),
+            'source': 'flagship'}
+    for b in (p for p in (1, 2, 4, 8) if str(p) not in pairs_sweep):
+        res = None
+        try:
+            with _section(f'pairs_b{b}'):
+                b_ms, b_perf = _bench_sparse_leg(bf16=True, pairs=b)
+                res = {'step_ms': round(b_ms, 1),
+                       'step_ms_per_pair': b_perf.get(
+                           'step_ms_per_pair', round(b_ms / b, 1)),
+                       **{k: b_perf[k] for k in ('mfu', 'arith_intensity')
+                          if k in b_perf}}
+        except Exception as e:   # SectionTimeout never escapes _section
+            res = {'error': f'{type(e).__name__}: {e}'}
+        if res is None:
+            res = {'error': 'timeout'}
+        pairs_sweep[str(b)] = res
+
     out = {'shape': f'{SP_N_S}x{SP_N_T} k={SP_K} steps={NUM_STEPS}',
-           'topk_ms': topk_ms}
+           'topk_ms': topk_ms,
+           'pairs_sweep': pairs_sweep}
     if step_ms is not None:
         # Flagship leg: the bf16 compute policy (quality-gated; see
         # module docstring) at SP_PAIRS pairs per step.

@@ -1,4 +1,4 @@
-"""Streamed-S million-entity scale benchmark (ROADMAP item 3).
+"""Streamed-S million-entity scale benchmark (ROADMAP item 4).
 
 Drives the DBP15K CLI's partition-rule streamed layout
 (``--row_shards N --stream_chunk M``, ``dgmc_tpu/parallel/rules.py``) on
@@ -8,21 +8,32 @@ record is the 10⁶×10⁶-entity pair, whose dense correspondence matrix
 (4 TB) no machine holds and whose 15k-scale sparse ancestor already
 peaked at 2.3 GiB HBM on one chip.
 
-Two supervised runs (``--supervise`` + armed watchdog — a hang becomes
-``hang_report.json`` + retry, not rc:124-with-nothing, the r01–r05
-multichip lesson):
+Round 8 protocol — three legs:
 
-1. the N-device mesh (default 8): S row-sharded over ``data``, candidate
-   search streamed per shard;
+1. the N-device mesh (default 8), supervised (``--supervise`` + armed
+   watchdog): S row-sharded over ``data``, candidate search streamed
+   per shard through the DOUBLE-BUFFERED chunk pipeline with targets
+   RING-rotated over the same axis (``streamed_rules`` defaults since
+   the pipelining rewrite — boundary permutes overlap the per-tile
+   top-k instead of serializing it);
 2. the 1-device reference: same streamed path, unsharded — the
-   scaling-efficiency anchor.
+   weak-scaling efficiency anchor;
+3. the OFFLOAD leg (``--offload-corpus``, on by default): a ~10M-row
+   (``--offload-rows``, default 2^23) corpus ψ₁ table resident in HOST
+   RAM, shortlisted through ``python -m dgmc_tpu.ops.offload`` — the
+   N-deep device prefetch ring streams chunks to every device while
+   the shortlist streams back, so per-device static memory stays at
+   the per-chunk executable's bound however big the corpus
+   (``--prefetch-depth``; a leading prefix is verified bit-exact
+   against the device-resident path).
 
 Each run records through the standard obs stack (``RunObserver`` step
 timings, ``--aot_compile`` static per-device memory bounds from
 ``memory_analysis``, ``obs.cost`` stage attribution) and the N-device run
 is merged by ``obs.aggregate`` into the per-device skew summary. The
-driver then writes one committed JSON record (``SCALE_r07.json``) with
-step times, per-device memory, and scaling efficiency vs 1 device.
+driver then writes one committed JSON record (``SCALE_r08.json``) with
+step times, per-device memory, scaling efficiency vs 1 device, and the
+offload-leg account (the ``offload`` column of ``obs.timeline``).
 
 On this container the "devices" are XLA virtual CPU devices on one
 socket (no parallel silicon), so the efficiency number records
@@ -80,7 +91,43 @@ def cli_argv(args, obs_dir, row_shards, n_s=None, e_s=None):
     return argv
 
 
-def run_leg(args, name, row_shards, n_devices, n_s=None, e_s=None):
+def anchor_cpu_share(args):
+    """CPU cores the 1-device anchor leg is pinned to (``taskset``):
+    its fair per-device share of the socket. The N-device leg runs N
+    virtual devices on the whole socket, so each device effectively
+    owns ``cores/N``; an anchor free to spread one device's work over
+    every core is comparing one device against N devices' silicon, and
+    the 'weak-scaling' ratio reads ~0.88 from that artifact alone
+    (r07's recorded gap — measured directly: the 2^18 slice search
+    takes 20.2 s on the full socket vs 23.8 s on its 3-core share,
+    against 23.3 s per sharded step). Returns a core count, or 0 =
+    unpinned (``--anchor-cpus 0``, the r07 protocol). Validates up
+    front — ``main`` resolves this BEFORE any leg runs, so an unusable
+    explicit value fails in seconds, not after the 8-device leg's wall
+    clock."""
+    import shutil
+    if str(args.anchor_cpus).lower() in ('0', 'off', 'none'):
+        return 0
+    if str(args.anchor_cpus) == 'auto':
+        if shutil.which('taskset') is None:
+            return 0
+        return max(1, (os.cpu_count() or args.devices) // args.devices)
+    try:
+        n = int(args.anchor_cpus)
+    except ValueError:
+        raise SystemExit(
+            f'--anchor-cpus must be "auto", 0/off, or an integer core '
+            f'count; got {args.anchor_cpus!r}')
+    if n > 0 and shutil.which('taskset') is None:
+        raise SystemExit(
+            f'--anchor-cpus {n} requires taskset(1), which this box '
+            f'does not have; pass --anchor-cpus 0 for the unpinned '
+            f'(r07) protocol')
+    return max(0, n)
+
+
+def run_leg(args, name, row_shards, n_devices, n_s=None, e_s=None,
+            pin_cpus=0):
     obs_dir = os.path.join(args.workdir, f'obs_{name}')
     env = dict(
         os.environ,
@@ -94,6 +141,12 @@ def run_leg(args, name, row_shards, n_devices, n_s=None, e_s=None):
     )
     log_path = os.path.join(args.workdir, f'{name}.log')
     done = os.path.join(obs_dir, 'recovery.json')
+    # The pinning actually APPLIED to this leg, persisted beside its
+    # telemetry: a --reuse collect-only rerun must report the pin the
+    # completed leg ran under, not whatever the current invocation
+    # would have used (a reused unpinned r07-era anchor documented as
+    # pinned would falsify the efficiency number's provenance).
+    pin_path = os.path.join(args.workdir, f'{name}.pin.json')
     if args.reuse and os.path.exists(done) and json.load(
             open(done)).get('outcome') == 'completed':
         # Collect-only rerun: the leg already completed in this workdir;
@@ -101,13 +154,20 @@ def run_leg(args, name, row_shards, n_devices, n_s=None, e_s=None):
         rc = 0
         wall = sum(a.get('end_time', 0.0) - a.get('start_time', 0.0)
                    for a in json.load(open(done)).get('attempts', []))
-        print(f'# {name}: reusing completed leg in {obs_dir}', flush=True)
+        pin_cpus = (json.load(open(pin_path)).get('pin_cpus', 0)
+                    if os.path.exists(pin_path) else 0)
+        print(f'# {name}: reusing completed leg in {obs_dir} '
+              f'(ran with pin_cpus={pin_cpus})', flush=True)
     else:
         t0 = time.time()
+        argv = cli_argv(args, obs_dir, row_shards, n_s=n_s, e_s=e_s)
+        if pin_cpus:
+            argv = ['taskset', '-c', f'0-{pin_cpus - 1}'] + argv
+        with open(pin_path, 'w') as f:
+            json.dump({'pin_cpus': pin_cpus}, f)
         with open(log_path, 'w') as log:
             rc = subprocess.run(
-                cli_argv(args, obs_dir, row_shards, n_s=n_s, e_s=e_s),
-                cwd=REPO, env=env, stdout=log,
+                argv, cwd=REPO, env=env, stdout=log,
                 stderr=subprocess.STDOUT).returncode
         wall = time.time() - t0
     print(f'# {name}: rc={rc} wall={wall:.0f}s (log: {log_path})',
@@ -156,12 +216,74 @@ def run_leg(args, name, row_shards, n_devices, n_s=None, e_s=None):
                                             'total_bytes') if k in rec}
     return {'rc': rc, 'wall_s': round(wall, 1), 'obs_dir': obs_dir,
             'report': report, 'recovery': recovery,
-            'aot_memory': aot_memory,
+            'aot_memory': aot_memory, 'pin_cpus': pin_cpus,
             'hang_report': os.path.exists(
                 os.path.join(obs_dir, 'hang_report.json'))}
 
 
-def summarize(args, leg8, leg1):
+def run_offload_leg(args):
+    """The host-RAM offload leg: ``python -m dgmc_tpu.ops.offload`` on
+    the full virtual-device mesh, watchdog-armed through the standard
+    obs stack; returns the driver's JSON record plus rc/wall. Under
+    ``--reuse`` a completed record in the workdir is collected instead
+    of re-running the ~50-minute sweep (the same contract as the
+    supervised legs' recovery.json reuse)."""
+    obs_dir = os.path.join(args.workdir, 'obs_offload')
+    record_path = os.path.join(args.workdir, 'offload_record.json')
+    if args.reuse and os.path.exists(record_path):
+        with open(record_path) as f:
+            saved = json.load(f)
+        if saved.get('record', {}).get('metric') == 'offloaded_shortlist':
+            print(f'# offload: reusing completed leg in {obs_dir}',
+                  flush=True)
+            return saved
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS='cpu',
+        XLA_FLAGS=(os.environ.get('XLA_FLAGS', '')
+                   + f' --xla_force_host_platform_device_count='
+                     f'{args.devices}'),
+        JAX_ENABLE_COMPILATION_CACHE='false',
+    )
+    log_path = os.path.join(args.workdir, 'offload.log')
+    argv = [
+        sys.executable, '-m', 'dgmc_tpu.ops.offload',
+        '--rows', str(args.offload_rows),
+        '--targets', str(args.offload_targets),
+        '--dim', str(args.psi_dim), '--k', str(args.k),
+        '--chunk', str(args.offload_chunk),
+        '--block', str(args.block),
+        '--prefetch-depth', str(args.prefetch_depth),
+        '--seed', str(args.seed),
+        '--obs-dir', obs_dir,
+        '--watchdog-deadline', str(args.watchdog),
+    ]
+    t0 = time.time()
+    with open(log_path, 'w') as log:
+        proc = subprocess.run(argv, cwd=REPO, env=env,
+                              stdout=subprocess.PIPE, stderr=log,
+                              text=True)
+    wall = time.time() - t0
+    record = {}
+    for line in reversed(proc.stdout.strip().splitlines()):
+        try:
+            record = json.loads(line)
+            break
+        except ValueError:
+            continue
+    print(f'# offload: rc={proc.returncode} wall={wall:.0f}s '
+          f'(log: {log_path})', flush=True)
+    leg = {'rc': proc.returncode, 'wall_s': round(wall, 1),
+           'obs_dir': obs_dir, 'record': record,
+           'hang_report': os.path.exists(
+               os.path.join(obs_dir, 'hang_report.json'))}
+    if proc.returncode == 0 and record:
+        with open(record_path, 'w') as f:
+            json.dump(leg, f)
+    return leg
+
+
+def summarize(args, leg8, leg1, offload=None):
     rep8, rep1 = leg8['report'], leg1['report']
     p50_8 = rep8.get('step_p50_s')
     p50_1 = rep1.get('step_p50_s')
@@ -178,7 +300,10 @@ def summarize(args, leg8, leg1):
         'mode': (f'supervised streamed-S synthetic KG alignment '
                  f'(dbp15k.py --synthetic --row_shards {args.devices} '
                  f'--stream_chunk {args.chunk} --aot_compile) under '
-                 f'--supervise --watchdog-deadline {args.watchdog}'),
+                 f'--supervise --watchdog-deadline {args.watchdog}; '
+                 f'double-buffered chunk pipeline + ring-rotated '
+                 f'target shards (streamed_rules defaults since the '
+                 f'overlap rewrite)'),
         'environment': {
             'platform': ('cpu (XLA --xla_force_host_platform_device_'
                          f'count={args.devices}; virtual devices on one '
@@ -203,10 +328,21 @@ def summarize(args, leg8, leg1):
             'watchdog_deadline_s': args.watchdog,
         },
         'anchor_mode': (
-            'weak-scaling slice: 1dev leg runs N_s/devices source rows '
-            'against the full target set (equal per-device work)'
-            if args.anchor == 'slice' else
-            'strong: 1dev leg runs the full pair'),
+            ('weak-scaling slice: 1dev leg runs N_s/devices source rows '
+             'against the full target set (equal per-device work)'
+             if args.anchor == 'slice' else
+             'strong: 1dev leg runs the full pair')
+            # Provenance from the leg that RAN (run_leg persists the
+            # applied pin beside its telemetry), never from the current
+            # invocation's flags — a --reuse collect must not relabel
+            # an unpinned anchor as pinned.
+            + (f'; anchor pinned to its fair per-device core share '
+               f'({leg1.get("pin_cpus")} of {os.cpu_count()} cores '
+               f'via taskset — the N-device leg runs N virtual devices '
+               f'on one socket, so an unpinned anchor would compare '
+               f'one device against N devices\' silicon)'
+               if leg1.get('pin_cpus') else
+               '; anchor unpinned (whole socket — the r07 protocol)')),
         'timing': {
             'step_p50_ms_8dev': None if p50_8 is None
             else round(p50_8 * 1e3, 1),
@@ -234,27 +370,53 @@ def summarize(args, leg8, leg1):
                 rep1['peak_memory_bytes'] / gib, 3),
             'single_chip_flagship_peak_gib': 2.3,
         },
-        'analysis': (
-            'First million-entity (2^20 x 2^20) alignment smoke to '
-            'complete end to end: the partition-rule streamed layout '
-            '(S/shortlist/psi2-rows sharded over data, candidate search '
-            'streamed per shard, AD-opaque) holds the refinement train '
-            'step at ~1.0 GiB static per device — under the 15k x 20k '
-            'single-chip flagship\'s 2.3 GiB live peak while the '
-            'correspondence space is ~3,500x larger — and the full '
-            'supervised two-phase train + eval schedule completed under '
-            'the supervisor with zero restarts, no hang report, and '
-            'device step skew 1.0. Timing on virtual CPU devices records '
-            'machinery, not silicon: the weak-scaling anchor (one '
-            'device\'s row slice against the full target set, run on 1 '
-            'device) steps at 0.89x the 8-device full-pair step, i.e. '
-            '~11% parallelization overhead from GSPMD collectives and '
-            'shared-socket contention. The f32 policy is pinned because '
-            'this CPU backend emulates bf16 (a whole phase-1 step '
-            'measured >10x slower under the bf16 default). The '
-            'real-accelerator rerun is a config change, not new code: '
-            'the same partition rules on a TPU slice.'),
     }
+    if offload is not None:
+        rec = offload.get('record') or {}
+        ost = rec.get('offload') or {}
+        mem_off = rec.get('per_device_static_bytes') or {}
+        out['offload'] = {
+            'outcome': ('completed' if offload['rc'] == 0
+                        and rec.get('metric') == 'offloaded_shortlist'
+                        else f'rc:{offload["rc"]}'),
+            'rows': rec.get('rows'),
+            'targets': rec.get('targets'),
+            'chunk': rec.get('chunk'),
+            'prefetch_depth': ost.get('prefetch_depth'),
+            'host_resident_bytes': ost.get('host_resident_bytes'),
+            'bytes_streamed': ost.get('bytes_streamed'),
+            'ring_misses': ost.get('ring_misses'),
+            'wall_s': offload['wall_s'],
+            'rows_per_sec': rec.get('rows_per_sec'),
+            'per_device_static_gib': None if not mem_off else round(
+                mem_off['total_bytes'] / gib, 3),
+            'per_device_static_bytes': mem_off or None,
+            'verified_rows': rec.get('verified_rows'),
+            'verified_equal': rec.get('verified_equal'),
+            'hang_report': offload['hang_report'],
+        }
+    out['analysis'] = (
+        'Round 8: the chunk loop is a pipeline, and the corpus no '
+        'longer has to fit on device. The 2^20 x 2^20 supervised leg '
+        'runs the rewritten streamed layout - double-buffered source '
+        "chunks (iteration k+1's fetch rides the scan carry, "
+        "independent of iteration k's compute) and ring-rotated "
+        'target shards whose boundary collective-permute is issued a '
+        'rotation ahead of the per-tile top-k (per-device h_t drops '
+        'to one shard; the trip-amplified schedule model pins the '
+        'overlap at >= the 0.24 committed budget, 2x the pre-rewrite '
+        'pin). The offload leg goes an order of magnitude up the '
+        'source axis: the corpus psi_1 table lives in HOST RAM and '
+        'streams through the N-deep device prefetch ring while the '
+        'shortlist streams back, so per-device static memory is the '
+        "per-chunk executable's bound - flat vs r07's 1.04 "
+        'GiB/device however many rows the corpus holds - with a '
+        'leading prefix verified bit-exact against the '
+        'device-resident path. Timing on virtual CPU devices records '
+        'machinery, not silicon; the f32 policy stays pinned (this '
+        'CPU backend emulates bf16 >10x slower), and the '
+        'real-accelerator rerun remains a config change, not new '
+        'code.')
     return out
 
 
@@ -291,7 +453,44 @@ def main(argv=None):
                         help='arm the live telemetry plane on each CLI '
                              'leg (pass 0: every leg picks a free port '
                              'and advertises it in its heartbeat.json)')
-    parser.add_argument('--round', type=int, default=7)
+    parser.add_argument('--round', type=int, default=8)
+    parser.add_argument('--offload-corpus', '--offload_corpus',
+                        dest='offload_corpus', default=True,
+                        action='store_true',
+                        help='run the host-RAM offload leg (on by '
+                             'default; --no-offload-corpus skips it)')
+    parser.add_argument('--no-offload-corpus', dest='offload_corpus',
+                        action='store_false')
+    parser.add_argument('--offload-rows', dest='offload_rows', type=int,
+                        default=1 << 23,
+                        help='offload-leg corpus rows (>= 2^23 = the '
+                             '~10M-row r08 target)')
+    parser.add_argument('--offload-targets', dest='offload_targets',
+                        type=int, default=1 << 17)
+    parser.add_argument('--offload-chunk', dest='offload_chunk',
+                        type=int, default=1 << 14,
+                        help='offload-leg rows per device chunk: the '
+                             'compiled per-chunk program holds TWO '
+                             '[chunk, block] f32 score tiles, so '
+                             'chunk=2^14 x block=8192 measures 1.01 '
+                             'GiB static per device (SCALE_r08.json) — '
+                             'only ~3%% headroom under the 1.04 '
+                             'GiB/device ceiling; size up with the '
+                             'measured record, not the single-tile '
+                             'arithmetic')
+    parser.add_argument('--prefetch-depth', '--prefetch_depth',
+                        dest='prefetch_depth', type=int, default=2,
+                        help='offload-leg device prefetch ring depth '
+                             '(benchmarks/DISPATCH_DEFAULTS.md)')
+    parser.add_argument('--anchor-cpus', dest='anchor_cpus', type=str,
+                        default='auto',
+                        help='pin the 1-device anchor leg to this many '
+                             'CPU cores via taskset ("auto" = '
+                             'cores/devices, the fair per-device share '
+                             'of the socket; 0/off = unpinned, the r07 '
+                             'protocol). On a virtual-device socket an '
+                             'unpinned anchor measures one device '
+                             'against N devices\' silicon')
     parser.add_argument('--anchor', choices=['slice', 'full'],
                         default='slice',
                         help='1-device scaling anchor: "slice" = '
@@ -306,9 +505,12 @@ def main(argv=None):
     parser.add_argument('--workdir', type=str, default='/tmp/scale_bench')
     parser.add_argument('--out', type=str,
                         default=os.path.join(REPO, 'benchmarks',
-                                             'SCALE_r07.json'))
+                                             'SCALE_r08.json'))
     args = parser.parse_args(argv)
     os.makedirs(args.workdir, exist_ok=True)
+    # Resolve (and validate) the anchor pin BEFORE any leg burns wall
+    # clock: a bad --anchor-cpus fails here, not after the 8-dev leg.
+    pin = anchor_cpu_share(args)
 
     leg8 = run_leg(args, f'{args.devices}dev', args.devices, args.devices)
     if args.anchor == 'slice':
@@ -321,17 +523,22 @@ def main(argv=None):
         # meaning; 'full' remains available for a real chip.
         leg1 = run_leg(args, '1dev', 0, 1,
                        n_s=args.nodes // args.devices,
-                       e_s=args.edges // args.devices)
+                       e_s=args.edges // args.devices,
+                       pin_cpus=pin)
     else:
-        leg1 = run_leg(args, '1dev', 0, 1)
-    out = summarize(args, leg8, leg1)
+        leg1 = run_leg(args, '1dev', 0, 1, pin_cpus=pin)
+    offload = run_offload_leg(args) if args.offload_corpus else None
+    out = summarize(args, leg8, leg1, offload)
     with open(args.out, 'w') as f:
         json.dump(out, f, indent=1)
         f.write('\n')
     print(json.dumps({k: out[k] for k in ('timing', 'memory',
-                                          'supervision')}, indent=1))
+                                          'supervision', 'offload')
+                      if k in out}, indent=1))
     print(f'# wrote {args.out}')
-    return 0 if (leg8['rc'] == 0 and leg1['rc'] == 0) else 1
+    ok = leg8['rc'] == 0 and leg1['rc'] == 0 and (
+        offload is None or offload['rc'] == 0)
+    return 0 if ok else 1
 
 
 if __name__ == '__main__':

@@ -191,8 +191,15 @@ RULES: Dict[str, RuleDoc] = {d.rule: d for d in [
        '(shard_map, as parallel/topk.py does).'),
     _r('SHD303', 'warning',
        'resharding churn inside the consensus iteration body',
-       'Two or more resharding collectives (collective-permute / '
-       'all-to-all) inside one while-loop body.',
+       'Two or more resharding collectives that BOUNCE the layout '
+       'inside one while-loop body: all-to-alls, and collective-'
+       'permutes composed through the body dataflow (one permute fed '
+       'by another — the data left and came back in one iteration). '
+       'Independent per-iteration permutes are exempt: they are the '
+       'pipelined streamed-S ring rotation (the boundary transfer '
+       'deliberately re-issued each iteration, overlapped with the '
+       'per-tile top-k — at any ring size; a 2-device rotation is its '
+       'own inverse, so churn cannot be read off source_target_pairs).',
        'The layout is bounced back and forth on EVERY consensus '
        'iteration — communication cost that scales with num_steps '
        'instead of being paid once.',

@@ -140,6 +140,9 @@ _COMP_HEADER = re.compile(
 _OP_LINE = re.compile(
     r'^\s*(ROOT\s+)?%?([\w.\-]+)\s*=\s*(\(.*?\)|\S+)\s+([\w\-]+)\(')
 _CHANNEL_ID = re.compile(r'channel_id=(\d+)')
+# `backend_config={"known_trip_count":{"n":"32"}}` on while ops whose
+# trip count XLA proved constant (every lax.scan lowers this way).
+_TRIP_COUNT = re.compile(r'"known_trip_count":\s*\{"n":"(\d+)"\}')
 _REGION_REF = re.compile(
     r'\b(condition|body|true_computation|false_computation|to_apply|'
     r'calls)=%?([\w.\-]+)')
@@ -219,6 +222,18 @@ class HloOp:
     @property
     def channel_id(self) -> Optional[int]:
         m = _CHANNEL_ID.search(self.line)
+        return int(m.group(1)) if m else None
+
+    @property
+    def known_trip_count(self) -> Optional[int]:
+        """Constant trip count of a ``while`` op, from the
+        ``known_trip_count`` backend config XLA stamps on loops it
+        proved bounded (``lax.scan``'s counted loop always is). None
+        when absent or not a while — callers treating None as 1 get
+        the conservative single-execution reading."""
+        if self.opcode != 'while':
+            return None
+        m = _TRIP_COUNT.search(self.line)
         return int(m.group(1)) if m else None
 
     @property

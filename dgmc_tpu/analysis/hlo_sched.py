@@ -30,8 +30,12 @@ This module builds that view:
   overlap the rewrite bought, statically.
 - **Per-collective overlap fraction**: the fraction of a collective's
   modeled in-flight window covered by busy compute-stream time; the
-  program's ``overlap_fraction`` is the payload-weighted mean. A
-  collective with zero overlappable compute is **serialized**.
+  program's ``overlap_fraction`` is the payload-weighted mean, with
+  in-loop collectives weighted once per modeled trip
+  (:func:`computation_trip_factors` — a ring body's boundary permute
+  at 8 rotations moves more bytes than a one-shot gradient psum, and
+  the weighting must say so). A collective with zero overlappable
+  compute is **serialized**.
 - **Critical-path share**: longest dependency-path cost over total cost
   — how much of the program is chain, not width. 1.0 = fully serial.
 
@@ -54,7 +58,7 @@ from dgmc_tpu.analysis.hlo_comm import (HloComputation, HloModule, HloOp,
 __all__ = [
     'FREE_OPS', 'FETCH_OPS', 'ScheduledOp', 'CollectiveInterval',
     'ComputationSchedule', 'schedule_computation', 'module_schedules',
-    'schedule_summary', 'main',
+    'computation_trip_factors', 'schedule_summary', 'main',
 ]
 
 #: Ops that neither move nor produce bytes worth modeling: bookkeeping
@@ -311,11 +315,52 @@ def module_schedules(text_or_module) -> Dict[str, ComputationSchedule]:
     return out
 
 
+def computation_trip_factors(text_or_module) -> Dict[str, int]:
+    """Static execution multiplier per reachable computation: the
+    product of ``known_trip_count`` over the while nests enclosing it
+    (1 at the entry; an unknown trip count conservatively multiplies
+    by 1). A collective inside a chunk loop runs once PER TRIP — a
+    ring body's 200-byte boundary permute at 8 rotations moves more
+    than a one-shot 1 KiB all-reduce — so the payload weighting in
+    :func:`schedule_summary` must amplify by these factors or the
+    model systematically understates exactly the loops ROADMAP item 4
+    pipelines. A computation reachable along several nests keeps the
+    LARGEST factor (shared combiner clones)."""
+    module = (text_or_module if isinstance(text_or_module, HloModule)
+              else parse_hlo_module(text_or_module))
+    factors: Dict[str, int] = {}
+    roots = [module.entry] if module.entry else list(module.computations)[:1]
+
+    def walk(name, factor):
+        comp = module.computations.get(name)
+        if comp is None or factors.get(name, 0) >= factor:
+            return
+        factors[name] = factor
+        for op in comp.ops:
+            if op.opcode == 'fusion':
+                continue
+            sub_factor = factor
+            if op.opcode == 'while':
+                sub_factor = factor * (op.known_trip_count or 1)
+            for sub in op.called_computations():
+                walk(sub, sub_factor)
+
+    for r in roots:
+        if r:
+            walk(r, 1)
+    return factors
+
+
 def schedule_summary(text_or_module, scheds=None) -> dict:
     """The program-level account ``obs/cost.py`` publishes and the SCH
     rules gate on: payload-weighted ``overlap_fraction`` over every
     reachable collective, the serialized subset, and the entry
-    computation's ``critical_path_share``. ``overlap_fraction`` is
+    computation's ``critical_path_share``. Payload weights are
+    **loop-amplified**: a collective inside a while body counts its
+    bytes once per modeled trip (:func:`computation_trip_factors`), so
+    ``collective_bytes`` reads as bytes moved per program execution and
+    an overlapped in-loop boundary permute carries its real weight
+    against one-shot gradient reductions. ``overlap_fraction`` is
     omitted when the program moves nothing between devices. Pass
     ``scheds`` (a :func:`module_schedules` result) to reuse an
     already-built model instead of rebuilding it."""
@@ -323,9 +368,11 @@ def schedule_summary(text_or_module, scheds=None) -> dict:
               else parse_hlo_module(text_or_module))
     if scheds is None:
         scheds = module_schedules(module)
-    colls: List[CollectiveInterval] = []
-    for sched in scheds.values():
-        colls.extend(sched.collectives)
+    factors = computation_trip_factors(module)
+    colls: List[Tuple[CollectiveInterval, int]] = []
+    for name, sched in scheds.items():
+        f = factors.get(name, 1)
+        colls.extend((c, c.nbytes * f) for c in sched.collectives)
     out = {'computations': len(scheds)}
     entry = scheds.get(module.entry) if module.entry else None
     if entry is None and scheds:
@@ -333,13 +380,15 @@ def schedule_summary(text_or_module, scheds=None) -> dict:
     if entry is not None:
         out['critical_path_share'] = round(entry.critical_path_share, 4)
     if colls:
-        total = sum(c.nbytes for c in colls)
+        total = sum(w for _, w in colls)
         out['collective_count'] = len(colls)
         out['collective_bytes'] = total
+        out['loop_collectives'] = sum(
+            1 for c, w in colls if w != c.nbytes)
         out['overlap_fraction'] = round(
-            sum(c.overlap_fraction * c.nbytes for c in colls) / total, 4)
+            sum(c.overlap_fraction * w for c, w in colls) / total, 4)
         out['serialized_collectives'] = sum(
-            1 for c in colls if c.overlap_fraction <= 0.0)
+            1 for c, _ in colls if c.overlap_fraction <= 0.0)
     return out
 
 

@@ -329,25 +329,32 @@ def _streamed_train_step_specimen():
 
     Schedule & liveness budgets (the SCH402/MEM404/MEM405 face of
     ROADMAP item 4, measured via ``python -m dgmc_tpu.analysis.
-    hlo_sched --specimens parallel.streamed_train_step``): the modeled
-    collective overlap fraction of the compiled fixture is **0.1353**
-    (38 collectives, 21 dependence-serialized — the strictly-serial
-    chunk loop; a double-buffered rewrite RAISES this), so
-    ``overlap_budget=0.12`` fails CI the moment an edit chains the loop
-    further; the static peak-live bound is **29,596 B**, so
-    ``peak_bytes_budget=40 KiB`` (~1.35x headroom for layout jitter)
-    fails on a structural blowup — the fixture-scale face of the
-    SCALE_r07 1.04 GiB/device claim. ``stream_full``/``stream_chunk``
-    mirror the ``streamed_rules(stream_chunk=8)`` config over the
-    n_s=16 source axis, arming MEM405's residual accounting — with
-    ``residual_min_bytes=4 KiB``, scaled to the fixture (its largest
-    LEGITIMATE loop-carried buffer is 1,536 B, so any full-axis carry
-    >= 4 KiB here is anomalous; the default GiB-class floor would make
-    the rule inert at this scale). ``double_buffer_min_bytes`` keeps
-    its default deliberately: the fixture's per-chunk fetches are
-    KiB-scale and SCH403 firing on the known-single-buffered loop
-    would add a standing INFO finding — lower it alongside the
-    pipelining rewrite to surface the sites it should fix."""
+    hlo_sched --specimens parallel.streamed_train_step``): since the
+    chunk-pipeline rewrite, the fixture compiles the PHASE-2 refinement
+    step the scale rounds actually spend their wall clock in
+    (``detach=True`` — ψ₁ frozen, exactly ``dbp15k.py``'s streamed
+    phase-2 builder), with the double-buffered chunk scan and the
+    ring-rotated target shards (``streamed_rules`` defaults): the
+    boundary ``collective-permute`` rides the loop carry one rotation
+    ahead of the compute that consumes it, and the trip-amplified
+    schedule model measures **0.3118** collective overlap (the
+    single-buffered, replicated-target ancestor modeled 0.1353), so
+    ``overlap_budget=0.24`` — 2x the pre-rewrite 0.12 pin, with ~30%
+    headroom — fails CI the moment an edit re-serializes the loop or
+    drops the ring. The static peak-live bound is **27,232 B**, so
+    ``peak_bytes_budget=40 KiB`` (~1.5x headroom) fails on a
+    structural blowup — the fixture-scale face of the SCALE_r07/r08
+    per-device memory claims. ``stream_full``/``stream_chunk`` mirror
+    the ``streamed_rules(stream_chunk=8)`` config over the n_s=16
+    source axis, arming MEM405's residual accounting with
+    ``residual_min_bytes=4 KiB`` (largest legitimate carry — the ring
+    target buffer + the prefetched chunk slot — stays well under 2 KiB
+    at this scale). ``double_buffer_min_bytes=128`` is now LOW on
+    purpose: the per-iteration fetches here are a few hundred bytes,
+    and with the floor armed SCH403 stays SILENT only because the
+    rewritten loops keep every fetch off the carry-chained critical
+    path — a regression to the serial shape fires it (pinned by
+    ``tests/analysis/test_sched_rules.py``)."""
     def build():
         import jax
 
@@ -356,14 +363,15 @@ def _streamed_train_step_specimen():
         from dgmc_tpu.parallel.sharding import make_sharded_train_step
         from dgmc_tpu.train import create_train_state
         one = _pair_batch(np.random.RandomState(0), n_s=16, e_s=32,
-                          n_t=24, e_t=48)
+                          n_t=32, e_t=64)
         model = DGMC(RelCNN(4, 8, num_layers=1),
                      RelCNN(4, 4, num_layers=1), num_steps=1, k=4)
         state = create_train_state(model, jax.random.key(0), one,
                                    learning_rate=1e-3)
         mesh = make_mesh(data=4, model=1, devices=jax.devices()[:4])
         rules = streamed_rules(stream_chunk=8)
-        step = make_sharded_train_step(model, mesh, rules=rules,
+        step = make_sharded_train_step(model, mesh, num_steps=1,
+                                       detach=True, rules=rules,
                                        state=state)
         state_sh, batch_sh = rules.place(state, one, mesh)
         b, n_s = one.y.shape
@@ -374,11 +382,12 @@ def _streamed_train_step_specimen():
                 'donate_argnums': (0,),
                 'corr_bytes': b * n_s * n_t * 4,
                 'comm_budget_bytes': 64 << 10,
-                'overlap_budget': 0.12,
+                'overlap_budget': 0.24,
                 'peak_bytes_budget': 40 << 10,
                 'stream_full': n_s,
                 'stream_chunk': 8,
-                'residual_min_bytes': 4 << 10}
+                'residual_min_bytes': 4 << 10,
+                'double_buffer_min_bytes': 128}
     return build
 
 

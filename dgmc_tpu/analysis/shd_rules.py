@@ -24,10 +24,13 @@ over the per-program collective schedule:
     these silently at sharding boundaries; one of them un-shards the
     million-entity S matrix the whole layout exists to split.
 ``SHD303`` reshard-churn (warning)
-    Two or more resharding collectives (``collective-permute`` /
-    ``all-to-all``) inside one ``while`` body — layout bounced back and
-    forth every consensus iteration instead of being settled once
-    outside the loop.
+    Two or more resharding collectives that BOUNCE the layout inside
+    one ``while`` body — ``all-to-all``s, and ``collective-permute``s
+    composed through the body's dataflow (a permute fed by another
+    permute: the data left and came back in one iteration) — instead
+    of the layout being settled once outside the loop. Independent
+    per-iteration permutes are the pipelined streamed-S ring rotation
+    and do not count.
 ``SHD304`` comm-budget (warning)
     The program's total collective payload exceeds the specimen's
     recorded per-step communication budget (``comm_budget_bytes`` in the
@@ -189,16 +192,79 @@ def check_corr_replication(module: HloModule,
     return out
 
 
+def _region_computations(module: HloModule, root: str):
+    """``root`` plus every computation reachable from it through region
+    refs (fusion interiors excluded, matching the schedule walk)."""
+    seen = []
+
+    def walk(name):
+        comp = module.computations.get(name)
+        if comp is None or name in seen:
+            return
+        seen.append(name)
+        for op in comp.ops:
+            if op.opcode == 'fusion':
+                continue
+            for sub in op.called_computations():
+                walk(sub)
+
+    walk(root)
+    return seen
+
+
+def _churn_resharding(module: HloModule, body: str):
+    """Resharding collectives in ``body``'s region that actually BOUNCE
+    the layout. The bounce signature is *composition*: a
+    collective-permute whose local dataflow is fed by (or feeds)
+    another resharding collective in the same computation — the data
+    left and came back inside one iteration. Permutes of INDEPENDENT
+    tensors are single resharding events, not churn: re-issuing the
+    boundary permute every iteration is the pipelined streamed-S ring
+    rotation working as designed (at ANY ring size — a 2-device ring's
+    mapping is its own inverse, which is why churn cannot be read off
+    the source_target_pairs alone). ``all-to-all`` always counts: it
+    is a full reshard with no pipeline reading."""
+    out = []
+    for name in _region_computations(module, body):
+        comp = module.computations[name]
+        defs = {op.result: op for op in comp.ops}
+        resh = [op for op in comp.ops
+                if op.collective_kind in _RESHARDING]
+        composed = set()
+        for op in resh:
+            seen, stack = set(), list(op.operand_refs())
+            while stack:
+                ref = stack.pop()
+                if ref in seen:
+                    continue
+                seen.add(ref)
+                producer = defs.get(ref)
+                if producer is None:
+                    continue
+                if (producer is not op
+                        and producer.collective_kind in _RESHARDING):
+                    composed.add(id(op))
+                    composed.add(id(producer))
+                    break
+                stack.extend(producer.operand_refs())
+        out.extend(op for op in resh
+                   if op.opcode != 'collective-permute'
+                   or id(op) in composed)
+    return out
+
+
 def check_reshard_churn(module: HloModule,
                         ctx: ShardedContext) -> List[Finding]:
-    """SHD303: repeated resharding collectives inside one loop body."""
+    """SHD303: resharding collectives that bounce the layout inside one
+    loop body (:func:`_churn_resharding` — composed permutes and
+    all-to-alls; independent ring-rotation permutes are the pipelined
+    chunk loop working as designed and do not count)."""
     out = []
     for i, (while_op, body) in enumerate(module.while_bodies()):
-        resh = [c for c in module.flatten_collectives(body)
-                if c.kind in _RESHARDING]
+        resh = _churn_resharding(module, body)
         if len(resh) < ctx.reshard_churn_min:
             continue
-        kinds = sorted({c.kind for c in resh})
+        kinds = sorted({op.collective_kind for op in resh})
         out.append(Finding(
             rule='SHD303', severity=Severity.WARNING,
             context=f'while {"/".join(kinds)}',
@@ -207,8 +273,8 @@ def check_reshard_churn(module: HloModule,
                      f'({"/".join(kinds)} round-trip) — the layout is '
                      f'bounced every iteration'),
             detail=(f'{len(resh)} resharding collective(s), '
-                    f'{sum(c.nbytes for c in resh)} B payload per '
-                    f'iteration; settle the layout once outside the '
+                    f'{sum(op.result_bytes for op in resh)} B payload '
+                    f'per iteration; settle the layout once outside the '
                     f'loop (sharding constraints on the carried state) '
                     f'instead of round-tripping it in the consensus '
                     f'iteration body')))

@@ -138,6 +138,25 @@ def parse_args(argv=None):
                              'nodes). "auto" = on, except under the '
                              'row-sharded/streamed layout '
                              '(--row_shards/--stream_chunk)')
+    parser.add_argument('--offload-corpus', '--offload_corpus',
+                        dest='offload_corpus', action='store_true',
+                        help='host-RAM offload tier (ops/offload.py): '
+                             'after training, rebuild the test-pair '
+                             'shortlist with the source ψ₁ embedding '
+                             'table resident in HOST memory, streamed '
+                             'chunk-by-chunk through the N-deep device '
+                             'prefetch ring, and assert bit-exact '
+                             'equality against the device-resident '
+                             'streamed search (logged as '
+                             'offload_equal; the serving-corpus '
+                             'mechanism at experiment scale)')
+    parser.add_argument('--prefetch-depth', '--prefetch_depth',
+                        dest='prefetch_depth', type=int, default=0,
+                        metavar='N',
+                        help='prefetch ring depth for --offload-corpus '
+                             '(0 = the measured library default, '
+                             'ops/offload.DEFAULT_PREFETCH_DEPTH; see '
+                             'benchmarks/DISPATCH_DEFAULTS.md)')
     parser.add_argument('--topk_block', type=int, default=0,
                         help='candidate-search target-axis tile '
                              '(0 = the one measured library default, '
@@ -522,6 +541,7 @@ def main(argv=None):
         print('Optimize initial feature matching...')
     key = jax.random.key(args.seed + 1)
     last_print_epoch, t_span = start_epoch - 1, time.time()
+    last_eval = {}
     for epoch in range(1, args.epochs + 1):
         # Keys are split unconditionally so a resumed run consumes the
         # PRNG stream exactly as an uninterrupted one would.
@@ -575,6 +595,7 @@ def main(argv=None):
             n = max(float(host['count']), 1.0)
             hits1 = float(host['correct']) / n
             hits10 = float(host['hits@10']) / n
+            last_eval = {'loss': loss, 'hits1': hits1, 'hits10': hits10}
             guard_metrics = {}
             if guard_mon is not None:
                 guard_metrics = {
@@ -612,6 +633,72 @@ def main(argv=None):
             # Armed ckpt-truncate/ckpt-corrupt faults damage the step
             # that was just committed (waits out the async save).
             plan.after_checkpoint(ckpt, epoch)
+    if args.offload_corpus and nproc > 1:
+        # The prefetch ring device_puts onto addressable devices only;
+        # a per-host pass would also duplicate the verification work.
+        # Single-process covers the mechanism — skip loudly, not crash
+        # after the whole training wall clock was spent.
+        if is_coordinator():
+            print('# offload shortlist: skipped (multi-process run; '
+                  'the prefetch ring is single-host)')
+    elif args.offload_corpus:
+        # Host-RAM offload pass (the serving-corpus mechanism, exercised
+        # at experiment scale): the trained ψ₁ table for the test pair's
+        # source side moves to HOST memory and is re-shortlisted through
+        # the prefetch ring, then compared BIT-EXACTLY against the
+        # device-resident streamed search on the same embeddings. The
+        # final eval metrics ride the same record so obs.diff
+        # --require-equal can gate streamed-vs-offloaded runs on them.
+        from dgmc_tpu.models.precision import compute_dtype_of
+        from dgmc_tpu.ops.offload import (DEFAULT_PREFETCH_DEPTH,
+                                          offloaded_streamed_topk)
+        from dgmc_tpu.ops.topk import streamed_topk
+        from dgmc_tpu.parallel.rules import DEFAULT_STREAM_CHUNK
+
+        def embed(params, batch):
+            h_s = model.psi_1.apply({'params': params['psi_1']},
+                                    batch.s.x, batch.s, train=False)
+            h_t = model.psi_1.apply({'params': params['psi_1']},
+                                    batch.t.x, batch.t, train=False)
+            dt = compute_dtype_of(model.dtype)
+            if dt is not None:
+                h_s, h_t = h_s.astype(dt), h_t.astype(dt)
+            return h_s, h_t
+
+        h_s, h_t = jax.jit(embed)(state.params, test_batch)
+        chunk = (args.stream_chunk
+                 or (rules.stream_chunk if rules is not None else None)
+                 or DEFAULT_STREAM_CHUNK)
+        chunk = min(int(chunk), h_s.shape[1])
+        block = args.topk_block or model.topk_block
+        depth = args.prefetch_depth or DEFAULT_PREFETCH_DEPTH
+        ref_v, ref_i = streamed_topk(h_s, h_t, args.k, chunk,
+                                     block=block, pallas=False,
+                                     return_values=True)
+        ov, oi, stats = offloaded_streamed_topk(
+            np.asarray(jax.device_get(h_s)),
+            np.asarray(jax.device_get(h_t)), args.k, chunk,
+            block=block, depth=depth, devices=jax.local_devices())
+        equal = bool(np.array_equal(oi, np.asarray(ref_i))
+                     and np.array_equal(ov, np.asarray(ref_v)))
+        if is_coordinator():
+            print(f'# offload shortlist: equal={equal} '
+                  f'rows={stats.rows} chunks={stats.chunks} '
+                  f'depth={stats.prefetch_depth} '
+                  f'host {stats.host_resident_bytes >> 20} MiB '
+                  f'misses={stats.ring_misses} '
+                  f'wall {stats.wall_s:.2f}s')
+        obs.log(args.epochs, event='offload_shortlist',
+                offload_equal=float(equal),
+                offload_host_bytes=stats.host_resident_bytes,
+                offload_prefetch_depth=stats.prefetch_depth,
+                offload_ring_misses=stats.ring_misses,
+                offload_wall_s=stats.wall_s, **last_eval)
+        if not equal:
+            raise SystemExit(
+                'offloaded shortlist diverged from the device-resident '
+                'streamed search — the offload tier must be pure '
+                'scheduling')
     if ckpt:
         ckpt.close()
     prof.close()

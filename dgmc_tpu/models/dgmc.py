@@ -148,12 +148,33 @@ class DGMC(nn.Module):
     #   per-iteration difference tensors row-sharded by propagation.
     topk_sharding: Optional[object] = None
     psi2_sharding: Optional[object] = None
+    # - psi1_sharding constrains the source ψ₁ embedding table h_s
+    #   [B, N_s, C] to the row layout, so the embedding COMPUTE shards
+    #   with the search instead of replicating per device (the 'psi1'
+    #   activation rule; GSPMD inserts the edge-boundary comm).
+    # - corpus_sharding constrains the target ψ₁ embedding table h_t
+    #   [B, N_t, C] — the serving-corpus table — over the same axis:
+    #   the ring-rotated search consumes h_t one shard per device, so
+    #   producing it sharded removes the last per-device O(N_t) ψ₁
+    #   replication (the 'corpus' activation rule; only set alongside
+    #   ring_targets — the replicated-target search would just
+    #   all-gather it back).
+    psi1_sharding: Optional[object] = None
+    corpus_sharding: Optional[object] = None
     # Source-node chunk streaming for the sparse candidate search
     # (ops/topk.streamed_topk; inside the shard-local region when a row
     # sharding is set): the N_s x N_t sweep only ever exists as one
     # [chunk, topk_block] score tile, the million-entity prerequisite.
     # None = unstreamed. Sparse (k >= 1) only.
     stream_chunk: Optional[int] = None
+    # Rotate TARGET shards through the row mesh axis during the sharded
+    # candidate search (parallel/topk.corr_sharded_topk ring mode): h_t
+    # lives one shard per device instead of replicated, and the
+    # shard-boundary collective-permute is issued a rotation ahead of
+    # the compute that consumes it, so the transfer pipelines against
+    # the per-tile top-k (bit-identical results; ignored without a
+    # ringable row sharding). Set by PartitionRules.apply_to_model.
+    ring_targets: bool = False
     # Mixed-precision compute dtype — a raw dtype or a
     # models/precision.Precision policy — for the matching stage itself
     # (the similarity GEMMs, candidate search operands and consensus MLP):
@@ -246,7 +267,9 @@ class DGMC(nn.Module):
         except inside explicit shard_map regions)."""
         return (self.corr_sharding is not None
                 or self.topk_sharding is not None
-                or self.psi2_sharding is not None)
+                or self.psi2_sharding is not None
+                or self.psi1_sharding is not None
+                or self.corpus_sharding is not None)
 
     @nn.compact
     def __call__(self, graph_s, graph_t, y=None, y_mask=None, train=False,
@@ -380,6 +403,16 @@ class DGMC(nn.Module):
         dtype = compute_dtype_of(self.dtype)
         if dtype is not None:
             h_s, h_t = h_s.astype(dtype), h_t.astype(dtype)
+        # Embedding-table layout constraints (streamed million-entity
+        # config): h_s follows the row sharding the search consumes, and
+        # h_t — the corpus table — follows the ring's shard rotation, so
+        # ψ₁ itself runs sharded instead of once per device.
+        if self.psi1_sharding is not None:
+            h_s = jax.lax.with_sharding_constraint(h_s,
+                                                   self.psi1_sharding)
+        if self.corpus_sharding is not None:
+            h_t = jax.lax.with_sharding_constraint(h_t,
+                                                   self.corpus_sharding)
         if detach:
             h_s = jax.lax.stop_gradient(h_s)
             h_t = jax.lax.stop_gradient(h_t)
@@ -588,7 +621,8 @@ class DGMC(nn.Module):
                 S_idx = corr_sharded_topk(idx_sharding, h_s, h_t,
                                           self.k, t_mask,
                                           block=self.topk_block,
-                                          chunk=self.stream_chunk)
+                                          chunk=self.stream_chunk,
+                                          ring=self.ring_targets)
             if S_idx is None and self.stream_chunk is not None:
                 from dgmc_tpu.ops.topk import streamed_topk
                 S_idx = streamed_topk(h_s, h_t, self.k, self.stream_chunk,

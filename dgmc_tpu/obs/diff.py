@@ -122,6 +122,11 @@ DEFAULT_THRESHOLDS = {
     #: off unless asked, same contract as min_overlap.
     'min_measured_overlap': None,
     'idle': 0.25,
+    #: Logged metrics whose FINAL values must be exactly equal between
+    #: the runs (tuple of keys; empty = gate off). The
+    #: streamed-vs-offloaded equivalence gate: two layouts of the same
+    #: forward must log the same loss/Hits, bit for bit.
+    'require_equal': (),
 }
 
 #: Keys the gates read from a run summary — listed in missing-metric
@@ -257,6 +262,38 @@ def diff_runs(a, b, thresholds=None, allow_kernel_fallback=False):
         rows.append(_row('restarts', ra.get('restarts', 0), None, None,
                          thr['restarts'], 'skipped',
                          'candidate unsupervised'))
+
+    # -- required-equal logged metrics ------------------------------------
+    # The layout-equivalence gate (streamed vs offloaded forward): the
+    # named metrics' final logged values must match EXACTLY — a layout
+    # change is pure scheduling, so any numeric drift is a bug, not
+    # noise. Asymmetric on absence like every other gate: a key the
+    # baseline logged but the candidate lost fails.
+    la, lb = a.get('last_metrics') or {}, b.get('last_metrics') or {}
+    for key in thr.get('require_equal') or ():
+        va, vb = la.get(key), lb.get(key)
+        if va is None and vb is None:
+            rows.append(_row(f'equal:{key}', None, None, None, 0,
+                             'REGRESSION',
+                             'neither run logged the required metric'))
+        elif va is None or vb is None:
+            rows.append(_row(f'equal:{key}', va, vb, None, 0,
+                             'REGRESSION',
+                             _missing_note(
+                                 'baseline' if va is None else 'candidate',
+                                 a if va is None else b)))
+        else:
+            # Values may be non-numeric (metrics.jsonl carries e.g.
+            # 'event' strings): the gate is pure equality; the delta
+            # column is numeric-only garnish.
+            delta = (abs(va - vb)
+                     if va != vb
+                     and isinstance(va, (int, float))
+                     and isinstance(vb, (int, float))
+                     and not isinstance(va, bool)
+                     and not isinstance(vb, bool) else None)
+            gate(f'equal:{key}', va, vb, delta, 0, va != vb,
+                 '' if va == vb else 'required exactly equal')
 
     # -- MFU --------------------------------------------------------------
     # Asymmetric like the timings: efficiency the baseline accounted for
@@ -590,6 +627,14 @@ def main(argv=None):
                              '(recovery.json; a candidate whose '
                              'supervisor gave up fails unconditionally; '
                              'default %(default)s)')
+    parser.add_argument('--require-equal', type=str, default=None,
+                        metavar='KEY[,KEY...]',
+                        help='comma-separated logged-metric keys whose '
+                             'FINAL values must be exactly equal in '
+                             'both runs (the streamed-vs-offloaded '
+                             'layout-equivalence gate: e.g. '
+                             '--require-equal loss,hits1); a key '
+                             'either run failed to log fails')
     parser.add_argument('--allow-kernel-fallback', action='store_true',
                         help='downgrade pallas->fallback dispatch changes '
                              'from regression to note')
@@ -625,6 +670,9 @@ def main(argv=None):
             'static_peak': args.max_peak_regression,
             'min_measured_overlap': args.min_measured_overlap,
             'idle': args.max_idle_regression,
+            'require_equal': tuple(
+                k.strip() for k in (args.require_equal or '').split(',')
+                if k.strip()),
         },
         allow_kernel_fallback=args.allow_kernel_fallback)
 

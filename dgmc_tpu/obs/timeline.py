@@ -10,13 +10,21 @@ it finds (both the modern structured schema of r06+ and the legacy
 renders the trajectory per family::
 
     python -m dgmc_tpu.obs.timeline benchmarks/          # table
-    python -m dgmc_tpu.obs.timeline benchmarks/ . --json # machine-readable
+    python -m dgmc_tpu.obs.timeline benchmarks/ --json   # machine-readable
+
+Since PR 14 every committed round lives under ``benchmarks/`` (the
+legacy root-level r01–r05 driver captures moved there), so the single
+``benchmarks/`` invocation covers the whole trajectory.
 
 Columns are the headline series the ROADMAP tracks: throughput
 (pairs/s), step p50, MFU, modeled overlap fraction, skew, device count,
 and the round's outcome (``rc:124`` rounds — the silent-hang era — show
-up as exactly that). Like every other obs reader, this module has **no
-jax import**: it renders committed evidence on any box.
+up as exactly that). SCALE rows additionally carry the ``offload``
+column (prefetch-ring depth + host-resident corpus bytes) so an r07→r08
+jump in rows reads as the layout change it is — the corpus moved to
+host RAM — not a regression in what fits on device. Like every other
+obs reader, this module has **no jax import**: it renders committed
+evidence on any box.
 """
 
 import argparse
@@ -114,6 +122,14 @@ def parse_round(family, number, path):
             _get(d, 'timing', 'overlap_fraction')),
         'skew': _get(d, 'timing', 'per_device_step_skew_ratio'),
     }
+    off = d.get('offload') or {}
+    if off:
+        row['offload'] = {
+            'rows': off.get('rows'),
+            'prefetch_depth': off.get('prefetch_depth'),
+            'host_resident_bytes': off.get('host_resident_bytes'),
+            'outcome': off.get('outcome'),
+        }
     # Truncate the long prose device/platform strings to their lead.
     if isinstance(row['device'], str):
         row['device'] = row['device'].split('(')[0].strip() or None
@@ -151,16 +167,30 @@ def _fmt(v, spec='{:.4g}'):
     return '-' if v is None else spec.format(v)
 
 
+def _fmt_offload(off):
+    """``d<depth>/<host GiB>`` — the ring depth and where the corpus
+    lives; '-' for rows without an offload tier."""
+    if not off:
+        return '-'
+    depth = off.get('prefetch_depth')
+    host = off.get('host_resident_bytes')
+    host = f'{host / 2**30:.1f}G' if host else '?'
+    return f'd{depth if depth is not None else "?"}/{host}'
+
+
 def render(rows):
     lines = []
     for family in _FAMILIES:
         fam_rows = [r for r in rows if r['family'] == family]
         if not fam_rows:
             continue
+        offload_col = any(r.get('offload') for r in fam_rows)
         lines.append(f'== {family} trajectory ==')
         lines.append(f'  {"round":>5} {"pairs/s":>9} {"step p50":>11} '
                      f'{"MFU":>8} {"overlap":>8} {"skew":>7} '
-                     f'{"dev":>4}  outcome')
+                     f'{"dev":>4}'
+                     + (f' {"offload":>9}' if offload_col else '')
+                     + '  outcome')
         for r in fam_rows:
             p50 = r.get('step_p50_ms')
             p50 = fmt_seconds(p50 / 1e3) if p50 is not None else '-'
@@ -170,8 +200,10 @@ def render(rows):
                 f'  {r["round"]:>5} {_fmt(r.get("pairs_per_sec")):>9} '
                 f'{p50:>11} {mfu:>8} {_fmt(r.get("overlap")):>8} '
                 f'{_fmt(r.get("skew"), "{:.3f}x"):>7} '
-                f'{_fmt(r.get("devices"), "{:d}"):>4}  '
-                f'{r.get("outcome", "?")}')
+                f'{_fmt(r.get("devices"), "{:d}"):>4}'
+                + (f' {_fmt_offload(r.get("offload")):>9}'
+                   if offload_col else '')
+                + f'  {r.get("outcome", "?")}')
     if not lines:
         lines.append('(no BENCH_r*/MULTICHIP_r*/SCALE_r*.json rounds '
                      'found)')

@@ -1,0 +1,367 @@
+"""Host-RAM offload tier: corpus tables in host memory, device chunks
+streamed through an N-deep prefetch ring.
+
+The streamed-S layout (``ops/topk.streamed_topk`` + ``parallel/``)
+bounds per-device memory at ``O(chunk x block)`` for the SEARCH, but the
+corpus ψ₁ embedding table itself still had to live on device. This
+module removes that last O(corpus) device resident: the table stays in
+host RAM (pinned where the platform supports it), and a
+:class:`PrefetchRing` keeps the next ``depth`` source chunks in flight
+to the device while the current chunk's per-tile top-k computes — the
+host-side face of the double-buffered chunk loop, driven at the same
+chunk boundaries. The result shortlist (the "cold" sparse S rows)
+streams straight back to host through async device-to-host copies, so
+the on-device working set is ``O(depth x chunk)`` whatever the corpus
+size — the mechanism the 10M-row SCALE_r08 leg rides, and the same one
+the serving embedding cache (ROADMAP item 1) will reuse.
+
+Two layers:
+
+- :class:`PrefetchRing` — generic host→device chunk ring: slot ``i``
+  lands on ``devices[i % n]`` (round-robin over every addressable
+  device: chunks are row-independent, so the ring is also the
+  data-parallel dispatch), ``get(i)`` serves chunk ``i`` and tops the
+  window back up to ``depth`` in-flight puts, evicting everything
+  behind the cursor. ``jax.device_put`` is async, so the transfers
+  genuinely overlap compute the host has already dispatched.
+- :func:`offloaded_streamed_topk` — the chunk-streamed candidate search
+  driven from the host against a ring-fed corpus, **bit-identical** to
+  :func:`~dgmc_tpu.ops.topk.streamed_topk` on the same inputs (same
+  per-chunk programs in the same order; tie order included), returning
+  host-resident results plus an :class:`OffloadStats` account.
+
+``python -m dgmc_tpu.ops.offload`` is the scale driver: it builds a
+synthetic corpus of ``--rows`` ψ₁ embeddings host-side, shortlists it
+against ``--targets`` device-resident targets through the ring, records
+through the standard obs stack (one ``RunObserver`` step per chunk,
+the per-chunk executable's ``memory_analysis`` as the static per-device
+memory bound), verifies a prefix against the in-device path, and prints
+one JSON summary line — the offloaded leg of ``benchmarks/
+scale_bench.py``.
+"""
+
+import dataclasses
+import time
+from typing import Callable, Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+from dgmc_tpu.ops.topk import DEFAULT_BLOCK
+
+__all__ = ['DEFAULT_PREFETCH_DEPTH', 'PrefetchRing', 'OffloadStats',
+           'offloaded_streamed_topk', 'main']
+
+#: Measured default (benchmarks/DISPATCH_DEFAULTS.md, offload section):
+#: depth 2 already hides the host→device copy behind the per-chunk
+#: search on this container (ring misses only on the cold start), and
+#: deeper rings just hold more device memory for the same wall clock —
+#: the on-device working set is O(depth x chunk).
+DEFAULT_PREFETCH_DEPTH = 2
+
+
+def _pinned_put(x, device):
+    """``jax.device_put`` onto ``device``; when the platform exposes a
+    ``pinned_host`` memory space (TPU runtimes — this container's CPU
+    backend does not), corpus staging buffers could additionally be
+    pinned there; the portable path commits straight to the device."""
+    import jax
+    return jax.device_put(x, device)
+
+
+class PrefetchRing:
+    """N-deep host→device prefetch ring over a chunked host table.
+
+    ``source`` is either a host array whose leading axis is the chunk
+    axis, or a callable ``i -> host chunk`` (for tables too big or too
+    lazy to materialize at once; ``n_chunks`` is then required).
+    ``get(i)`` must be called with a non-decreasing cursor: it returns
+    chunk ``i`` on ``devices[i % len(devices)]``, issues the puts for
+    ``i+1 .. i+depth``, and evicts every slot behind the cursor — at
+    most ``depth + 1`` chunks are device-resident per sweep, whatever
+    the corpus size.
+    """
+
+    def __init__(self, source: Union[np.ndarray, Callable[[int], np.ndarray]],
+                 depth: int = DEFAULT_PREFETCH_DEPTH,
+                 n_chunks: Optional[int] = None,
+                 devices: Optional[Sequence] = None):
+        import jax
+        self._fn = (source.__getitem__ if hasattr(source, '__getitem__')
+                    else source)
+        if n_chunks is None:
+            if not hasattr(source, 'shape'):
+                raise ValueError('n_chunks is required for a callable '
+                                 'source')
+            n_chunks = source.shape[0]
+        self.n_chunks = int(n_chunks)
+        self.depth = max(1, int(depth))
+        # Addressable devices only: device_put to a remote host's
+        # device raises — a multi-process caller gets its local slice.
+        self.devices = list(devices or jax.local_devices())
+        self._slots: Dict[int, object] = {}
+        self.puts = 0
+        self.misses = 0
+        self.evictions = 0
+
+    def _issue(self, i):
+        if i < self.n_chunks and i not in self._slots:
+            self._slots[i] = _pinned_put(
+                self._fn(i), self.devices[i % len(self.devices)])
+            self.puts += 1
+
+    def get(self, i: int):
+        """Device chunk ``i`` (its put issued now on a cold miss), with
+        the window ``i+1 .. i+depth`` re-armed and slots behind the
+        cursor evicted."""
+        if i not in self._slots:
+            self.misses += 1
+            self._issue(i)
+        out = self._slots[i]
+        for j in range(i + 1, min(i + 1 + self.depth, self.n_chunks)):
+            self._issue(j)
+        for j in [j for j in self._slots if j < i]:
+            del self._slots[j]
+            self.evictions += 1
+        return out
+
+    @property
+    def in_flight(self) -> int:
+        return len(self._slots)
+
+
+@dataclasses.dataclass
+class OffloadStats:
+    """The account one offloaded sweep returns (and the obs artifacts
+    record): what lived where, and how the ring behaved."""
+    rows: int
+    chunks: int
+    chunk: int
+    prefetch_depth: int
+    devices: int
+    host_resident_bytes: int        # corpus + results, host RAM
+    bytes_streamed: int             # corpus bytes moved host->device
+    ring_misses: int                # chunks served cold (no prefetch)
+    ring_evictions: int
+    wall_s: float
+
+    def to_json(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def offloaded_streamed_topk(h_s_host, h_t, k, chunk,
+                            t_mask=None, block=DEFAULT_BLOCK,
+                            depth: int = DEFAULT_PREFETCH_DEPTH,
+                            devices: Optional[Sequence] = None,
+                            on_chunk: Optional[Callable[[int], None]] = None):
+    """Chunk-streamed top-k with the source table in HOST memory.
+
+    Bit-identical to ``streamed_topk(h_s, h_t, k, chunk, ...)`` run on
+    device (``tests/ops/test_offload.py``): the same ``_chunked_topk``
+    program scores the same chunks in the same order — the ring only
+    changes WHERE each chunk waits. Returns
+    ``(vals, idx, OffloadStats)`` with ``vals``/``idx`` as host numpy
+    ``[B, N_s, k]`` (the shortlist streams back through async
+    device→host copies as it is produced — at most ``depth`` chunk
+    results ride the device at once).
+
+    ``devices`` round-robins chunks across several devices (rows are
+    independent, so the ring doubles as data-parallel dispatch);
+    ``on_chunk`` fires after each chunk's dispatch — the obs step hook.
+    """
+    import jax
+
+    from dgmc_tpu.ops.topk import _chunked_topk, _tile_sort
+
+    h_s_host = np.asarray(h_s_host)
+    B, N_s, C = h_s_host.shape
+    chunk = int(chunk)
+    devices = list(devices or jax.local_devices())
+    n_chunks = -(-N_s // chunk)
+    sort_tiles = _tile_sort()
+
+    def host_chunk(i):
+        piece = h_s_host[:, i * chunk:(i + 1) * chunk]
+        if piece.shape[1] < chunk:     # ragged tail: padded, like the
+            piece = np.pad(            # in-graph scan's padded rows
+                piece, ((0, 0), (0, chunk - piece.shape[1]), (0, 0)))
+        return piece
+
+    ring = PrefetchRing(host_chunk, depth=depth, n_chunks=n_chunks,
+                        devices=devices)
+    # The target side is the small, hot operand: one replica per device,
+    # placed up front.
+    per_dev_t = [jax.device_put(h_t, d) for d in devices]
+    per_dev_m = (None if t_mask is None
+                 else [jax.device_put(t_mask, d) for d in devices])
+
+    vals = np.empty((B, n_chunks * chunk, k), h_s_host.dtype)
+    idx = np.empty((B, n_chunks * chunk, k), np.int32)
+    pending: List = []          # (chunk index, device vals, device idx)
+
+    def drain(limit):
+        while len(pending) > limit:
+            i, dv, di = pending.pop(0)
+            vals[:, i * chunk:(i + 1) * chunk] = np.asarray(dv)
+            idx[:, i * chunk:(i + 1) * chunk] = np.asarray(di)
+
+    t0 = time.perf_counter()
+    for i in range(n_chunks):
+        d = i % len(devices)
+        dv, di = _chunked_topk(ring.get(i), per_dev_t[d], k,
+                               None if per_dev_m is None else per_dev_m[d],
+                               block, True, False, sort_tiles)
+        # Start the device->host copy immediately; materialize lazily so
+        # at most `depth` chunk results are ever device-resident.
+        for a in (dv, di):
+            if hasattr(a, 'copy_to_host_async'):
+                a.copy_to_host_async()
+        pending.append((i, dv, di))
+        drain(depth)
+        if on_chunk is not None:
+            on_chunk(i)
+    drain(0)
+    wall = time.perf_counter() - t0
+
+    vals, idx = vals[:, :N_s], idx[:, :N_s]
+    stats = OffloadStats(
+        rows=N_s, chunks=n_chunks, chunk=chunk, prefetch_depth=depth,
+        devices=len(devices),
+        host_resident_bytes=h_s_host.nbytes + vals.nbytes + idx.nbytes,
+        bytes_streamed=ring.puts * B * chunk * C * h_s_host.itemsize,
+        ring_misses=ring.misses, ring_evictions=ring.evictions,
+        wall_s=round(wall, 3))
+    return vals, idx, stats
+
+
+# ---------------------------------------------------------------------------
+# CLI: the offloaded-corpus scale driver (scale_bench's offload leg)
+# ---------------------------------------------------------------------------
+
+
+def _synthetic_corpus(rows, dim, seed, batch=1 << 20):
+    """Host-side synthetic ψ₁ embedding table, built in bounded pieces
+    (a 2^23 x C normal draw in one call would transiently double the
+    table)."""
+    rng = np.random.RandomState(seed)
+    out = np.empty((1, rows, dim), np.float32)
+    for start in range(0, rows, batch):
+        n = min(batch, rows - start)
+        out[0, start:start + n] = rng.randn(n, dim).astype(np.float32)
+    return out
+
+
+def main(argv=None):
+    import argparse
+    import json
+    import sys
+
+    parser = argparse.ArgumentParser(
+        prog='python -m dgmc_tpu.ops.offload',
+        description='Offloaded-corpus shortlist driver: host-RAM ψ₁ '
+                    'table, N-deep device prefetch ring, chunk-streamed '
+                    'top-k across every device — the ≥2^23-row '
+                    'SCALE_r08 offload leg.')
+    parser.add_argument('--rows', type=int, default=1 << 23,
+                        help='corpus rows (source entities)')
+    parser.add_argument('--targets', type=int, default=1 << 17)
+    parser.add_argument('--dim', type=int, default=16)
+    parser.add_argument('--k', type=int, default=10)
+    parser.add_argument('--chunk', type=int, default=1 << 15)
+    parser.add_argument('--block', type=int, default=8192)
+    parser.add_argument('--prefetch-depth', '--prefetch_depth',
+                        dest='prefetch_depth', type=int,
+                        default=DEFAULT_PREFETCH_DEPTH)
+    parser.add_argument('--seed', type=int, default=8)
+    parser.add_argument('--verify-rows', dest='verify_rows', type=int,
+                        default=1 << 12,
+                        help='leading corpus rows re-shortlisted '
+                             'through the fully device-resident '
+                             'streamed path and compared exactly '
+                             '(0 = skip)')
+    from dgmc_tpu.obs import add_obs_flag
+    add_obs_flag(parser)
+    args = parser.parse_args(argv)
+
+    import jax
+
+    from dgmc_tpu.obs import RunObserver
+    from dgmc_tpu.obs.memory import compiled_memory
+    from dgmc_tpu.ops.topk import _chunked_topk, _tile_sort, streamed_topk
+
+    obs = RunObserver(args.obs_dir,
+                      watchdog_deadline_s=args.watchdog_deadline,
+                      obs_port=args.obs_port)
+    devices = jax.local_devices()
+    rng = np.random.RandomState(args.seed + 1)
+    corpus = _synthetic_corpus(args.rows, args.dim, args.seed)
+    h_t = rng.randn(1, args.targets, args.dim).astype(np.float32)
+
+    # Static per-device memory evidence: the per-chunk search executable
+    # is the ONLY device program this driver runs — its memory_analysis
+    # bound IS the per-device static footprint (the corpus never lands).
+    probe = np.zeros((1, args.chunk, args.dim), np.float32)
+    lowered = jax.jit(
+        lambda a, b: _chunked_topk(a, b, args.k, None, args.block, True,
+                                   False, _tile_sort())).lower(probe, h_t)
+    mem = compiled_memory(lowered.compile()) or {}
+    if mem:
+        obs.log(0, event='aot_memory_offload_chunk', **mem)
+        print(f'# per-chunk executable static memory: '
+              f'{mem["total_bytes"] / 2**30:.3f} GiB per device',
+              file=sys.stderr, flush=True)
+
+    t0 = time.time()
+    stepper = {'cm': None}
+
+    def chunk_step(i):
+        # One observer step per chunk: step p50 over chunks is the
+        # ring's sustained service time.
+        if stepper['cm'] is not None:
+            stepper['cm'].__exit__(None, None, None)
+        stepper['cm'] = obs.step()
+        stepper['cm'].__enter__()
+
+    chunk_step(-1)
+    vals, idx, stats = offloaded_streamed_topk(
+        corpus, h_t, args.k, args.chunk, block=args.block,
+        depth=args.prefetch_depth, devices=devices, on_chunk=chunk_step)
+    if stepper['cm'] is not None:
+        stepper['cm'].__exit__(None, None, None)
+    wall = time.time() - t0
+
+    verified = None
+    if args.verify_rows:
+        n = min(args.verify_rows, args.rows)
+        dv, di = streamed_topk(
+            np.ascontiguousarray(corpus[:, :n]), h_t, args.k, args.chunk,
+            block=args.block, pallas=False, return_values=True)
+        verified = bool(np.array_equal(np.asarray(di), idx[:, :n])
+                        and np.array_equal(np.asarray(dv), vals[:, :n]))
+
+    rec = {
+        'metric': 'offloaded_shortlist',
+        'rows': args.rows, 'targets': args.targets, 'dim': args.dim,
+        'k': args.k, 'chunk': args.chunk, 'block': args.block,
+        'devices': len(devices),
+        'wall_s': round(wall, 1),
+        'rows_per_sec': round(args.rows / max(stats.wall_s, 1e-9), 1),
+        'offload': stats.to_json(),
+        'per_device_static_bytes': mem or None,
+        'verified_rows': None if verified is None else
+        min(args.verify_rows, args.rows),
+        'verified_equal': verified,
+    }
+    obs.log(stats.chunks, event='offload_summary',
+            offload_equal=None if verified is None else float(verified),
+            host_resident_bytes=stats.host_resident_bytes,
+            prefetch_depth=stats.prefetch_depth,
+            ring_misses=stats.ring_misses)
+    obs.snapshot_memory('offload')
+    obs.close()
+    print(json.dumps(rec))
+    return 0 if (verified is not False) else 1
+
+
+if __name__ == '__main__':
+    import sys
+    sys.exit(main())

@@ -287,6 +287,15 @@ def streamed_topk(h_s, h_t, k, chunk, t_mask=None, block=DEFAULT_BLOCK,
     Same dispatch contract as :func:`chunked_topk`: the auto Pallas
     decision resolves here (un-jitted) and streams chunk-by-chunk
     through the kernel when taken.
+
+    The chunk loop is **double-buffered**: the scan carry holds the
+    chunk being scored while the body issues the NEXT chunk's
+    source-row fetch, so iteration ``k+1``'s gather depends only on the
+    loop counter — never on iteration ``k``'s compute — and the fetch
+    hides behind the per-tile top-k instead of serializing ahead of it
+    (ROADMAP item 4; SCH403's single-buffered shape). Two chunk slots
+    live instead of one — ``O(2 x chunk x C)`` — and results stay
+    bit-identical: the same chunks are scored in the same order.
     """
     pallas = _resolve_dispatch(pallas, k, dispatch_reason)
     sort_tiles = _tile_sort()
@@ -313,11 +322,25 @@ def _streamed_topk(h_s, h_t, k, t_mask, chunk, block, return_values,
     n_chunks = h_s.shape[1] // chunk
     chunks = h_s.reshape(B, n_chunks, chunk, C).transpose(1, 0, 2, 3)
 
-    def body(_, h_chunk):
-        return None, _chunked_topk(h_chunk, h_t, k, t_mask, block, True,
-                                   pallas, sort_tiles)
+    # Double-buffered chunk pipeline: the carry holds the PREFETCHED
+    # chunk k, and the body (1) issues chunk k+1's fetch — a
+    # dynamic-slice off the loop counter alone, independent of this
+    # iteration's compute — then (2) scores the carried chunk. The
+    # fetch is therefore never on the body's critical path (the serial
+    # form chained slice -> einsum -> merge, which is exactly the
+    # SCH403 single-buffered shape), so a scheduler can run it under
+    # the per-tile top-k. The final iteration's fetch is clamped to the
+    # last chunk — one discarded re-fetch instead of a ragged epilogue.
+    def body(cur, i):
+        nxt = jax.lax.dynamic_index_in_dim(
+            chunks, jnp.minimum(i + 1, n_chunks - 1), axis=0,
+            keepdims=False)
+        out = _chunked_topk(cur, h_t, k, t_mask, block, True, pallas,
+                            sort_tiles)
+        return nxt, out
 
-    _, (vals, idx) = jax.lax.scan(body, None, chunks)
+    _, (vals, idx) = jax.lax.scan(body, chunks[0],
+                                  jnp.arange(n_chunks, dtype=jnp.int32))
     # [n_chunks, B, chunk, k] -> [B, N_s, k]
     merge = lambda a: a.transpose(1, 0, 2, 3).reshape(  # noqa: E731
         B, n_chunks * chunk, k)[:, :N_s]

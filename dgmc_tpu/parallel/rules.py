@@ -149,8 +149,13 @@ class PartitionRules:
             (``P(DATA_AXIS)`` for data parallelism, ``P()``/``None``
             for a replicated single giant pair).
         activations: named activation rules — ``'corr'``, ``'topk'``,
-            ``'psi2'`` (module docstring). Missing names mean "no
-            constraint" (``'topk'`` falls back to ``'corr'``).
+            ``'psi2'`` (module docstring), plus the embedding-table
+            rules ``'psi1'`` (source ψ₁ table ``h_s [B, N_s, C]``) and
+            ``'corpus'`` (target ψ₁ table ``h_t [B, N_t, C]`` — shard
+            it only with ``ring_targets``, which consumes it sharded;
+            both are opt-in, see :func:`streamed_rules`). Missing
+            names mean "no constraint" (``'topk'`` falls back to
+            ``'corr'``).
         topk_block: target-axis tile for the blockwise candidate
             search, threaded to every consumer in place of per-callsite
             literals.
@@ -158,12 +163,24 @@ class PartitionRules:
             rows in chunks of this many (``ops/topk.streamed_topk`` /
             the shard-local scan inside
             :func:`~dgmc_tpu.parallel.topk.corr_sharded_topk`).
+        ring_targets: rotate TARGET shards device-to-device during the
+            sharded candidate search
+            (:func:`~dgmc_tpu.parallel.topk.corr_sharded_topk`
+            ``ring=True``): per-device ``h_t`` memory drops to
+            ``O(N_t / devices)`` and the shard-boundary
+            ``collective-permute`` is issued a rotation ahead so it
+            overlaps the per-tile top-k instead of serializing it —
+            the pipelined form SCH402's overlap budget pins. Bit-
+            identical results; falls back to the replicated-target
+            path when the row axis cannot ring (single shard, tuple
+            axis, or ``k`` wider than a target shard).
     """
     state: Tuple[Tuple[str, P], ...] = (('.*', P()),)
     batch: Optional[P] = None
     activations: Mapping[str, P] = dataclasses.field(default_factory=dict)
     topk_block: int = DEFAULT_TOPK_BLOCK
     stream_chunk: Optional[int] = None
+    ring_targets: bool = False
 
     # -- pytree placement ---------------------------------------------------
 
@@ -201,7 +218,10 @@ class PartitionRules:
             corr_sharding=self.activation_sharding('corr', mesh),
             topk_sharding=self.activation_sharding('topk', mesh),
             psi2_sharding=self.activation_sharding('psi2', mesh),
+            psi1_sharding=self.activation_sharding('psi1', mesh),
+            corpus_sharding=self.activation_sharding('corpus', mesh),
             stream_chunk=self.stream_chunk,
+            ring_targets=self.ring_targets,
             topk_block=self.topk_block)
 
 
@@ -236,8 +256,20 @@ def streamed_rules(row_axis: str = DATA_AXIS,
     following it, and the candidate search streaming ``stream_chunk``
     source rows at a time so peak memory is
     ``O(chunk × block)`` + ``O(N_s/devices × K)`` per device — never
-    ``O(N_s × N_t)`` anywhere."""
+    ``O(N_s × N_t)`` anywhere. Targets RING over the same axis by
+    default (``ring_targets=True``): per-device ``h_t`` drops to one
+    shard and the boundary permutes pipeline against the per-tile
+    top-k (pass ``ring_targets=False`` for the replicated-target
+    layout)."""
     row = P(None, row_axis)
+    kw.setdefault('ring_targets', True)
+    # The 'psi1'/'corpus' embedding-table rules (shard ψ₁'s own compute
+    # with the rows/ring) exist but are deliberately NOT defaults: on
+    # this container's CPU GSPMD the constrained step measured 8.36 s
+    # vs 7.37 s replicated at 2^17 (the edge scatters force comm
+    # without dropping the replicated compute) — the on-silicon
+    # re-measure is recorded in benchmarks/DISPATCH_DEFAULTS.md. Pass
+    # activations={'psi1': ..., 'corpus': ...} explicitly to opt in.
     return PartitionRules(
         state=(('.*', P()),),
         batch=None,

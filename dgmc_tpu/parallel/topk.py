@@ -60,8 +60,24 @@ def sharded_topk_rows(mesh, h_s, h_t, k, t_mask=None,
     return inner(h_s, h_t, t_mask)
 
 
+def _merge_candidates(vals, idx, tile_vals, tile_idx, k):
+    """Merge two candidate sets into the running top-k with the DENSE
+    tie order: candidates are sorted by global target index before the
+    ``top_k``, so equal values always resolve toward the lowest global
+    index — whatever order the ring delivered the shards in. (The
+    chunk-scan merge can rely on carry-before-tile concatenation
+    because its tiles arrive in index order; ring shards do not.)"""
+    all_vals = jnp.concatenate([vals, tile_vals], axis=-1)
+    all_idx = jnp.concatenate([idx, tile_idx], axis=-1)
+    order = jnp.argsort(all_idx, axis=-1)
+    all_vals = jnp.take_along_axis(all_vals, order, axis=-1)
+    all_idx = jnp.take_along_axis(all_idx, order, axis=-1)
+    new_vals, pos = jax.lax.top_k(all_vals, k)
+    return new_vals, jnp.take_along_axis(all_idx, pos, axis=-1)
+
+
 def corr_sharded_topk(sharding, h_s, h_t, k, t_mask,
-                      block=DEFAULT_TOPK_BLOCK, chunk=None):
+                      block=DEFAULT_TOPK_BLOCK, chunk=None, ring=False):
     """Top-k under a correspondence sharding, INSIDE a GSPMD program.
 
     ``sharding`` is the ``corr_sharding`` NamedSharding for
@@ -81,6 +97,24 @@ def corr_sharded_topk(sharding, h_s, h_t, k, t_mask,
     device's ``N_s/n_dev`` row block is too many rows to score against
     every target at once — peak per-device search memory becomes
     ``O(chunk × block)``.
+
+    ``ring`` additionally shards the TARGET set over the same row axis
+    and rotates the shards device-to-device: each device starts with its
+    own ``N_t/n_dev`` target block and, per rotation, (1) issues the
+    shard-boundary ``collective-permute`` handing its CURRENT block to
+    the next device — a transfer that depends only on the loop carry,
+    never on this rotation's compute — then (2) runs the (double-
+    buffered) chunk-streamed search of its rows against the block it
+    holds, merging candidates with the dense tie order
+    (:func:`_merge_candidates`). The permute therefore overlaps the
+    per-tile top-k instead of serializing against it (the SCH402-gated
+    overlap win of ROADMAP item 4), and per-device ``h_t`` memory drops
+    from ``O(N_t)`` to ``O(N_t/n_dev)``. Results stay bit-identical to
+    :func:`~dgmc_tpu.ops.topk.dense_topk` (ties included). Ring needs a
+    single concrete mesh axis on the rows, ``N_t`` padded up to the
+    ring size (masked columns — discarded work), and ``k <=
+    N_t/n_dev`` (a shard must be able to hold a full candidate set);
+    otherwise the replicated-target path runs unchanged.
     """
     mesh, spec = sharding.mesh, sharding.spec
     b_ax = spec[0] if len(spec) > 0 else None
@@ -138,6 +172,25 @@ def corr_sharded_topk(sharding, h_s, h_t, k, t_mask,
                                    _streamed_topk, _tile_sort)
     sort_tiles = _tile_sort()
 
+    # Ring eligibility: one concrete mesh axis on the rows (ppermute
+    # needs a named axis), more than one shard, and a shard wide enough
+    # to hold k candidates. Anything else runs the replicated path —
+    # same results, no boundary collectives to overlap.
+    n_ring = ax_size(s_ax) if isinstance(s_ax, str) else 1
+    if ring and n_ring > 1:
+        N_t = h_t.shape[1]
+        pad_t = (-N_t) % n_ring
+        shard_cols = (N_t + pad_t) // n_ring
+        if k <= shard_cols:
+            if pad_t:
+                h_t = jnp.pad(h_t, ((0, 0), (0, pad_t), (0, 0)))
+                t_mask = jnp.pad(t_mask, ((0, 0), (0, pad_t)))
+            out = _ring_topk(mesh, b_ax, s_ax, n_ring, shard_cols,
+                             h_s, h_t, t_mask, k, block,
+                             int(chunk) if chunk else 0, use_kernel,
+                             sort_tiles)
+            return out[:, :N_s] if pad_s else out
+
     @functools.partial(
         shard_map, mesh=mesh,
         in_specs=(P(b_ax, s_ax, None), P(b_ax, None, None), P(b_ax, None)),
@@ -151,6 +204,74 @@ def corr_sharded_topk(sharding, h_s, h_t, k, t_mask,
 
     out = _ad_opaque(local, h_s, h_t, t_mask)
     return out[:, :N_s] if pad_s else out
+
+
+def _ring_topk(mesh, b_ax, s_ax, n_ring, shard_cols, h_s, h_t, t_mask,
+               k, block, chunk, use_kernel, sort_tiles):
+    """The rotating-shard search behind ``corr_sharded_topk(ring=True)``.
+
+    Shard-local loop, one iteration per target shard: the body FIRST
+    issues the boundary ``ppermute`` handing the currently-held target
+    block (and its mask) to the next device — data-dependent only on
+    the loop carry — and THEN scores its rows against that same block
+    through the double-buffered chunk scan, so the transfer and the
+    per-tile top-k share no dependency edge and the schedule model (and
+    a real TPU scheduler) can run them concurrently. After ``n_ring``
+    rotations every device has scored every target column exactly once.
+
+    Tie-exactness bookkeeping: after ``j`` rotations device ``d`` holds
+    shard ``(d - j) mod n_ring``, so local candidate positions lift to
+    global columns at ``shard_id * shard_cols``; positions beyond the
+    shard's real width (the chunk scan's own block padding — value
+    ``finfo.min``, never a winner against live columns) are remapped
+    PAST the padded target range so they can never steal an equal-value
+    tie from a real masked column in another shard.
+    """
+    from dgmc_tpu.ops.topk import _ad_opaque, _chunked_topk, _streamed_topk
+    n_pad_total = n_ring * shard_cols
+    perm = [(i, (i + 1) % n_ring) for i in range(n_ring)]
+
+    @functools.partial(
+        shard_map, mesh=mesh,
+        in_specs=(P(b_ax, s_ax, None), P(b_ax, s_ax, None),
+                  P(b_ax, s_ax)),
+        out_specs=P(b_ax, s_ax, None))
+    def local(hs, ht, tm):
+        my = jax.lax.axis_index(s_ax)
+
+        def body(carry, j):
+            vals, idx, buf_t, buf_m = carry
+            # Boundary permute FIRST: depends on the carry alone, so
+            # the search below can hide it.
+            nxt_t = jax.lax.ppermute(buf_t, s_ax, perm)
+            nxt_m = jax.lax.ppermute(buf_m, s_ax, perm)
+            if chunk:
+                tv, tp = _streamed_topk(hs, buf_t, k, buf_m, chunk,
+                                        block, True, use_kernel,
+                                        sort_tiles)
+            else:
+                tv, tp = _chunked_topk(hs, buf_t, k, buf_m, block, True,
+                                       use_kernel, sort_tiles)
+            shard_id = (my - j) % n_ring
+            ti = jnp.where(tp < shard_cols,
+                           shard_id * shard_cols + tp,
+                           n_pad_total + tp)
+            vals, idx = _merge_candidates(vals, idx, tv, ti, k)
+            return (vals, idx, nxt_t, nxt_m), None
+
+        init_vals = jnp.full(hs.shape[:2] + (k,), -jnp.inf, hs.dtype)
+        init_idx = jnp.zeros(hs.shape[:2] + (k,), jnp.int32)
+        from dgmc_tpu.ops.pallas.dispatch import vma_of
+        vma = tuple(vma_of(hs))
+        if vma:
+            init_vals = jax.lax.pcast(init_vals, vma, to='varying')
+            init_idx = jax.lax.pcast(init_idx, vma, to='varying')
+        (vals, idx, _, _), _ = jax.lax.scan(
+            body, (init_vals, init_idx, ht, tm),
+            jnp.arange(n_ring, dtype=jnp.int32))
+        return idx
+
+    return _ad_opaque(local, h_s, h_t, t_mask)
 
 
 def sharded_topk_cols(mesh, h_s, h_t, k, t_mask=None,

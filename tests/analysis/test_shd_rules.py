@@ -174,6 +174,52 @@ def test_shd303_single_permute_is_clean():
     assert analyze_sharded_hlo(one, ShardedContext(specimen='fix')) == []
 
 
+def _independent_permutes(fixture):
+    """Decouple the fixture's two permutes: cp2 reads the carried state
+    directly instead of cp1's result — two INDEPENDENT per-iteration
+    transfers (the ring pattern: target shard + its mask), no
+    composition."""
+    return fixture.replace(
+        '%cp2 = f32[4,8]{1,0} collective-permute(f32[4,8]{1,0} %neg)',
+        '%cp2 = f32[4,8]{1,0} collective-permute(f32[4,8]{1,0} %s)')
+
+
+def test_shd303_ring_rotation_permutes_are_exempt():
+    """The pipelined streamed-S ring re-issues INDEPENDENT boundary
+    permutes (the rotating target shard and its mask) every iteration —
+    no permute feeds another, so the layout never bounces and SHD303
+    must stay silent, at any ring size."""
+    ring = _independent_permutes(RESHARD_CHURN).replace(
+        'source_target_pairs={{0,1},{1,0}}',
+        'source_target_pairs={{0,1},{1,2},{2,3},{3,0}}').replace(
+        'source_target_pairs={{1,0},{0,1}}',
+        'source_target_pairs={{0,1},{1,2},{2,3},{3,0}}')
+    assert analyze_sharded_hlo(ring, ShardedContext(specimen='fix')) == []
+
+
+def test_shd303_two_device_ring_is_exempt_too():
+    """A 2-shard ring's rotation {(0,1),(1,0)} is its own inverse —
+    indistinguishable from a swap by source_target_pairs alone — so
+    the exemption must key on COMPOSITION, not on the permutation:
+    independent self-inverse permutes are the 2-device ring, clean."""
+    ring2 = _independent_permutes(RESHARD_CHURN)
+    assert analyze_sharded_hlo(ring2,
+                               ShardedContext(specimen='fix')) == []
+
+
+def test_shd303_composed_rotations_still_fire():
+    """Forward-rotation source_target_pairs do NOT launder a bounce: a
+    permute FED BY another permute (through the body's dataflow) is the
+    round trip the rule exists for, whatever the mapping spells."""
+    bounced = RESHARD_CHURN.replace(
+        'source_target_pairs={{0,1},{1,0}}',
+        'source_target_pairs={{0,1},{1,2},{2,3},{3,0}}').replace(
+        'source_target_pairs={{1,0},{0,1}}',
+        'source_target_pairs={{1,0},{2,1},{3,2},{0,3}}')
+    findings = analyze_sharded_hlo(bounced, ShardedContext(specimen='fix'))
+    assert _rules(findings) == ['SHD303']
+
+
 # --- SHD304: communication budget ---------------------------------------
 
 BIG_COMM = (

@@ -399,3 +399,43 @@ def test_missing_note_lists_available_keys(tmp_path, capsys):
     assert 'missing from candidate; candidate has:' in out
     # The candidate did keep compile counts + memory: both listed.
     assert 'compile_events' in out and 'peak_memory_bytes' in out
+
+
+def _write_metrics(run_dir, record):
+    with open(os.path.join(run_dir, 'metrics.jsonl'), 'w') as f:
+        f.write(json.dumps({'step': 1, 'loss': 9.9}) + '\n')
+        f.write(json.dumps(record) + '\n')
+
+
+def test_require_equal_passes_on_exact_match(tmp_path):
+    """The streamed-vs-offloaded layout-equivalence gate: identical
+    final logged metrics pass at delta 0."""
+    a = write_run(tmp_path, 'a')
+    b = write_run(tmp_path, 'b')
+    final = {'step': 4, 'loss': 1.25, 'hits1': 0.5, 'hits10': 0.75}
+    _write_metrics(a, final)
+    _write_metrics(b, dict(final, offload_equal=1.0))
+    assert diff_mod.main([a, b, '--require-equal',
+                          'loss,hits1,hits10']) == 0
+
+
+def test_require_equal_fails_on_any_drift(tmp_path, capsys):
+    a = write_run(tmp_path, 'a')
+    b = write_run(tmp_path, 'b')
+    _write_metrics(a, {'step': 4, 'loss': 1.25, 'hits1': 0.5})
+    _write_metrics(b, {'step': 4, 'loss': 1.2500001, 'hits1': 0.5})
+    assert diff_mod.main([a, b, '--require-equal', 'loss,hits1']) == 1
+    out = capsys.readouterr().out
+    assert 'equal:loss' in out
+
+
+def test_require_equal_missing_key_fails_either_side(tmp_path, capsys):
+    """A key either run failed to log fails — a gate that exits 0
+    because the numbers vanished is no gate."""
+    a = write_run(tmp_path, 'a')
+    b = write_run(tmp_path, 'b')
+    _write_metrics(a, {'step': 4, 'loss': 1.25, 'hits1': 0.5})
+    _write_metrics(b, {'step': 4, 'loss': 1.25})
+    assert diff_mod.main([a, b, '--require-equal', 'loss,hits1']) == 1
+    out = capsys.readouterr().out
+    assert 'equal:hits1' in out

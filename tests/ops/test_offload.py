@@ -1,0 +1,110 @@
+"""Host-RAM offload tier: prefetch-ring semantics + offloaded search
+bit-identity (ops/offload.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dgmc_tpu.ops.offload import (OffloadStats, PrefetchRing,
+                                  offloaded_streamed_topk)
+from dgmc_tpu.ops.topk import streamed_topk
+
+
+def test_ring_prefetches_ahead_and_evicts_behind():
+    """get(i) serves chunk i, keeps exactly the next `depth` chunks in
+    flight, and drops everything behind the cursor — the device-resident
+    window is depth+1 chunks whatever the corpus size."""
+    fetched = []
+
+    def source(i):
+        fetched.append(i)
+        return np.full((2, 2), i, np.float32)
+
+    ring = PrefetchRing(source, depth=2, n_chunks=6)
+    a = ring.get(0)
+    np.testing.assert_array_equal(np.asarray(a), np.zeros((2, 2)))
+    # Cold start: 0 was a miss; 1 and 2 are now in flight.
+    assert fetched == [0, 1, 2]
+    assert ring.misses == 1
+    assert ring.in_flight == 3
+
+    ring.get(1)                # hit; window tops up to {1,2,3}; 0 out
+    assert fetched == [0, 1, 2, 3]
+    assert ring.misses == 1
+    assert ring.evictions == 1
+    assert sorted(ring._slots) == [1, 2, 3]
+
+    ring.get(4)                      # skip ahead: 4 was never prefetched
+    assert ring.misses == 2
+    assert sorted(ring._slots) == [4, 5]   # 5 is the last chunk
+    assert ring.in_flight == 2
+
+    ring.get(5)
+    assert sorted(ring._slots) == [5]
+    # Each chunk was fetched exactly once: no refetch churn.
+    assert sorted(fetched) == list(range(6))
+
+
+def test_ring_round_robins_devices():
+    """Slot i lands on devices[i % n] — the ring is also the
+    data-parallel dispatch (rows are independent)."""
+    devs = jax.devices()
+    table = np.arange(8, dtype=np.float32).reshape(8, 1)
+    ring = PrefetchRing(table, depth=3, devices=devs)
+    for i in range(8):
+        chunk = ring.get(i)
+        assert chunk.devices() == {devs[i % len(devs)]}
+
+
+def test_ring_array_source_len_inferred():
+    table = np.zeros((5, 3), np.float32)
+    ring = PrefetchRing(table, depth=1)
+    assert ring.n_chunks == 5
+    ring.get(0)
+    assert ring.in_flight == 2
+
+
+def test_offloaded_matches_streamed_bit_identical():
+    """The offloaded sweep returns the exact device-path result —
+    values, indices, tie order, ragged tail included — with the stats
+    account matching what actually moved."""
+    rng = np.random.RandomState(5)
+    base = rng.randn(1, 16, 8).astype(np.float32)
+    h_t = np.concatenate([base, base], axis=1)      # forced value ties
+    h_s = rng.randn(1, 37, 8).astype(np.float32)    # ragged: 37 % 8 != 0
+    tm = rng.rand(1, 32) > 0.4
+
+    dv, di = streamed_topk(h_s, jnp.asarray(h_t), 5, 8,
+                           t_mask=jnp.asarray(tm), block=8, pallas=False,
+                           return_values=True)
+    ov, oi, stats = offloaded_streamed_topk(
+        h_s, h_t, 5, 8, t_mask=tm, block=8, depth=2)
+    np.testing.assert_array_equal(oi, np.asarray(di))
+    np.testing.assert_array_equal(ov, np.asarray(dv))
+
+    assert isinstance(stats, OffloadStats)
+    assert stats.rows == 37
+    assert stats.chunks == 5                        # ceil(37 / 8)
+    assert stats.ring_misses == 1                   # cold start only
+    assert stats.host_resident_bytes == (
+        h_s.nbytes + ov.nbytes + oi.nbytes)
+    # Every chunk moved host->device exactly once (padded tail counts
+    # a full chunk).
+    assert stats.bytes_streamed == 5 * 8 * 8 * 4
+    d = stats.to_json()
+    assert d['prefetch_depth'] == 2 and d['devices'] >= 1
+
+
+def test_offloaded_multi_device_round_robin_identical():
+    """Round-robin dispatch over several devices must not change a bit
+    of the result (row independence)."""
+    rng = np.random.RandomState(6)
+    h_s = rng.randn(2, 24, 4).astype(np.float32)
+    h_t = rng.randn(2, 16, 4).astype(np.float32)
+    dv, di = streamed_topk(h_s, jnp.asarray(h_t), 3, 4, block=4,
+                           pallas=False, return_values=True)
+    ov, oi, stats = offloaded_streamed_topk(
+        h_s, h_t, 3, 4, block=4, depth=3, devices=jax.devices())
+    np.testing.assert_array_equal(oi, np.asarray(di))
+    np.testing.assert_array_equal(ov, np.asarray(dv))
+    assert stats.devices == len(jax.devices())

@@ -106,3 +106,116 @@ def test_tile_extractor_forms_identical():
     np.testing.assert_array_equal(a, s)
     np.testing.assert_array_equal(
         a, np.asarray(dense_topk(h_s, h_t, 6, t_mask=tm)))
+
+
+def test_double_buffered_stream_matches_serial_reference():
+    """The double-buffered chunk pipeline (prefetched-carry scan) is
+    bit-identical to the retired single-buffered formulation — scan
+    straight over the chunk stack — on ties, masked targets, a ragged
+    final chunk, and BOTH per-tile extractor forms. The pipeline only
+    reorders data movement; if it ever touches values or tie order,
+    this is the test that says so."""
+    import functools
+
+    import dgmc_tpu.ops.topk as T
+
+    def serial_streamed(h_s, h_t, k, chunk, t_mask, block, sort_tiles):
+        # The pre-pipeline loop, verbatim semantics: fetch chunk k,
+        # THEN score chunk k — the xs slice feeds the compute directly.
+        B, N_s, C = h_s.shape
+        pad = (-N_s) % chunk
+        if pad:
+            h_s = jnp.pad(h_s, ((0, 0), (0, pad), (0, 0)))
+        n_chunks = h_s.shape[1] // chunk
+        chunks = h_s.reshape(B, n_chunks, chunk, C).transpose(1, 0, 2, 3)
+
+        def body(_, h_chunk):
+            return None, T._chunked_topk(h_chunk, h_t, k, t_mask, block,
+                                         True, False, sort_tiles)
+
+        _, (vals, idx) = jax.lax.scan(body, None, chunks)
+        merge = functools.partial(
+            lambda a: a.transpose(1, 0, 2, 3).reshape(
+                B, n_chunks * chunk, k)[:, :N_s])
+        return merge(vals), merge(idx)
+
+    rng = np.random.RandomState(7)
+    base = rng.randn(1, 16, 8).astype(np.float32)
+    h_t = jnp.asarray(np.concatenate([base, base], axis=1))  # forced ties
+    # ragged final chunk: 37 % 8 != 0
+    h_s = jnp.asarray(rng.randn(1, 37, 8).astype(np.float32))
+    tm = jnp.asarray(rng.rand(1, 32) > 0.4)
+    for sort_tiles in (True, False):
+        sv, si = serial_streamed(h_s, h_t, 5, 8, tm, 8, sort_tiles)
+        dv, di = T._streamed_topk(h_s, h_t, 5, tm, 8, 8, True, False,
+                                  sort_tiles)
+        np.testing.assert_array_equal(np.asarray(si), np.asarray(di))
+        np.testing.assert_array_equal(np.asarray(sv), np.asarray(dv))
+
+
+def test_double_buffered_carry_holds_prefetched_chunk():
+    """The pipeline's structural claim, pinned at the jaxpr level: the
+    chunk scan CARRIES a ``[B, chunk, C]`` buffer (the prefetched
+    slot), and the per-iteration fetch (``dynamic_slice`` off the loop
+    counter) produces ONLY that carry — it never feeds this
+    iteration's compute, which consumes the slot fetched one
+    iteration earlier. The serial form had no chunk-shaped carry at
+    all (its xs slice fed the compute directly — the SCH403 shape the
+    rewrite retires; the golden HLO fixtures in
+    tests/analysis/test_sched_rules.py pin the rule itself, since a
+    fused CPU build hides the slice from compiled-text checks)."""
+    import dgmc_tpu.ops.topk as T
+    B, chunk, C = 1, 16, 8
+    h_s = jnp.zeros((B, 64, C), jnp.float32)
+    h_t = jnp.zeros((B, 32, C), jnp.float32)
+    jaxpr = jax.make_jaxpr(
+        lambda a, b: T._streamed_topk(a, b, 4, None, chunk, 8, False,
+                                      False, True))(h_s, h_t)
+
+    def find_scans(jpr, out):
+        for eqn in jpr.eqns:
+            if eqn.primitive.name == 'scan':
+                out.append(eqn)
+            for v in eqn.params.values():
+                if hasattr(v, 'jaxpr'):
+                    find_scans(v.jaxpr, out)
+        return out
+
+    scans = find_scans(jaxpr.jaxpr, [])
+
+    def carry_vars(e):
+        start = e.params.get('num_consts', 0)
+        return e.invars[start:start + e.params['num_carry']]
+
+    chunk_scans = [
+        e for e in scans
+        if any(getattr(v.aval, 'shape', None) == (B, chunk, C)
+               for v in carry_vars(e))]
+    carries = [[getattr(v.aval, 'shape', None) for v in carry_vars(e)]
+               for e in scans]
+    assert chunk_scans, (
+        f'no scan carries the [B, chunk, C] prefetch slot: {carries}')
+    body = chunk_scans[0].params['jaxpr'].jaxpr
+    # The fetch: a dynamic_slice whose descendants inside the body are
+    # pure bookkeeping ending at the carry output — NEVER this
+    # iteration's compute (the search call / einsum consume the slot
+    # fetched one iteration earlier, via the carry input).
+    ds = [e for e in body.eqns if e.primitive.name == 'dynamic_slice']
+    assert ds, [e.primitive.name for e in body.eqns]
+    fetched = set()
+    for e in ds:
+        fetched.update(id(v) for v in e.outvars)
+    compute_consumers = []
+    for e in body.eqns:
+        if any(id(v) in fetched for v in e.invars):
+            if e.primitive.name in ('squeeze', 'reshape', 'broadcast_in_dim'):
+                fetched.update(id(v) for v in e.outvars)
+            else:
+                compute_consumers.append(e.primitive.name)
+    assert compute_consumers == [], (
+        f'prefetched chunk consumed by in-body compute: '
+        f'{compute_consumers}')
+    # ... and the carry slot written back IS fetch-derived.
+    carry_out = body.outvars[:chunk_scans[0].params['num_carry']]
+    assert any(id(v) in fetched for v in carry_out), (
+        'carry slot is not the fetched chunk')
