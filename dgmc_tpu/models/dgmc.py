@@ -273,7 +273,8 @@ class DGMC(nn.Module):
 
     @nn.compact
     def __call__(self, graph_s, graph_t, y=None, y_mask=None, train=False,
-                 num_steps=None, detach=None, pair_offset=0):
+                 num_steps=None, detach=None, pair_offset=0, h_t=None,
+                 S_idx=None, h_t_cand=None):
         """Compute initial and refined correspondences ``(S_0, S_L)``.
 
         Args:
@@ -295,9 +296,44 @@ class DGMC(nn.Module):
                 offsets ``i..i+N-1`` with the same stream keys — the
                 ``--pairs-per-step`` equivalence contract
                 (tests/models/test_pairs_per_step.py).
+            h_t: optional precomputed ψ₁ target embedding table
+                ``[B, N_t, C]`` — the serving corpus cache
+                (``dgmc_tpu/serve/``). When given, ψ₁ runs on the source
+                side only; ``graph_t.x`` is never read, so a serving
+                process can ship a dummy feature array and keep the raw
+                corpus features off the device entirely.
+            S_idx: optional precomputed candidate shortlist
+                ``[B, N_s, K]`` (sparse variant only, ``train=False``) —
+                skips the in-graph candidate search. The host-driven
+                offloaded corpus search
+                (:func:`~dgmc_tpu.ops.offload.offloaded_corpus_topk`)
+                produces these bit-identically to the in-graph paths.
+            h_t_cand: optional pre-gathered candidate embedding rows
+                ``[B, N_s, K, C]`` (``h_t[b, S_idx[b]]``), for serving
+                modes whose full corpus table lives in HOST memory:
+                together with ``S_idx`` it removes the last O(N_t)
+                device operand of the matching stage (ψ₂ still runs on
+                the corpus *graph structure*, which is O(E_t)).
         """
         num_steps = self.num_steps if num_steps is None else num_steps
         detach = self.detach if detach is None else detach
+
+        if S_idx is not None or h_t_cand is not None:
+            if train:
+                raise ValueError(
+                    'precomputed S_idx / h_t_cand are inference-serving '
+                    'arguments: the training path extends the shortlist '
+                    'with negatives and the injected ground truth '
+                    '(train=False required)')
+            if self.k < 1:
+                raise ValueError(
+                    'precomputed S_idx / h_t_cand require the sparse '
+                    'variant (k >= 1); the dense variant has no '
+                    'candidate shortlist')
+            if h_t_cand is not None and S_idx is None:
+                raise ValueError('h_t_cand (pre-gathered candidate rows) '
+                                 'is meaningless without the S_idx it '
+                                 'was gathered at')
 
         if self.stream_chunk is not None and self.k < 1:
             raise ValueError(
@@ -375,6 +411,13 @@ class DGMC(nn.Module):
                 f'source/target feature widths differ '
                 f'({graph_s.x.shape[-1]} vs {graph_t.x.shape[-1]})')
         merge_2 = merges(self.psi_2, 'psi_2')
+        if (merge_1 or merge_2) and (h_t is not None
+                                     or h_t_cand is not None):
+            raise ValueError(
+                'precomputed h_t / h_t_cand are incompatible with '
+                'batch_pair union evaluation: the union stacks both '
+                'sides through one backbone call, but a precomputed '
+                'target table means the target side never runs ψ₁')
         pair = UnionPair(graph_s, graph_t) if (merge_1 or merge_2) else None
 
         def run_pair(m, x_s_in, x_t_in, merge):
@@ -388,7 +431,14 @@ class DGMC(nn.Module):
         # name the matching pipeline's phases in profiler traces and
         # lowered HLO metadata — numerics are untouched.
         with jax.named_scope('psi1'):
-            h_s, h_t = run_pair(self.psi_1, graph_s.x, graph_t.x, merge_1)
+            if h_t is None and h_t_cand is None:
+                h_s, h_t = run_pair(self.psi_1, graph_s.x, graph_t.x,
+                                    merge_1)
+            else:
+                # Serving split: the corpus table (or its candidate
+                # rows) comes precomputed — ψ₁ runs on the query side
+                # only. graph_t.x is dead here by design.
+                h_s = run_psi(self.psi_1, graph_s.x, graph_s, train=train)
         # In-graph numerics probes (obs/probes.py). The switch is a Python
         # bool at trace time: disabled (default) traces NOTHING — neither
         # the metric math nor the host callback — so the lowered HLO stays
@@ -398,11 +448,16 @@ class DGMC(nn.Module):
         # the CI non-finite gate on an eval-only NaN).
         probe = _probes.enabled() and train
         if probe:
-            _probes.check_finite('psi1', h_s, h_t, order=0)
+            _probes.check_finite('psi1', h_s,
+                                 *(() if h_t is None else (h_t,)), order=0)
         from dgmc_tpu.models.precision import compute_dtype_of
         dtype = compute_dtype_of(self.dtype)
         if dtype is not None:
-            h_s, h_t = h_s.astype(dtype), h_t.astype(dtype)
+            h_s = h_s.astype(dtype)
+            if h_t is not None:
+                h_t = h_t.astype(dtype)
+            if h_t_cand is not None:
+                h_t_cand = h_t_cand.astype(dtype)
         # Embedding-table layout constraints (streamed million-entity
         # config): h_s follows the row sharding the search consumes, and
         # h_t — the corpus table — follows the ring's shard rotation, so
@@ -410,12 +465,13 @@ class DGMC(nn.Module):
         if self.psi1_sharding is not None:
             h_s = jax.lax.with_sharding_constraint(h_s,
                                                    self.psi1_sharding)
-        if self.corpus_sharding is not None:
+        if self.corpus_sharding is not None and h_t is not None:
             h_t = jax.lax.with_sharding_constraint(h_t,
                                                    self.corpus_sharding)
         if detach:
             h_s = jax.lax.stop_gradient(h_s)
-            h_t = jax.lax.stop_gradient(h_t)
+            if h_t is not None:
+                h_t = jax.lax.stop_gradient(h_t)
 
         s_mask, t_mask = graph_s.node_mask, graph_t.node_mask
         (B, N_s), N_t = s_mask.shape, t_mask.shape[1]
@@ -612,11 +668,25 @@ class DGMC(nn.Module):
         # (parallel/topk.corr_sharded_topk). Ragged row counts are padded
         # inside the embedding; only a ragged batch axis falls back.
         with jax.named_scope('topk'):
-            S_idx = None
+            if S_idx is not None:
+                # Precomputed shortlist (serving offload tier): the
+                # search is skipped wholesale; validity/tie semantics
+                # are the producer's contract
+                # (offloaded_corpus_topk == chunked_topk, bit-exact).
+                if S_idx.shape[-1] != self.k:
+                    raise ValueError(
+                        f'precomputed S_idx carries {S_idx.shape[-1]} '
+                        f'candidates but the model was built with '
+                        f'k={self.k}')
+                S_idx = self._constrain_idx(S_idx.astype(jnp.int32))
+            elif h_t is None:
+                raise ValueError(
+                    'the sparse candidate search needs the full h_t '
+                    'table (or a precomputed S_idx shortlist)')
             idx_sharding = (self.topk_sharding
                             if self.topk_sharding is not None
                             else self.corr_sharding)
-            if idx_sharding is not None:
+            if S_idx is None and idx_sharding is not None:
                 from dgmc_tpu.parallel.topk import corr_sharded_topk
                 S_idx = corr_sharded_topk(idx_sharding, h_s, h_t,
                                           self.k, t_mask,
@@ -716,8 +786,8 @@ class DGMC(nn.Module):
                                       S_idx.reshape(B, N_s * K_))
 
         with jax.named_scope('initial_corr'):
-            h_t_cand = cand_rows(h_t)
-            S_hat = jnp.einsum('bsc,bskc->bsk', h_s, h_t_cand,
+            h_t_rows = h_t_cand if h_t_cand is not None else cand_rows(h_t)
+            S_hat = jnp.einsum('bsc,bskc->bsk', h_s, h_t_rows,
                                preferred_element_type=jnp.float32)
             S_0 = masked_softmax(S_hat, entry_mask) * s_mask[..., None]
         if probe:

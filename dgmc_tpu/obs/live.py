@@ -348,7 +348,7 @@ def histogram_family(name, help_text, hist_snapshot):
 # ---------------------------------------------------------------------------
 
 class TelemetryServer:
-    """Threaded HTTP server for the three endpoints.
+    """Threaded HTTP server for the three endpoints (plus app routes).
 
     Args:
         port: TCP port (0 = pick a free one; the chosen port is in
@@ -361,18 +361,29 @@ class TelemetryServer:
         status_fn: 0-arg callable returning the ``/status`` JSON dict.
         host: bind address (default all interfaces — an external
             prober/scraper is the point of the plane).
+        routes: optional ``{path: handler}`` application endpoints
+            mounted BESIDE the telemetry trio (the serving subsystem's
+            ``/match`` joins ``/healthz``/``/metrics``/``/status`` on
+            one port this way). A handler is called as
+            ``handler(method, body_bytes)`` — GET arrives with
+            ``body_bytes=b''`` — and returns ``(status_code,
+            payload_dict)``; the payload is rendered as JSON. Returning
+            a 4xx/5xx code is the structured-error path (the router's
+            unknown-bucket 400). A handler that *raises* still yields
+            the generic 500, like the telemetry callbacks.
 
     A callback that raises yields a 500 carrying the error text; the
     serving thread itself must survive anything the callbacks do.
     """
 
     def __init__(self, port, health_fn=None, metrics_fn=None,
-                 status_fn=None, host=''):
+                 status_fn=None, host='', routes=None):
         self._requested_port = int(port)
         self._host = host
         self._health_fn = health_fn
         self._metrics_fn = metrics_fn
         self._status_fn = status_fn
+        self._routes = dict(routes or {})
         self._server = None
         self._thread = None
         self.port = None
@@ -400,10 +411,23 @@ class TelemetryServer:
                                                indent=1),
                               'application/json; charset=utf-8')
 
-            def do_GET(self):
+            def _endpoints(self):
+                return (['/healthz', '/metrics', '/status']
+                        + sorted(plane._routes))
+
+            def _dispatch(self, method):
                 path = self.path.split('?', 1)[0].rstrip('/') or '/'
                 try:
-                    if path == '/healthz' and plane._health_fn:
+                    if path in plane._routes:
+                        n = int(self.headers.get('Content-Length') or 0)
+                        body = self.rfile.read(n) if n else b''
+                        code, payload = plane._routes[path](method, body)
+                        self._json(code, payload)
+                    elif method != 'GET':
+                        self._json(405, {
+                            'error': f'{method} not supported on {path}',
+                            'endpoints': self._endpoints()})
+                    elif path == '/healthz' and plane._health_fn:
                         payload = plane._health_fn()
                         code = 200 if payload.get('healthy', True) \
                             else 503
@@ -417,8 +441,7 @@ class TelemetryServer:
                     else:
                         self._json(404, {
                             'error': f'no such endpoint: {path}',
-                            'endpoints': ['/healthz', '/metrics',
-                                          '/status']})
+                            'endpoints': self._endpoints()})
                 except BrokenPipeError:
                     pass      # scraper went away mid-response
                 except Exception as e:
@@ -427,6 +450,12 @@ class TelemetryServer:
                             'error': f'{type(e).__name__}: {e}'})
                     except Exception:
                         pass
+
+            def do_GET(self):
+                self._dispatch('GET')
+
+            def do_POST(self):
+                self._dispatch('POST')
 
         self._server = http.server.ThreadingHTTPServer(
             (self._host, self._requested_port), Handler)

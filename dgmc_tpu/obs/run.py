@@ -140,7 +140,7 @@ class RunObserver:
 
     def __init__(self, obs_dir, probes=False, watchdog_deadline_s=None,
                  watchdog_signals=None, fence_deadline_s=None,
-                 host_channel=None, obs_port=None):
+                 host_channel=None, obs_port=None, routes=None):
         self.dir = obs_dir
         self.enabled = bool(obs_dir)
         #: Collective-fence deadline (``--fence-deadline``): every
@@ -246,27 +246,47 @@ class RunObserver:
             if obs_port is not None:
                 # Started BEFORE the watchdog so the bound port can be
                 # advertised in every heartbeat from the first poll on.
-                # A failed bind (fixed port already taken — e.g. two
-                # host processes of one machine given the same
-                # --obs-port) degrades to no plane with a warning:
-                # telemetry must never take the run down.
-                try:
-                    self._server = live_mod.TelemetryServer(
-                        obs_port, health_fn=self.health,
+                def bind(port):
+                    return live_mod.TelemetryServer(
+                        port, health_fn=self.health,
                         metrics_fn=self.prometheus_metrics,
-                        status_fn=self.timings,
+                        status_fn=self.timings, routes=routes,
                         # All interfaces by default (external probers
                         # are the point); DGMC_TPU_OBS_BIND narrows it
                         # (e.g. 127.0.0.1 on multi-tenant machines).
                         host=os.environ.get('DGMC_TPU_OBS_BIND',
                                             '')).start()
+
+                # A failed bind on a FIXED port (already taken — two
+                # host processes given the same --obs-port, or a
+                # restarted serving worker whose predecessor's socket
+                # lingers in TIME_WAIT) retries on an ephemeral port:
+                # the plane MOVES instead of dying, and the chosen port
+                # is re-advertised through heartbeat.json so the
+                # supervisor's /healthz scrape and any endpoint
+                # discovery follow it. Only a failed ephemeral bind
+                # (the port-0 retry itself refused) degrades to no
+                # plane — telemetry must never take the run down.
+                try:
+                    self._server = bind(obs_port)
                     self.live_port = self._server.port
                 except OSError as e:
-                    print(f'RunObserver: could not bind the live '
-                          f'telemetry plane on port {obs_port} ({e}); '
-                          f'continuing without it (pass --obs-port 0 '
-                          f'for a free port per process)',
-                          file=sys.stderr)
+                    if obs_port:
+                        try:
+                            self._server = bind(0)
+                            self.live_port = self._server.port
+                            print(f'RunObserver: port {obs_port} is '
+                                  f'taken ({e}); live telemetry plane '
+                                  f'moved to ephemeral port '
+                                  f'{self.live_port} (advertised in '
+                                  f'heartbeat.json)', file=sys.stderr)
+                        except OSError as e2:
+                            e = e2
+                    if self._server is None:
+                        print(f'RunObserver: could not bind the live '
+                              f'telemetry plane on port {obs_port} '
+                              f'({e}); continuing without it',
+                              file=sys.stderr)
             if watchdog_deadline_s:
                 from dgmc_tpu.obs.watchdog import DEFAULT_SIGNALS, Watchdog
                 self.watchdog = Watchdog(

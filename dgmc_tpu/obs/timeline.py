@@ -3,8 +3,11 @@
 ``obs.diff`` compares exactly two runs; the repo's performance HISTORY
 lives in the committed round records — ``BENCH_r*.json`` (single-chip),
 ``MULTICHIP_r*.json`` (sharded), ``SCALE_r*.json`` (streamed
-million-entity) — and has so far been invisible except by opening each
-file. This CLI walks one or more directories, parses every round record
+million-entity), ``SERVE_r*.json`` (the online matching service's load
+rounds: query-latency p50/p95, QPS, restart count and the warm
+restart-to-first-answer beside the training families' columns) — and
+has so far been invisible except by opening each file.
+This CLI walks one or more directories, parses every round record
 it finds (both the modern structured schema of r06+ and the legacy
 ``{'cmd', 'rc', 'tail', 'parsed'}`` driver capture of r01–r05), and
 renders the trajectory per family::
@@ -37,9 +40,9 @@ from dgmc_tpu.obs.observe import fmt_seconds
 
 __all__ = ['collect_rounds', 'parse_round', 'render', 'main']
 
-_ROUND_FILE = re.compile(r'^(BENCH|MULTICHIP|SCALE)_r(\d+)\.json$')
+_ROUND_FILE = re.compile(r'^(BENCH|MULTICHIP|SCALE|SERVE)_r(\d+)\.json$')
 #: Family render order (matches the chronology: single-chip first).
-_FAMILIES = ('BENCH', 'MULTICHIP', 'SCALE')
+_FAMILIES = ('BENCH', 'MULTICHIP', 'SCALE', 'SERVE')
 
 
 def _get(d, *path):
@@ -91,7 +94,9 @@ def parse_round(family, number, path):
             outcome = '?'
     restarts = _first(_get(d, 'supervision', 'restarts'),
                       _get(d, 'supervision', 'restarts_8dev'))
-    if restarts:
+    if restarts and family != 'SERVE':
+        # SERVE rows carry restarts as their own column (the chaos kill
+        # is part of the round's protocol, not an anomaly to flag).
         outcome = f'{outcome} ({restarts} restarts)'
     row = {
         'family': family,
@@ -130,6 +135,23 @@ def parse_round(family, number, path):
             'host_resident_bytes': off.get('host_resident_bytes'),
             'outcome': off.get('outcome'),
         }
+    if family == 'SERVE':
+        # The serving rounds' headline series: per-query latency, QPS
+        # under concurrent load, and how many supervised restarts the
+        # round survived (the mid-run SIGKILL is part of the protocol —
+        # 1 restart is the healthy shape, not a regression).
+        lat = d.get('latency') or {}
+        restart = d.get('restart') or {}
+        row.update({
+            'latency_p50_ms': _first(lat.get('server_p50_ms'),
+                                     lat.get('client_p50_ms')),
+            'latency_p95_ms': _first(lat.get('server_p95_ms'),
+                                     lat.get('client_p95_ms')),
+            'qps': d.get('qps'),
+            'clients': d.get('clients'),
+            'restarts': _first(_get(d, 'supervision', 'restarts'), 0),
+            'warm_restart_s': restart.get('warm_first_answer_s'),
+        })
     # Truncate the long prose device/platform strings to their lead.
     if isinstance(row['device'], str):
         row['device'] = row['device'].split('(')[0].strip() or None
@@ -178,11 +200,36 @@ def _fmt_offload(off):
     return f'd{depth if depth is not None else "?"}/{host}'
 
 
+def _render_serve(fam_rows, lines):
+    """SERVE rows carry a different headline set than the training
+    families: per-query latency p50/p95, sustained QPS, concurrent
+    clients, warm restart-to-first-answer, restart count."""
+    lines.append('== SERVE trajectory ==')
+    lines.append(f'  {"round":>5} {"p50":>9} {"p95":>9} {"QPS":>7} '
+                 f'{"clients":>7} {"warm rta":>9} {"restarts":>8}'
+                 f'  outcome')
+    for r in fam_rows:
+        p50 = r.get('latency_p50_ms')
+        p95 = r.get('latency_p95_ms')
+        lines.append(
+            f'  {r["round"]:>5} '
+            f'{fmt_seconds(p50 / 1e3) if p50 is not None else "-":>9} '
+            f'{fmt_seconds(p95 / 1e3) if p95 is not None else "-":>9} '
+            f'{_fmt(r.get("qps")):>7} '
+            f'{_fmt(r.get("clients"), "{:d}"):>7} '
+            f'{_fmt(r.get("warm_restart_s"), "{:.2f}s"):>9} '
+            f'{_fmt(r.get("restarts"), "{:d}"):>8}'
+            f'  {r.get("outcome", "?")}')
+
+
 def render(rows):
     lines = []
     for family in _FAMILIES:
         fam_rows = [r for r in rows if r['family'] == family]
         if not fam_rows:
+            continue
+        if family == 'SERVE':
+            _render_serve(fam_rows, lines)
             continue
         offload_col = any(r.get('offload') for r in fam_rows)
         lines.append(f'== {family} trajectory ==')

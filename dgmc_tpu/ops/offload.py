@@ -41,6 +41,7 @@ scale_bench.py``.
 """
 
 import dataclasses
+import functools
 import time
 from typing import Callable, Dict, List, Optional, Sequence, Union
 
@@ -49,7 +50,7 @@ import numpy as np
 from dgmc_tpu.ops.topk import DEFAULT_BLOCK
 
 __all__ = ['DEFAULT_PREFETCH_DEPTH', 'PrefetchRing', 'OffloadStats',
-           'offloaded_streamed_topk', 'main']
+           'offloaded_streamed_topk', 'offloaded_corpus_topk', 'main']
 
 #: Measured default (benchmarks/DISPATCH_DEFAULTS.md, offload section):
 #: depth 2 already hides the host→device copy behind the per-chunk
@@ -228,6 +229,116 @@ def offloaded_streamed_topk(h_s_host, h_t, k, chunk,
         devices=len(devices),
         host_resident_bytes=h_s_host.nbytes + vals.nbytes + idx.nbytes,
         bytes_streamed=ring.puts * B * chunk * C * h_s_host.itemsize,
+        ring_misses=ring.misses, ring_evictions=ring.evictions,
+        wall_s=round(wall, 3))
+    return vals, idx, stats
+
+
+@functools.lru_cache(maxsize=None)
+def _corpus_merge(k, block, sort_tiles):
+    """One cached jitted merge step per (k, block, extractor) config:
+    chunk-local top-k (the exact in-graph per-tile programs) folded into
+    the running carry, carry first so lower target indices win ties.
+    Cached at module scope so a SERVING process re-running the sweep per
+    query reuses one executable instead of re-jitting per call."""
+    import jax
+    import jax.numpy as jnp
+
+    from dgmc_tpu.ops.topk import _chunked_topk
+
+    @jax.jit
+    def merge(run_vals, run_idx, hs, ht_c, m_c, start):
+        cv, ci = _chunked_topk(hs, ht_c, k, m_c, block, True, False,
+                               sort_tiles)
+        ci = start + ci
+        all_v = jnp.concatenate([run_vals, cv], axis=-1)
+        all_i = jnp.concatenate([run_idx, ci], axis=-1)
+        nv, pos = jax.lax.top_k(all_v, k)
+        return nv, jnp.take_along_axis(all_i, pos, axis=-1)
+
+    return merge
+
+
+def offloaded_corpus_topk(h_s, h_t_host, k, chunk, t_mask=None,
+                          block=DEFAULT_BLOCK,
+                          depth: int = DEFAULT_PREFETCH_DEPTH,
+                          device=None,
+                          on_chunk: Optional[Callable[[int], None]] = None):
+    """Top-k candidate search with the TARGET (corpus) table in host RAM.
+
+    The mirror image of :func:`offloaded_streamed_topk`: there the big
+    table is the *source* side streamed in row chunks against a
+    device-resident target; here the queries (``h_s``, small) live on
+    device and the CORPUS ``h_t_host`` streams through the
+    :class:`PrefetchRing` in **target**-axis chunks, each merged into a
+    running per-row top-k carry — the serving layout
+    (``dgmc_tpu/serve/``), where a query is a handful of rows and the
+    corpus is the thing bigger than a chip.
+
+    Bit-identical to ``chunked_topk(h_s, h_t, k, t_mask, block)`` on the
+    same inputs, tie order included (``tests/serve/test_offload_corpus.
+    py``): every chunk runs the SAME per-tile programs over the same
+    tiles in the same target order, and the cross-chunk merge
+    concatenates the running carry *first* so earlier target indices
+    keep winning ties exactly like the in-graph scan. Masked / padded
+    columns score ``finfo.min`` with their true index and unfilled
+    carry slots stay ``(-inf, idx 0)``, both matching the device path's
+    degenerate orderings.
+
+    Returns host-numpy ``(vals, idx, OffloadStats)`` with
+    ``vals``/``idx`` shaped ``[B, N_s, k]``.
+    """
+    import jax
+
+    from dgmc_tpu.ops.topk import _tile_sort
+
+    h_t_host = np.asarray(h_t_host)
+    B, N_t, C = h_t_host.shape
+    chunk = int(chunk)
+    n_chunks = -(-N_t // chunk)
+    sort_tiles = _tile_sort()
+    device = device or jax.local_devices()[0]
+    h_s = jax.device_put(h_s, device)
+    mask_host = (None if t_mask is None else np.asarray(t_mask))
+
+    def host_chunk(i):
+        piece = h_t_host[:, i * chunk:(i + 1) * chunk]
+        if piece.shape[1] < chunk:
+            piece = np.pad(
+                piece, ((0, 0), (0, chunk - piece.shape[1]), (0, 0)))
+        return piece
+
+    def chunk_mask(i):
+        lo = i * chunk
+        m = np.zeros((B, chunk), bool)
+        n = min(chunk, N_t - lo)
+        m[:, :n] = True if mask_host is None else mask_host[:, lo:lo + n]
+        return m
+
+    ring = PrefetchRing(host_chunk, depth=depth, n_chunks=n_chunks,
+                        devices=[device])
+
+    merge = _corpus_merge(k, block, sort_tiles)
+    N_s = h_s.shape[1]
+    run_vals = jax.device_put(
+        np.full((B, N_s, k), -np.inf, h_t_host.dtype), device)
+    run_idx = jax.device_put(np.zeros((B, N_s, k), np.int32), device)
+
+    t0 = time.perf_counter()
+    for i in range(n_chunks):
+        run_vals, run_idx = merge(
+            run_vals, run_idx, h_s, ring.get(i),
+            jax.device_put(chunk_mask(i), device), np.int32(i * chunk))
+        if on_chunk is not None:
+            on_chunk(i)
+    vals = np.asarray(run_vals)
+    idx = np.asarray(run_idx)
+    wall = time.perf_counter() - t0
+    stats = OffloadStats(
+        rows=N_t, chunks=n_chunks, chunk=chunk, prefetch_depth=depth,
+        devices=1,
+        host_resident_bytes=h_t_host.nbytes + vals.nbytes + idx.nbytes,
+        bytes_streamed=ring.puts * B * chunk * C * h_t_host.itemsize,
         ring_misses=ring.misses, ring_evictions=ring.evictions,
         wall_s=round(wall, 3))
     return vals, idx, stats

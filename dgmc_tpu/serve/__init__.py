@@ -1,0 +1,51 @@
+"""Online matching service: the retrieval-then-rerank serving split.
+
+The paper's two-stage matcher is exactly a serving architecture: ψ₁
+node embeddings are a pure function of the graph and the checkpoint —
+precomputable and cacheable for the whole target corpus — while only
+the neighborhood-consensus refinement must run per query
+(``efficiency.json``: consensus iterations dominate the step). This
+package assembles the pieces PRs 6–14 built into a persistent process
+that answers "match this query graph against the corpus":
+
+- :mod:`~dgmc_tpu.serve.corpus` — the corpus index: ψ₁ embeddings for
+  the target corpus computed ONCE from a checkpoint and persisted to
+  disk under a sha256-checksummed manifest (the checkpoint layer's
+  hardening applied to the serving cache), so a restarted worker skips
+  the recompute entirely — the warm-restart story.
+- :mod:`~dgmc_tpu.serve.router` — padding-bucket query routing on the
+  SAME :func:`~dgmc_tpu.analysis.recompile.bucket_signature` hash the
+  recompile lint keys on: declared buckets get warm AOT-compiled
+  executables at startup; an unfittable query is a structured 4xx,
+  never an inline compile (RCP201/202 as latency-SLO guards).
+- :mod:`~dgmc_tpu.serve.engine` — per-bucket AOT executables: ψ₁ on
+  the query, top-k shortlist against the cached corpus table (device-
+  resident, streamed, or host-RAM offloaded through
+  :func:`~dgmc_tpu.ops.offload.offloaded_corpus_topk`), consensus
+  rerank on the shortlist; bit-identical answers across repeats and
+  across the corpus-placement tiers.
+- :mod:`~dgmc_tpu.serve.service` — the worker process: ``/match``
+  mounted beside the live plane's ``/healthz``/``/metrics``/``/status``
+  (:mod:`dgmc_tpu.obs.live`), per-query latency streamed into the
+  Prometheus histogram, supervised restarts via
+  ``python -m dgmc_tpu.serve --supervise``
+  (:mod:`dgmc_tpu.resilience.supervisor`) restarting **warm** from the
+  on-disk embedding cache.
+- :mod:`~dgmc_tpu.serve.client` — query sampling + HTTP/endpoint-
+  discovery helpers shared by ``serve_bench.py``, the CI serve-smoke
+  job and the tests.
+
+Evidence rounds land as ``benchmarks/SERVE_r*.json`` (rendered by
+``python -m dgmc_tpu.obs.timeline``) the way training rounds record
+``BENCH_*``/``SCALE_*``.
+"""
+
+from dgmc_tpu.serve.corpus import Corpus, CorpusIndex, synthetic_corpus
+from dgmc_tpu.serve.engine import MatchEngine
+from dgmc_tpu.serve.router import (QueryRouter, UnknownBucketError,
+                                   parse_buckets)
+from dgmc_tpu.serve.service import ServeService, add_serve_args
+
+__all__ = ['Corpus', 'CorpusIndex', 'synthetic_corpus', 'MatchEngine',
+           'QueryRouter', 'UnknownBucketError', 'parse_buckets',
+           'ServeService', 'add_serve_args']

@@ -1,0 +1,8 @@
+"""``python -m dgmc_tpu.serve`` — the online matching service CLI."""
+
+import sys
+
+from dgmc_tpu.serve.service import main
+
+if __name__ == '__main__':
+    sys.exit(main())

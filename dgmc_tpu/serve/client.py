@@ -1,0 +1,133 @@
+"""Client-side helpers: query sampling, HTTP, endpoint discovery.
+
+Shared by ``serve_bench.py``, the CI serve-smoke job and the tests so
+the load driver, the smoke assertions and the determinism pins all
+speak the exact same wire format. jax-free: a load client must not pay
+a backend bring-up to POST JSON.
+"""
+
+import json
+import os
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+
+__all__ = ['sample_query', 'query_payload', 'post_match', 'get_json',
+           'discover_endpoint']
+
+
+def sample_query(corpus_x, num_nodes, num_edges, seed=0, noise=0.6):
+    """One synthetic query against a corpus feature table.
+
+    Picks ``num_nodes`` random corpus entities, emits variance-
+    preserving noisy copies of their features (the
+    ``synthetic_kg_alignment`` blend, so a trained ψ₁ can actually
+    find them) plus random edges among the picked nodes. Returns
+    ``(Graph, gt)`` where ``gt[i]`` is the corpus index query node
+    ``i`` was sampled from — the label the bench scores hits against.
+    """
+    from dgmc_tpu.utils.data import Graph
+    rng = np.random.RandomState(seed)
+    n_t, dim = corpus_x.shape
+    picks = rng.choice(n_t, size=num_nodes, replace=False)
+    sigma = rng.uniform(0.2, noise, (num_nodes, 1)).astype(np.float32)
+    eps = (rng.randn(num_nodes, dim) / np.sqrt(dim)).astype(np.float32)
+    x = ((corpus_x[picks] + sigma * eps)
+         / np.sqrt(1.0 + sigma ** 2)).astype(np.float32)
+    snd = rng.randint(0, num_nodes, num_edges)
+    rcv = rng.randint(0, num_nodes, num_edges)
+    g = Graph(edge_index=np.stack([snd, rcv]).astype(np.int64), x=x)
+    return g, picks.astype(np.int64)
+
+
+def query_payload(graph):
+    """The ``/match`` POST body for a host ``Graph``."""
+    return {'nodes': np.asarray(graph.x).tolist(),
+            'edges': np.asarray(graph.edge_index).T.tolist()}
+
+
+def post_match(port, payload, host='127.0.0.1', timeout_s=60.0):
+    """POST one query; returns ``(status_code, response_dict)`` or
+    ``None`` when the endpoint is unreachable."""
+    body = json.dumps(payload).encode('utf-8')
+    req = urllib.request.Request(
+        f'http://{host}:{int(port)}/match', data=body,
+        headers={'Content-Type': 'application/json'}, method='POST')
+    try:
+        with urllib.request.urlopen(req, timeout=timeout_s) as resp:
+            return resp.status, json.loads(resp.read().decode('utf-8'))
+    except urllib.error.HTTPError as e:
+        try:
+            return e.code, json.loads(e.read().decode('utf-8'))
+        except Exception:
+            return e.code, {}
+    except Exception:
+        return None
+
+
+def get_json(port, path, host='127.0.0.1', timeout_s=10.0):
+    """GET a JSON (or text) endpoint; ``(code, payload)`` or ``None``."""
+    url = f'http://{host}:{int(port)}{path}'
+    try:
+        with urllib.request.urlopen(url, timeout=timeout_s) as resp:
+            body = resp.read().decode('utf-8')
+            code = resp.status
+    except urllib.error.HTTPError as e:
+        code = e.code
+        try:
+            body = e.read().decode('utf-8')
+        except Exception:
+            return None
+    except Exception:
+        return None
+    try:
+        return code, json.loads(body)
+    except ValueError:
+        return code, body
+
+
+def discover_endpoint(obs_root, timeout_s=0.0, poll_s=0.25):
+    """Find the serving worker's live endpoint from heartbeat files.
+
+    Scans ``obs_root`` and its ``attempt_*/`` children (the supervisor's
+    per-attempt layout) for the freshest ``heartbeat.json`` advertising
+    a ``port`` — the SAME discovery the supervisor's /healthz watch
+    uses, so a worker whose plane moved to an ephemeral port (the
+    port-in-use retry) is found at its real address. Returns
+    ``(host, port, pid)`` or ``None`` after ``timeout_s``.
+    """
+    deadline = time.time() + timeout_s
+
+    def scan():
+        best = None
+        dirs = [obs_root]
+        try:
+            dirs += [os.path.join(obs_root, d)
+                     for d in os.listdir(obs_root)
+                     if d.startswith('attempt_')]
+        except OSError:
+            pass
+        for d in dirs:
+            path = os.path.join(d, 'heartbeat.json')
+            try:
+                with open(path) as f:
+                    hb = json.load(f)
+            except (OSError, ValueError):
+                continue
+            if not hb.get('port'):
+                continue
+            if best is None or hb.get('time', 0) > best[0]:
+                best = (hb.get('time', 0), hb)
+        if best is None:
+            return None
+        hb = best[1]
+        return (hb.get('host') or '127.0.0.1', int(hb['port']),
+                hb.get('pid'))
+
+    while True:
+        found = scan()
+        if found is not None or time.time() >= deadline:
+            return found
+        time.sleep(poll_s)

@@ -1,0 +1,247 @@
+"""Corpus index: ψ₁ embeddings computed once, cached to disk, verified.
+
+The serving split's precompute half. A :class:`Corpus` is the host-side
+target graph (entity features + edges); a :class:`CorpusIndex` is that
+graph plus its ψ₁ embedding table ``h_t [1, N_t, C]`` under a specific
+checkpoint. The table is a pure function of ``(corpus, ψ₁ params)``, so
+it is computed ONCE and persisted under a sha256-checksummed manifest
+(the same tmp+rename / hash-every-file discipline
+``train/checkpoint.py`` applies to checkpoints): a restarted worker
+re-hashes the cache against the manifest AND matches the recorded
+corpus/parameter fingerprints before trusting it, so a cache from a
+different checkpoint, a different corpus, or a torn write is rebuilt —
+never silently served.
+
+The embedding forward runs through the model's own ψ₁ module
+(``model.psi_1.apply`` on the ``psi_1`` parameter subtree), so the
+cached table is bit-identical to what an end-to-end
+:meth:`~dgmc_tpu.models.DGMC.__call__` would compute in-graph
+(tests/serve/test_engine.py pins this transitively: cached-h_t answers
+equal full-forward answers).
+"""
+
+import dataclasses
+import hashlib
+import json
+import os
+import time
+from typing import Optional
+
+import numpy as np
+
+from dgmc_tpu.utils.io import sha256_file, write_json_atomic
+
+__all__ = ['Corpus', 'CorpusIndex', 'synthetic_corpus', 'CACHE_MANIFEST',
+           'CACHE_TABLE']
+
+#: Cache directory contents: the embedding table and its manifest.
+CACHE_TABLE = 'h_t.npy'
+CACHE_MANIFEST = 'manifest.json'
+
+
+def _sha256_bytes(*chunks):
+    h = hashlib.sha256()
+    for c in chunks:
+        h.update(c)
+    return h.hexdigest()
+
+
+@dataclasses.dataclass
+class Corpus:
+    """Host-side target corpus: the graph queries are matched INTO."""
+    x: np.ndarray          # [N_t, C] float32 entity features
+    senders: np.ndarray    # [E_t] int32
+    receivers: np.ndarray  # [E_t] int32
+
+    @property
+    def num_nodes(self):
+        return self.x.shape[0]
+
+    @property
+    def num_edges(self):
+        return self.senders.shape[0]
+
+    @property
+    def feat_dim(self):
+        return self.x.shape[1]
+
+    def fingerprint(self):
+        """Content hash of the corpus arrays (shape-delimited so two
+        different-shape corpora can never collide by concatenation)."""
+        return _sha256_bytes(
+            repr((self.x.shape, self.senders.shape)).encode(),
+            np.ascontiguousarray(self.x).tobytes(),
+            np.ascontiguousarray(self.senders.astype(np.int32)).tobytes(),
+            np.ascontiguousarray(
+                self.receivers.astype(np.int32)).tobytes())
+
+    def graph_batch(self, dummy_x=True):
+        """The ``GraphBatch`` target side of every serve executable.
+
+        ``dummy_x=True`` (the serving default) ships a width-1 zero
+        feature array: with a precomputed ``h_t`` the model never reads
+        ``graph_t.x``, so the raw corpus features stay off the device —
+        the matching stage's device residents are the edge structure
+        and the embedding table only.
+        """
+        from dgmc_tpu.ops.graph import GraphBatch
+        n, e = self.num_nodes, self.num_edges
+        x = (np.zeros((1, n, 1), np.float32) if dummy_x
+             else self.x[None].astype(np.float32))
+        return GraphBatch(
+            x=x,
+            senders=self.senders[None].astype(np.int32),
+            receivers=self.receivers[None].astype(np.int32),
+            node_mask=np.ones((1, n), bool),
+            edge_mask=np.ones((1, e), bool))
+
+
+def synthetic_corpus(num_nodes, num_edges, dim, seed=0):
+    """Unit-norm-feature synthetic corpus (the
+    :func:`~dgmc_tpu.data.synthetic.synthetic_kg_alignment` feature
+    scale, so ψ₁ similarity logits stay in the trainable regime)."""
+    rng = np.random.RandomState(seed)
+    x = (rng.randn(num_nodes, dim) / np.sqrt(dim)).astype(np.float32)
+    snd = rng.randint(0, num_nodes, num_edges).astype(np.int32)
+    rcv = rng.randint(0, num_nodes, num_edges).astype(np.int32)
+    return Corpus(x=x, senders=snd, receivers=rcv)
+
+
+def params_fingerprint(params):
+    """Content hash of a parameter subtree (leaf paths + bytes): the
+    cache-invalidation key tying a corpus cache to the exact checkpoint
+    weights that produced it."""
+    import jax
+    leaves = jax.tree_util.tree_flatten_with_path(params)[0]
+    h = hashlib.sha256()
+    for path, leaf in leaves:
+        h.update(jax.tree_util.keystr(path).encode())
+        arr = np.asarray(leaf)
+        h.update(repr((arr.shape, str(arr.dtype))).encode())
+        h.update(np.ascontiguousarray(arr).tobytes())
+    return h.hexdigest()
+
+
+@dataclasses.dataclass
+class CorpusIndex:
+    """A corpus plus its ψ₁ embedding table under one checkpoint."""
+    corpus: Corpus
+    h_t: np.ndarray                 # [1, N_t, C_out] float32
+    meta: dict
+
+    @property
+    def embed_dim(self):
+        return self.h_t.shape[-1]
+
+
+def compute_embeddings(psi_1, psi_1_params, corpus, batch_stats=None):
+    """``h_t = ψ₁(corpus)`` through the model's own backbone module on
+    its parameter subtree — the table an in-graph forward would build."""
+    variables = {'params': psi_1_params}
+    if batch_stats:
+        variables['batch_stats'] = batch_stats
+    g = corpus.graph_batch(dummy_x=False)
+    h = psi_1.apply(variables, g.x, g, train=False)
+    return np.asarray(h, dtype=np.float32)
+
+
+def write_cache(cache_dir, index):
+    """Persist ``h_t`` + manifest atomically (tmp+rename both)."""
+    os.makedirs(cache_dir, exist_ok=True)
+    table_path = os.path.join(cache_dir, CACHE_TABLE)
+    tmp = table_path + '.tmp'
+    with open(tmp, 'wb') as f:
+        np.save(f, index.h_t)
+    os.replace(tmp, table_path)
+    manifest = dict(index.meta)
+    manifest['files'] = {CACHE_TABLE: {
+        'sha256': sha256_file(table_path),
+        'bytes': os.path.getsize(table_path)}}
+    write_json_atomic(os.path.join(cache_dir, CACHE_MANIFEST), manifest,
+                      indent=1, sort_keys=True)
+    return table_path
+
+
+def load_cache(cache_dir, corpus_fp, params_fp):
+    """``(h_t, meta)`` when the cache verifies, else ``(None, reason)``.
+
+    Verification is three-layered: the manifest must parse, every
+    manifested file must re-hash to its recorded sha256/size (a torn or
+    bit-flipped table is a rebuild, not a crash — and never a silently
+    wrong answer), and the recorded corpus/params fingerprints must
+    match the CURRENT corpus and checkpoint (a cache from yesterday's
+    weights is stale, not corrupt — same outcome)."""
+    mpath = os.path.join(cache_dir, CACHE_MANIFEST)
+    try:
+        with open(mpath) as f:
+            meta = json.load(f)
+    except FileNotFoundError:
+        return None, 'no-manifest'
+    except (OSError, ValueError) as e:
+        return None, f'manifest-unreadable:{type(e).__name__}'
+    if meta.get('corpus_fingerprint') != corpus_fp:
+        return None, 'corpus-mismatch'
+    if meta.get('params_fingerprint') != params_fp:
+        return None, 'params-mismatch'
+    for rel, want in (meta.get('files') or {}).items():
+        p = os.path.join(cache_dir, rel)
+        if not os.path.isfile(p):
+            return None, f'missing:{rel}'
+        if os.path.getsize(p) != want.get('bytes'):
+            return None, f'size-mismatch:{rel}'
+        if sha256_file(p) != want.get('sha256'):
+            return None, f'sha256-mismatch:{rel}'
+    try:
+        h_t = np.load(os.path.join(cache_dir, CACHE_TABLE))
+    except (OSError, ValueError) as e:
+        return None, f'table-unreadable:{type(e).__name__}'
+    return h_t, meta
+
+
+def load_or_build(cache_dir, psi_1, psi_1_params, corpus,
+                  batch_stats=None, checkpoint_step: Optional[int] = None,
+                  log=None):
+    """The worker's startup path: verified cache hit, or build + persist.
+
+    Returns ``(CorpusIndex, info)`` where ``info`` carries the
+    warm/cold evidence the restart measurements key on:
+    ``{'cache': 'hit' | 'miss:<reason>', 'seconds': <load or build>}``.
+    """
+    corpus_fp = corpus.fingerprint()
+    params_fp = params_fingerprint(psi_1_params)
+    t0 = time.perf_counter()
+    if cache_dir:
+        h_t, meta_or_reason = load_cache(cache_dir, corpus_fp, params_fp)
+        if h_t is not None:
+            info = {'cache': 'hit',
+                    'seconds': round(time.perf_counter() - t0, 3)}
+            if log:
+                log(f'corpus cache HIT: {cache_dir} '
+                    f'({h_t.nbytes >> 20} MiB ψ₁ table verified in '
+                    f'{info["seconds"]:.3f}s; recompute skipped)')
+            return CorpusIndex(corpus, h_t, meta_or_reason), info
+        reason = meta_or_reason
+    else:
+        reason = 'disabled'
+    h_t = compute_embeddings(psi_1, psi_1_params, corpus,
+                             batch_stats=batch_stats)
+    build_s = round(time.perf_counter() - t0, 3)
+    meta = {
+        'version': 1,
+        'corpus_fingerprint': corpus_fp,
+        'params_fingerprint': params_fp,
+        'checkpoint_step': checkpoint_step,
+        'shape': list(h_t.shape),
+        'dtype': str(h_t.dtype),
+        'built_unix': round(time.time(), 3),
+        'build_s': build_s,
+    }
+    index = CorpusIndex(corpus, h_t, meta)
+    if cache_dir:
+        write_cache(cache_dir, index)
+    info = {'cache': f'miss:{reason}', 'seconds': build_s}
+    if log:
+        log(f'corpus cache MISS ({reason}): built {h_t.nbytes >> 20} '
+            f'MiB ψ₁ table in {build_s:.3f}s'
+            + (f', persisted to {cache_dir}' if cache_dir else ''))
+    return index, info

@@ -1,0 +1,137 @@
+"""Padding-bucket query routing on the lint's own signature hash.
+
+Every distinct padding shape a serving process accepts is a distinct
+XLA program (``analysis/recompile.py``); a router that padded queries
+ad hoc would compile on the query path — the RCP201/202 churn findings
+as live latency spikes. This router inverts that: the bucket space is
+DECLARED at startup (``--buckets 32x64,64x128``), every declared bucket
+gets its executable AOT-compiled before the first query, and a query
+that fits no declared bucket is a structured 4xx
+(:class:`UnknownBucketError`), never an inline compile.
+
+Bucket identity is :func:`dgmc_tpu.analysis.recompile.bucket_signature`
+— the SAME public helper the recompile lint hashes telemetry rows with,
+over the same ``{batch, nodes, edges}`` row format the collation layer
+records (``utils/data.pad_pair_batch`` →
+``registry.padding_bucket_table``). One definition, two consumers;
+``tests/serve/test_router.py`` pins the agreement on every registry
+specimen's recorded buckets, so the lint's churn math and the router's
+executable table can never drift apart.
+"""
+
+import re
+from typing import List, NamedTuple
+
+from dgmc_tpu.analysis.recompile import bucket_signature
+
+__all__ = ['Bucket', 'QueryRouter', 'UnknownBucketError', 'parse_buckets',
+           'DEFAULT_BUCKETS']
+
+#: Default declared bucket ladder (query nodes x edges): power-of-two
+#: rungs covering small-to-medium query graphs. Serving deployments
+#: declare their own via ``--buckets``.
+DEFAULT_BUCKETS = ((16, 48), (32, 96), (64, 192))
+
+
+class Bucket(NamedTuple):
+    """One declared query padding bucket (source-side shape)."""
+    nodes: int
+    edges: int
+
+
+class UnknownBucketError(Exception):
+    """A query that fits no declared bucket. Carries the structured
+    4xx payload the service returns verbatim — the client learns the
+    declared bucket space instead of paying for an inline compile."""
+
+    def __init__(self, nodes, edges, buckets):
+        self.payload = {
+            'error': 'unknown-bucket',
+            'detail': f'query ({nodes} nodes, {edges} edges) fits no '
+                      f'declared padding bucket; the service only runs '
+                      f'warm AOT-compiled executables (no inline '
+                      f'compiles on the query path)',
+            'query': {'nodes': int(nodes), 'edges': int(edges)},
+            'buckets': [f'{b.nodes}x{b.edges}' for b in buckets],
+        }
+        super().__init__(self.payload['detail'])
+
+
+def parse_buckets(spec) -> List[Bucket]:
+    """``'32x96,64x192'`` → sorted, deduplicated bucket list."""
+    out = set()
+    for part in str(spec).split(','):
+        part = part.strip()
+        if not part:
+            continue
+        m = re.match(r'^(\d+)x(\d+)$', part)
+        if not m:
+            raise ValueError(f'bad bucket spec {part!r} (want NxE, e.g. '
+                             f'32x96)')
+        b = Bucket(int(m.group(1)), int(m.group(2)))
+        if b.nodes < 1 or b.edges < 1:
+            raise ValueError(f'bucket {part!r} must be positive')
+        out.add(b)
+    if not out:
+        raise ValueError(f'no buckets in spec {spec!r}')
+    return sorted(out)
+
+
+class QueryRouter:
+    """Route queries into declared padding buckets.
+
+    Args:
+        buckets: declared :class:`Bucket` list (or a ``'NxE,...'``
+            spec string).
+        corpus_nodes / corpus_edges: the fixed target-side padding every
+            bucket pairs with — the signature hashes the PAIR shape,
+            exactly like the telemetry rows the lint consumes.
+    """
+
+    def __init__(self, buckets, corpus_nodes, corpus_edges):
+        if isinstance(buckets, str):
+            buckets = parse_buckets(buckets)
+        self.buckets = sorted(Bucket(int(n), int(e)) for n, e in buckets)
+        self.corpus_nodes = int(corpus_nodes)
+        self.corpus_edges = int(corpus_edges)
+
+    def route(self, nodes, edges) -> Bucket:
+        """Smallest declared bucket that fits (nodes, edges) — smallest
+        by node padding then edge padding, so a query pays the least
+        masked-row waste the declaration allows. No fit raises
+        :class:`UnknownBucketError`."""
+        for b in self.buckets:
+            if nodes <= b.nodes and edges <= b.edges:
+                return b
+        raise UnknownBucketError(nodes, edges, self.buckets)
+
+    def bucket_row(self, bucket) -> dict:
+        """The obs-telemetry padding-bucket row this bucket collates as
+        (``registry.padding_bucket_table`` format) — the row format
+        :func:`~dgmc_tpu.analysis.recompile.bucket_signature` is
+        defined over."""
+        return {'batch': 1,
+                'nodes': f'{bucket.nodes}x{self.corpus_nodes}',
+                'edges': f'{bucket.edges}x{self.corpus_edges}'}
+
+    def signature(self, bucket) -> str:
+        """The bucket's executable-table key: the recompile lint's own
+        signature hash over this bucket's telemetry row."""
+        return bucket_signature(self.bucket_row(bucket))
+
+    def record(self, bucket):
+        """Count one collation into ``bucket`` in the process-wide obs
+        registry — the serve-side twin of ``pad_pair_batch``'s
+        telemetry, so a recorded serve run's padding buckets feed the
+        same RCP202 compile-churn cross-check as a training run's."""
+        from dgmc_tpu.obs.registry import REGISTRY
+        row = self.bucket_row(bucket)
+        REGISTRY.inc('padding_bucket', **row)
+
+    def pad_query(self, graph, bucket):
+        """Collate one host :class:`~dgmc_tpu.utils.data.Graph` into
+        ``bucket``'s padded ``GraphBatch`` (B=1), recording the
+        collation in the registry."""
+        from dgmc_tpu.utils.data import pad_graphs
+        self.record(bucket)
+        return pad_graphs([graph], bucket.nodes, bucket.edges)

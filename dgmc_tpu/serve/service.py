@@ -1,0 +1,398 @@
+"""The serving worker: checkpoint → cache → warm buckets → answer.
+
+``ServeService`` owns the worker lifecycle:
+
+1. build/load the corpus (synthetic by spec, or an ``.npz``),
+2. restore the checkpoint (``train/checkpoint.py`` hardening included;
+   ``--init-missing`` seeds + saves step 0 into an empty directory so
+   smoke/bench runs are self-contained AND deterministic across
+   supervised restarts),
+3. load-or-build the ψ₁ corpus cache (sha256-manifested; a verified
+   cache hit is the WARM restart path — the recompute is skipped and
+   the hit is logged + exported as the ``corpus_cache_hit`` gauge),
+4. AOT-warm every declared bucket executable,
+5. serve ``/match`` beside ``/healthz``/``/metrics``/``/status`` on the
+   observer's telemetry plane, with per-query latency streamed into the
+   Prometheus histogram (``dgmc_step_latency_seconds`` — a "step" IS a
+   query here) and startup-phase timings logged for the cold-vs-warm
+   restart account.
+
+Run supervised via ``python -m dgmc_tpu.serve --supervise``: the
+monitor kills a wedged worker on the same /healthz verdict the plane
+itself serves, and the restarted worker comes back warm from the cache.
+The idle loop beats the watchdog — an idle server is healthy; only a
+WEDGED one (a query stuck in XLA, a deadlocked handler) goes stale and
+gets restarted.
+"""
+
+import argparse
+import json
+import os
+import signal
+import sys
+import threading
+import time
+
+import numpy as np
+
+from dgmc_tpu.serve.router import (DEFAULT_BUCKETS, QueryRouter,
+                                   UnknownBucketError, parse_buckets)
+
+__all__ = ['ServeService', 'add_serve_args', 'main']
+
+
+def add_serve_args(parser):
+    """The serving CLI surface (``python -m dgmc_tpu.serve``)."""
+    parser.add_argument('--ckpt_dir', '--ckpt-dir', dest='ckpt_dir',
+                        type=str, required=True,
+                        help='checkpoint directory (train/checkpoint.py '
+                             'layout); the serving weights')
+    parser.add_argument('--init-missing', '--init_missing',
+                        dest='init_missing', action='store_true',
+                        help='if the checkpoint directory is empty, '
+                             'initialize seeded parameters and SAVE them '
+                             'as step 0 before serving — self-contained '
+                             'smoke/bench runs whose supervised restarts '
+                             'restore identical weights')
+    parser.add_argument('--corpus-npz', '--corpus_npz', dest='corpus_npz',
+                        type=str, default=None,
+                        help='corpus arrays: .npz with x [N,C] float32, '
+                             'senders [E] int32, receivers [E] int32 '
+                             '(default: synthetic by the --corpus-* '
+                             'flags)')
+    parser.add_argument('--corpus-nodes', '--corpus_nodes',
+                        dest='corpus_nodes', type=int, default=4096)
+    parser.add_argument('--corpus-edges', '--corpus_edges',
+                        dest='corpus_edges', type=int, default=16384)
+    parser.add_argument('--corpus-dim', '--corpus_dim', dest='corpus_dim',
+                        type=int, default=64,
+                        help='synthetic corpus feature width (and the '
+                             'width every query must ship)')
+    parser.add_argument('--corpus-seed', '--corpus_seed',
+                        dest='corpus_seed', type=int, default=0)
+    parser.add_argument('--cache-dir', '--cache_dir', dest='cache_dir',
+                        type=str, default=None,
+                        help='ψ₁ corpus-cache directory (default '
+                             '<ckpt_dir>/corpus_cache; "" disables '
+                             'caching — every restart is cold)')
+    parser.add_argument('--buckets', type=str,
+                        default=','.join(f'{n}x{e}'
+                                         for n, e in DEFAULT_BUCKETS),
+                        help='declared query padding buckets '
+                             '"NxE,NxE,..." — each gets a warm AOT '
+                             'executable at startup; queries outside '
+                             'the declared space get a structured 4xx '
+                             '(default %(default)s)')
+    parser.add_argument('--dim', type=int, default=64,
+                        help='ψ₁ hidden width')
+    parser.add_argument('--rnd_dim', type=int, default=16)
+    parser.add_argument('--num_layers', type=int, default=2)
+    parser.add_argument('--num_steps', type=int, default=4,
+                        help='consensus rerank iterations per query')
+    parser.add_argument('--k', type=int, default=10,
+                        help='shortlist size (candidates reranked per '
+                             'query node)')
+    parser.add_argument('--max-results', '--max_results',
+                        dest='max_results', type=int, default=5,
+                        help='ranked candidates returned per node')
+    parser.add_argument('--stream-chunk', '--stream_chunk',
+                        dest='stream_chunk', type=int, default=0,
+                        help='stream the shortlist search over source '
+                             'chunks of this many rows (0 = off)')
+    parser.add_argument('--offload-corpus', '--offload_corpus',
+                        dest='offload_corpus', action='store_true',
+                        help='host-RAM corpus tier: the ψ₁ table stays '
+                             'in host memory; the shortlist streams '
+                             'target chunks through the prefetch ring '
+                             '(ops/offload.offloaded_corpus_topk) and '
+                             'the rerank executable receives the '
+                             'shortlist + candidate rows — device '
+                             'residents stay O(corpus edges + query), '
+                             'whatever the corpus row count')
+    parser.add_argument('--offload-chunk', '--offload_chunk',
+                        dest='offload_chunk', type=int, default=4096)
+    parser.add_argument('--prefetch-depth', '--prefetch_depth',
+                        dest='prefetch_depth', type=int, default=0,
+                        help='prefetch ring depth for --offload-corpus '
+                             '(0 = library default)')
+    parser.add_argument('--noise-seed', '--noise_seed', dest='noise_seed',
+                        type=int, default=0,
+                        help='fixed consensus indicator-noise key: '
+                             'serving is deterministic — identical '
+                             'queries get bit-identical answers')
+    parser.add_argument('--seed', type=int, default=0)
+    from dgmc_tpu.obs import add_obs_flag
+    from dgmc_tpu.resilience import add_supervisor_args
+    add_obs_flag(parser)
+    add_supervisor_args(parser)
+    return parser
+
+
+def _load_corpus(args):
+    from dgmc_tpu.serve.corpus import Corpus, synthetic_corpus
+    if args.corpus_npz:
+        d = np.load(args.corpus_npz)
+        return Corpus(x=np.asarray(d['x'], np.float32),
+                      senders=np.asarray(d['senders'], np.int32),
+                      receivers=np.asarray(d['receivers'], np.int32))
+    return synthetic_corpus(args.corpus_nodes, args.corpus_edges,
+                            args.corpus_dim, seed=args.corpus_seed)
+
+
+class ServeService:
+    """One serving worker (construct, :meth:`start`, :meth:`serve_forever`
+    or drive in-process from tests via :attr:`port`/:meth:`stop`)."""
+
+    def __init__(self, args):
+        self.args = args
+        self.engine = None
+        self.obs = None
+        self.port = None
+        self.ready = False
+        self.phases = {}
+        self.queries_served = 0
+        self.query_errors = 0
+        # Handler threads (ThreadingHTTPServer: one per request) bump
+        # these outside the engine's execution lock — the non-atomic
+        # += needs its own lock or concurrent clients lose increments.
+        self._counts = threading.Lock()
+        self._stop = threading.Event()
+
+    # -- startup -----------------------------------------------------------
+
+    def start(self):
+        args = self.args
+        t_start = time.perf_counter()
+
+        from dgmc_tpu.obs import RunObserver
+        # The observer comes up FIRST: warmup compiles must be counted
+        # (the zero-per-query-compile check is a delta against them),
+        # the watchdog must cover the startup phases, and /healthz must
+        # answer while the cache builds. /match answers 503 until ready.
+        self.obs = RunObserver(args.obs_dir,
+                               watchdog_deadline_s=args.watchdog_deadline,
+                               obs_port=args.obs_port,
+                               routes={'/match': self.handle_match})
+        self.port = self.obs.live_port
+        obs = self.obs
+
+        def phase(name, fn):
+            t0 = time.perf_counter()
+            if obs.watchdog is not None:
+                obs.watchdog.beat('serve-startup', name)
+            out = fn()
+            self.phases[f'{name}_s'] = round(time.perf_counter() - t0, 3)
+            if obs.watchdog is not None:
+                obs.watchdog.done()
+            return out
+
+        corpus = phase('corpus', lambda: _load_corpus(args))
+        model, variables, step = phase(
+            'checkpoint', lambda: self._restore(corpus))
+        index, cache_info = phase(
+            'cache', lambda: self._index(corpus, model, variables, step))
+        self.cache_info = cache_info
+
+        router = QueryRouter(parse_buckets(args.buckets),
+                             corpus.num_nodes, corpus.num_edges)
+        from dgmc_tpu.serve.engine import MatchEngine
+        self.engine = MatchEngine(
+            model, variables, index, router,
+            max_results=args.max_results, noise_seed=args.noise_seed,
+            offload=args.offload_corpus,
+            offload_chunk=args.offload_chunk,
+            prefetch_depth=args.prefetch_depth or None, obs=obs)
+        warm_report = phase('warm', self.engine.warm)
+
+        self.phases['ready_s'] = round(time.perf_counter() - t_start, 3)
+        cache_hit = cache_info['cache'] == 'hit'
+        obs.set_gauge('serve_ready', 1)
+        obs.set_gauge('corpus_cache_hit', 1 if cache_hit else 0)
+        obs.set_gauge('serve_buckets_warm', self.engine.buckets_warm)
+        obs.set_gauge('queries_served', 0)
+        warm_compiles = self._compile_events()
+        obs.set_gauge('serve_warmup_compiles', warm_compiles)
+        obs.log(0, event='serve_ready', cache=cache_info['cache'],
+                cache_seconds=cache_info['seconds'],
+                warmup_compiles=warm_compiles,
+                buckets=len(warm_report), **self.phases)
+        self.ready = True
+        print(f'serve: ready in {self.phases["ready_s"]:.2f}s '
+              f'(cache {cache_info["cache"]}, '
+              f'{self.engine.buckets_warm} buckets warm, '
+              f'{warm_compiles} warmup compiles) on port {self.port}',
+              file=sys.stderr, flush=True)
+        return self
+
+    def _restore(self, corpus):
+        import jax
+
+        from dgmc_tpu.models import DGMC, RelCNN
+        from dgmc_tpu.train import create_train_state
+        from dgmc_tpu.train.checkpoint import Checkpointer
+        args = self.args
+        psi_1 = RelCNN(corpus.feat_dim, args.dim, args.num_layers,
+                       batch_norm=False, cat=True, lin=True, dropout=0.0)
+        psi_2 = RelCNN(args.rnd_dim, args.rnd_dim, args.num_layers,
+                       batch_norm=False, cat=True, lin=True, dropout=0.0)
+        model = DGMC(psi_1, psi_2, num_steps=args.num_steps, k=args.k,
+                     stream_chunk=args.stream_chunk or None)
+        state = create_train_state(
+            model, jax.random.key(args.seed), self._init_batch(corpus))
+        ckpt = Checkpointer(args.ckpt_dir)
+        steps = ckpt.all_steps()
+        if not steps:
+            if not args.init_missing:
+                raise SystemExit(
+                    f'serve: no checkpoint under {args.ckpt_dir} (pass '
+                    f'--init-missing to seed-initialize and save step 0)')
+            ckpt.save(0, state, wait=True)
+            steps = [0]
+        restored = ckpt.restore(state)
+        step = ckpt.restored_step
+        ckpt.close()
+        variables = {'params': restored.params}
+        if restored.batch_stats:
+            variables['batch_stats'] = restored.batch_stats
+        return model, variables, step
+
+    def _init_batch(self, corpus):
+        """Tiny init stand-in pair: parameter shapes depend only on
+        feature widths (train/state.create_train_state docs)."""
+        from dgmc_tpu.serve.corpus import synthetic_corpus
+        from dgmc_tpu.utils.data import PairBatch
+        c = corpus.feat_dim
+        g_s = synthetic_corpus(16, 48, c, seed=1).graph_batch(
+            dummy_x=False)
+        g_t = synthetic_corpus(24, 64, c, seed=2).graph_batch(
+            dummy_x=False)
+        y = np.full((1, 16), -1, np.int32)
+        y[0, :8] = np.arange(8)
+        return PairBatch(s=g_s, t=g_t, y=y, y_mask=y >= 0)
+
+    def _index(self, corpus, model, variables, step):
+        from dgmc_tpu.serve.corpus import load_or_build
+        args = self.args
+        cache_dir = args.cache_dir
+        if cache_dir is None:
+            cache_dir = os.path.join(args.ckpt_dir, 'corpus_cache')
+        bs = (variables.get('batch_stats') or {}).get('psi_1')
+        return load_or_build(
+            cache_dir or None, model.psi_1, variables['params']['psi_1'],
+            corpus, batch_stats=bs, checkpoint_step=step,
+            log=lambda m: print(f'serve: {m}', file=sys.stderr,
+                                flush=True))
+
+    def _compile_events(self):
+        w = self.obs._watcher
+        return (w.summary() or {}).get('events', 0) if w else 0
+
+    def _count_error(self):
+        with self._counts:
+            self.query_errors += 1
+
+    # -- the /match route --------------------------------------------------
+
+    def handle_match(self, method, body):
+        """``(method, body bytes) -> (code, payload)`` for the plane's
+        route table. Every failure is structured: 405 wrong method, 503
+        warming up, 400 malformed / unknown bucket, 500 engine fault."""
+        if method != 'POST':
+            return 405, {'error': 'POST a JSON query to /match',
+                         'schema': {'nodes': '[[feat,...],...]',
+                                    'edges': '[[src,dst],...]'}}
+        if not self.ready:
+            return 503, {'error': 'warming-up',
+                         'phases': dict(self.phases)}
+        try:
+            payload = json.loads(body.decode('utf-8'))
+            from dgmc_tpu.utils.data import Graph
+            x = np.asarray(payload['nodes'], np.float32)
+            edges = np.asarray(payload.get('edges') or [], np.int64)
+            edges = (edges.T if edges.size
+                     else np.zeros((2, 0), np.int64))
+            if x.ndim != 2:
+                raise ValueError(f'nodes must be [N, C], got shape '
+                                 f'{x.shape}')
+            graph = Graph(edge_index=edges, x=x)
+        except (ValueError, KeyError, TypeError,
+                UnicodeDecodeError) as e:
+            self._count_error()
+            return 400, {'error': 'bad-query',
+                         'detail': f'{type(e).__name__}: {e}'}
+        t0 = time.perf_counter()
+        from dgmc_tpu.serve.engine import UnknownExecutableError
+        try:
+            answer = self.engine.match(graph)
+        except UnknownBucketError as e:
+            self._count_error()
+            return 400, e.payload
+        except UnknownExecutableError as e:
+            self._count_error()
+            return 503, e.payload
+        except ValueError as e:
+            self._count_error()
+            return 400, {'error': 'bad-query',
+                         'detail': f'{type(e).__name__}: {e}'}
+        with self._counts:
+            self.queries_served += 1
+            served = self.queries_served
+        self.obs.set_gauge('queries_served', served)
+        answer['latency_ms'] = round(
+            (time.perf_counter() - t0) * 1e3, 3)
+        return 200, answer
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def serve_forever(self, poll_s=0.5, flush_every_s=5.0):
+        """Idle loop until SIGTERM/SIGINT/:meth:`stop`: beats the
+        watchdog (an idle server is healthy) and periodically flushes
+        the obs artifacts so the latest query telemetry is on disk for
+        scrapers of the FILE artifacts too."""
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            try:
+                signal.signal(sig, lambda *_: self._stop.set())
+            except ValueError:
+                break
+        last_flush = time.time()
+        while not self._stop.is_set():
+            self._stop.wait(poll_s)
+            if self.obs.watchdog is not None:
+                self.obs.watchdog.beat('idle')
+            if time.time() - last_flush >= flush_every_s:
+                self.obs.flush()
+                last_flush = time.time()
+        self.close()
+        return 0
+
+    def stop(self):
+        self._stop.set()
+
+    def close(self):
+        if self.obs is not None:
+            self.obs.flush()
+            self.obs.close()
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        prog='python -m dgmc_tpu.serve',
+        description='Online matching service: persistent query-serving '
+                    'worker (ψ₁ corpus cache, warm AOT bucket '
+                    'executables, shortlist→consensus rerank) with '
+                    '/match mounted beside the live telemetry plane. '
+                    'Run under --supervise for warm self-healing '
+                    'restarts.')
+    add_serve_args(parser)
+    args = parser.parse_args(argv)
+    if args.supervise:
+        from dgmc_tpu.resilience.supervisor import supervise_cli
+        return supervise_cli('dgmc_tpu.serve', args, argv,
+                             ladder=('disable-fused',))
+    if not args.obs_dir:
+        raise SystemExit('serve: --obs-dir is required (the /match '
+                         'plane and the latency account live there)')
+    if args.obs_port is None:
+        args.obs_port = 0
+    service = ServeService(args).start()
+    return service.serve_forever()

@@ -22,13 +22,14 @@ falls back to the previous good checkpoint with a warning.
 error (no silent fallback when the caller pinned a step).
 """
 
-import hashlib
 import json
 import os
 import sys
 from typing import Optional
 
 import jax
+
+from dgmc_tpu.utils.io import sha256_file
 
 
 class CheckpointError(RuntimeError):
@@ -45,17 +46,6 @@ class CheckpointCorruptError(CheckpointError):
 MANIFEST_DIRNAME = 'manifests'
 
 
-def _sha256(path, chunk=1 << 20):
-    h = hashlib.sha256()
-    with open(path, 'rb') as f:
-        while True:
-            b = f.read(chunk)
-            if not b:
-                break
-            h.update(b)
-    return h.hexdigest()
-
-
 def _file_table(step_dir):
     """{relpath: {sha256, bytes}} over every regular file under a step."""
     out = {}
@@ -63,7 +53,7 @@ def _file_table(step_dir):
         for name in sorted(files):
             p = os.path.join(root, name)
             rel = os.path.relpath(p, step_dir)
-            out[rel] = {'sha256': _sha256(p),
+            out[rel] = {'sha256': sha256_file(p),
                         'bytes': os.path.getsize(p)}
     return out
 
@@ -195,7 +185,7 @@ class Checkpointer:
                 problems.append(
                     f'{rel}: size {size} != manifest {want["bytes"]}')
                 continue
-            if _sha256(p) != want['sha256']:
+            if sha256_file(p) != want['sha256']:
                 problems.append(f'{rel}: sha256 mismatch')
         return problems
 

@@ -9,10 +9,26 @@ loop and the fault module use it in processes that must stay responsive
 while a jax backend wedges.
 """
 
+import hashlib
 import json
 import os
 
-__all__ = ['write_json_atomic']
+__all__ = ['write_json_atomic', 'sha256_file']
+
+
+def sha256_file(path, chunk=1 << 20):
+    """Chunked sha256 of one file — the manifest-integrity hash shared
+    by checkpoint manifests (``train/checkpoint.py``) and the serving
+    corpus cache (``serve/corpus.py``): ONE definition, so the two
+    manifest disciplines can never silently diverge."""
+    h = hashlib.sha256()
+    with open(path, 'rb') as f:
+        while True:
+            b = f.read(chunk)
+            if not b:
+                break
+            h.update(b)
+    return h.hexdigest()
 
 
 def write_json_atomic(path, payload, *, indent=None, sort_keys=False,

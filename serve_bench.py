@@ -1,0 +1,438 @@
+"""Serving load driver: concurrent clients, mixed buckets, chaos kill.
+
+The evidence round for the online matching service
+(``dgmc_tpu/serve/``), recorded the way training rounds record
+``BENCH_*``/``SCALE_*``::
+
+    python serve_bench.py --out benchmarks/SERVE_r01.json
+
+Protocol (one supervised service, measured end to end):
+
+1. **Cold start** — spawn ``python -m dgmc_tpu.serve --supervise`` on a
+   synthetic corpus with an empty checkpoint dir (``--init-missing``)
+   and measure spawn → first successful ``/match`` answer (imports,
+   checkpoint init, corpus ψ₁ build + cache write, AOT bucket warmup —
+   the whole cold path).
+2. **Load phase 1** — N concurrent clients × Q queries each, mixed
+   bucket sizes, client-observed latency per query; the compile-event
+   counter is read before and after through ``/status`` — the
+   zero-per-query-compiles cross-check (the RCP202 telemetry account:
+   compiles after warmup must be 0).
+3. **Chaos** — SIGKILL the serving WORKER mid-run (pid from
+   ``/healthz``). The supervisor restarts it; the restarted worker must
+   come back **warm** from the on-disk embedding cache (cache-hit gauge
+   asserted) and on a possibly NEW port (clients re-discover through
+   ``heartbeat.json``, the same discovery the supervisor uses).
+   Measured: kill → first successful answer (warm restart-to-first-
+   answer), which must beat the cold startup.
+4. **Load phase 2** — remaining queries against the restarted worker,
+   compile delta asserted zero again.
+5. **Teardown** — SIGTERM the worker (graceful exit 0 → the supervisor
+   records ``outcome: completed``, ``restarts: 1``).
+
+The record carries server-side latency p50/p95 (the worker's own
+per-query histogrammed account), client-observed p50/p95, sustained
+QPS, the cold/warm restart split, the compile account and Hits@1
+against the sampled queries' known ground truth.
+"""
+
+import argparse
+import json
+import os
+import shutil
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+from dgmc_tpu.obs.observe import percentile
+from dgmc_tpu.serve.client import (discover_endpoint, get_json,
+                                   post_match, query_payload,
+                                   sample_query)
+from dgmc_tpu.serve.corpus import synthetic_corpus
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(description=__doc__.split('\n')[0])
+    p.add_argument('--out', type=str, default=None,
+                   help='write the round record here (e.g. '
+                        'benchmarks/SERVE_r01.json); default: stdout '
+                        'only')
+    p.add_argument('--round', type=int, default=1)
+    p.add_argument('--workdir', type=str, default='/tmp/serve_bench')
+    p.add_argument('--clients', type=int, default=4)
+    p.add_argument('--queries-per-client', dest='queries_per_client',
+                   type=int, default=12)
+    p.add_argument('--corpus-nodes', dest='corpus_nodes', type=int,
+                   default=4096)
+    p.add_argument('--corpus-edges', dest='corpus_edges', type=int,
+                   default=16384)
+    p.add_argument('--corpus-dim', dest='corpus_dim', type=int,
+                   default=64)
+    p.add_argument('--buckets', type=str, default='16x48,32x96')
+    p.add_argument('--dim', type=int, default=64)
+    p.add_argument('--rnd_dim', type=int, default=16)
+    p.add_argument('--num_layers', type=int, default=2)
+    p.add_argument('--num_steps', type=int, default=4)
+    p.add_argument('--k', type=int, default=10)
+    p.add_argument('--offload-corpus', dest='offload_corpus',
+                   action='store_true',
+                   help='run the service in the host-RAM corpus tier')
+    p.add_argument('--startup-timeout', dest='startup_timeout',
+                   type=float, default=300.0)
+    p.add_argument('--seed', type=int, default=0)
+    return p.parse_args(argv)
+
+
+class Endpoint:
+    """Shared, re-discoverable service endpoint (the worker's port can
+    MOVE across the chaos restart — discovery follows heartbeat.json)."""
+
+    def __init__(self, obs_root):
+        self.obs_root = obs_root
+        self._lock = threading.Lock()
+        self.port = None
+        self.pid = None
+
+    def refresh(self, timeout_s=0.0):
+        found = discover_endpoint(self.obs_root, timeout_s=timeout_s)
+        if found is not None:
+            with self._lock:
+                self.port = found[1]
+                self.pid = found[2]
+        return found
+
+
+def wait_first_answer(endpoint, payload, deadline_s, exclude_pid=None):
+    """Poll /match until a 200 (optionally from a pid other than
+    ``exclude_pid`` — the restarted worker, not a zombie of the old
+    one). Returns (elapsed_s, pid)."""
+    t0 = time.perf_counter()
+    deadline = t0 + deadline_s
+    while time.perf_counter() < deadline:
+        endpoint.refresh()
+        if endpoint.port is not None:
+            health = get_json(endpoint.port, '/healthz', timeout_s=2.0)
+            pid = (health[1].get('pid')
+                   if health and isinstance(health[1], dict) else None)
+            if pid is not None and pid != exclude_pid:
+                r = post_match(endpoint.port, payload, timeout_s=30.0)
+                if r is not None and r[0] == 200:
+                    return time.perf_counter() - t0, pid
+        time.sleep(0.2)
+    raise RuntimeError(f'no /match answer within {deadline_s}s '
+                       f'(obs root {endpoint.obs_root})')
+
+
+def compile_events(port):
+    st = get_json(port, '/status', timeout_s=10.0)
+    if not st or not isinstance(st[1], dict):
+        return None
+    return (st[1].get('compile') or {}).get('events')
+
+
+def run_clients(jobs_per_client, endpoint, deadline_s=600.0,
+                progress=None, pace_s=0.0):
+    """N threads, each draining its job list; latencies + hits come
+    back per client. A failed POST (the mid-run kill window) refreshes
+    the endpoint and retries the SAME query until the deadline.
+    ``progress`` (a mutable ``{'done': n}``) lets the driver time the
+    chaos kill against real completions; ``pace_s`` spaces a client's
+    queries so a load phase stays open long enough to be killed into."""
+    results = [[] for _ in jobs_per_client]
+
+    def client(tid):
+        for payload, gt in jobs_per_client[tid]:
+            if pace_s:
+                time.sleep(pace_s)
+            t_end = time.time() + deadline_s
+            while True:
+                port = endpoint.port
+                t0 = time.perf_counter()
+                r = (post_match(port, payload, timeout_s=60.0)
+                     if port else None)
+                if r is not None and r[0] == 200:
+                    lat = time.perf_counter() - t0
+                    hits = sum(
+                        1 for m, t in zip(r[1]['matches'], gt)
+                        if m['target'] == int(t))
+                    results[tid].append(
+                        {'latency_s': lat, 'hits': hits, 'n': len(gt),
+                         'server_ms': r[1].get('latency_ms')})
+                    if progress is not None:
+                        progress['done'] = progress.get('done', 0) + 1
+                    break
+                if time.time() > t_end:
+                    results[tid].append({'failed': True})
+                    break
+                endpoint.refresh()
+                time.sleep(0.2)
+
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(len(jobs_per_client))]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return results, time.perf_counter() - t0
+
+
+def main(argv=None):
+    args = parse_args(argv)
+    work = os.path.abspath(args.workdir)
+    shutil.rmtree(work, ignore_errors=True)
+    os.makedirs(work, exist_ok=True)
+    obs_root = os.path.join(work, 'obs')
+    ckpt_dir = os.path.join(work, 'ckpt')
+
+    serve_cmd = [
+        sys.executable, '-m', 'dgmc_tpu.serve', '--supervise',
+        '--max-restarts', '3', '--restart-backoff', '0.2',
+        '--ckpt_dir', ckpt_dir, '--init-missing',
+        '--corpus-nodes', str(args.corpus_nodes),
+        '--corpus-edges', str(args.corpus_edges),
+        '--corpus-dim', str(args.corpus_dim),
+        '--buckets', args.buckets,
+        '--dim', str(args.dim), '--rnd_dim', str(args.rnd_dim),
+        '--num_layers', str(args.num_layers),
+        '--num_steps', str(args.num_steps), '--k', str(args.k),
+        '--obs-dir', obs_root, '--obs-port', '0',
+        '--watchdog-deadline', '120',
+    ] + (['--offload-corpus'] if args.offload_corpus else [])
+
+    # Query pool: mixed bucket sizes, deterministic, ground truth known.
+    corpus_x = synthetic_corpus(args.corpus_nodes, args.corpus_edges,
+                                args.corpus_dim,
+                                seed=args.seed).x
+    shapes = []
+    for part in args.buckets.split(','):
+        n, e = part.split('x')
+        shapes.append((int(n), int(e)))
+    jobs = [[] for _ in range(args.clients)]
+    for c in range(args.clients):
+        for q in range(args.queries_per_client):
+            n, e = shapes[(c + q) % len(shapes)]
+            g, gt = sample_query(corpus_x, n, e,
+                                 seed=args.seed + 1000 * c + q)
+            jobs[c].append((query_payload(g), gt))
+    probe_payload = jobs[0][0][0]
+
+    print(f'# spawning: {" ".join(serve_cmd)}', file=sys.stderr,
+          flush=True)
+    t_spawn = time.perf_counter()
+    sup = subprocess.Popen(serve_cmd)
+    endpoint = Endpoint(obs_root)
+    try:
+        cold_s, pid_1 = wait_first_answer(endpoint, probe_payload,
+                                          args.startup_timeout)
+        cold_s = round(time.perf_counter() - t_spawn, 3)
+        print(f'# cold startup -> first answer: {cold_s}s (worker pid '
+              f'{pid_1})', file=sys.stderr, flush=True)
+        health = get_json(endpoint.port, '/healthz')[1]
+        gauges_cold = health.get('gauges') or {}
+
+        c_warm = compile_events(endpoint.port)
+        half = [j[:len(j) // 2] for j in jobs]
+        rest = [j[len(j) // 2:] for j in jobs]
+        res1, wall1 = run_clients(half, endpoint)
+        c_after_1 = compile_events(endpoint.port)
+
+        # Chaos: SIGKILL the WORKER (not the supervisor) while phase-2
+        # clients are actively issuing queries — the in-flight and
+        # following queries retry through the restart window and must
+        # land on the restarted worker (re-discovering its port).
+        holder = {}
+        progress = {'done': 0}
+        n_phase2 = sum(len(j) for j in rest)
+        # Pace phase-2 clients so the phase is still open when the kill
+        # lands: every query before the kill answers normally, every one
+        # after rides the retry loop through the restart.
+        pace = max(0.05, 2.0 * (wall1 / max(sum(len(j) for j in half),
+                                            1)))
+
+        def phase2():
+            holder['res'], holder['wall'] = run_clients(
+                rest, endpoint, progress=progress, pace_s=pace)
+
+        th = threading.Thread(target=phase2)
+        th.start()
+        # Kill once a quarter of phase 2 has genuinely completed —
+        # synchronized to real progress, not a sleep race.
+        kill_after = max(1, n_phase2 // 4)
+        t_wait = time.time() + 120
+        while progress['done'] < kill_after and time.time() < t_wait \
+                and th.is_alive():
+            time.sleep(0.02)
+        t_kill = time.perf_counter()
+        os.kill(pid_1, signal.SIGKILL)
+        print(f'# SIGKILL worker {pid_1} (mid-load)', file=sys.stderr,
+              flush=True)
+        warm_s, pid_2 = wait_first_answer(
+            endpoint, probe_payload, args.startup_timeout,
+            exclude_pid=pid_1)
+        warm_s = round(time.perf_counter() - t_kill, 3)
+        print(f'# warm restart -> first answer: {warm_s}s (worker pid '
+              f'{pid_2})', file=sys.stderr, flush=True)
+        health2 = get_json(endpoint.port, '/healthz')[1]
+        gauges_warm = health2.get('gauges') or {}
+
+        c_warm2 = compile_events(endpoint.port)
+        th.join()
+        res2, wall2 = holder['res'], holder['wall']
+        c_after_2 = compile_events(endpoint.port)
+
+        status = get_json(endpoint.port, '/status')[1]
+        health_code, health_final = get_json(endpoint.port, '/healthz')
+        metrics_text = get_json(endpoint.port, '/metrics')[1]
+        # Scrape evidence for out-of-band verification (the CI smoke
+        # strict-parses the exposition and asserts the health verdict
+        # without having to race the live process).
+        with open(os.path.join(work, 'metrics.prom'), 'w') as f:
+            f.write(metrics_text if isinstance(metrics_text, str)
+                    else json.dumps(metrics_text))
+        with open(os.path.join(work, 'healthz.json'), 'w') as f:
+            json.dump({'code': health_code, 'payload': health_final}, f,
+                      indent=1)
+
+        # Graceful teardown: TERM the worker -> rc 0 -> the supervisor
+        # records 'completed' and exits 0 itself.
+        os.kill(pid_2, signal.SIGTERM)
+        rc = sup.wait(timeout=60)
+    finally:
+        if sup.poll() is None:
+            sup.terminate()
+            try:
+                sup.wait(timeout=20)
+            except subprocess.TimeoutExpired:
+                sup.kill()
+
+    with open(os.path.join(obs_root, 'recovery.json')) as f:
+        recovery = json.load(f)
+
+    flat = [r for res in (res1, res2) for c in res for r in c]
+    ok = [r for r in flat if not r.get('failed')]
+    lats = sorted(r['latency_s'] for r in ok)
+    server_ms = sorted(r['server_ms'] for r in ok
+                       if r.get('server_ms') is not None)
+    hits = sum(r['hits'] for r in ok)
+    total_gt = sum(r['n'] for r in ok)
+    steps = (status.get('steps') or {})
+    compiles_load = ((c_after_1 - c_warm)
+                     if None not in (c_after_1, c_warm) else None)
+    compiles_load_2 = ((c_after_2 - c_warm2)
+                       if None not in (c_after_2, c_warm2) else None)
+
+    record = {
+        'family': 'SERVE',
+        'round': args.round,
+        'tool': 'serve_bench.py',
+        'time_unix': round(time.time(), 1),
+        'cmd': serve_cmd,
+        'config': {
+            'corpus_nodes': args.corpus_nodes,
+            'corpus_edges': args.corpus_edges,
+            'corpus_dim': args.corpus_dim,
+            'buckets': args.buckets,
+            'dim': args.dim, 'rnd_dim': args.rnd_dim,
+            'num_layers': args.num_layers,
+            'num_steps': args.num_steps, 'k': args.k,
+            'offload_corpus': bool(args.offload_corpus),
+        },
+        'clients': args.clients,
+        'queries': len(ok),
+        'queries_failed': len(flat) - len(ok),
+        # Headline QPS is the UNINTERRUPTED phase (phase 2 deliberately
+        # absorbs a worker kill + restart and is paced; its effective
+        # rate is reported separately as the availability figure).
+        'qps': round(sum(len(c) for c in res1)
+                     / max(wall1, 1e-9), 2),
+        'qps_through_restart': round(
+            sum(len(c) for c in res2) / max(wall2, 1e-9), 2),
+        'load_wall_s': round(wall1 + wall2, 3),
+        'latency': {
+            'server_p50_ms': (round(percentile(server_ms, 0.5), 3)
+                              if server_ms else None),
+            'server_p95_ms': (round(percentile(server_ms, 0.95), 3)
+                              if server_ms else None),
+            'client_p50_ms': (round(percentile(lats, 0.5) * 1e3, 3)
+                              if lats else None),
+            'client_p95_ms': (round(percentile(lats, 0.95) * 1e3, 3)
+                              if lats else None),
+            'observer_step_p50_ms': (
+                round(steps['p50_s'] * 1e3, 3)
+                if steps.get('p50_s') else None),
+            'observer_step_p95_ms': (
+                round(steps['p95_s'] * 1e3, 3)
+                if steps.get('p95_s') else None),
+        },
+        'hits_at_1': round(hits / total_gt, 4) if total_gt else None,
+        'restart': {
+            'cold_first_answer_s': cold_s,
+            'warm_first_answer_s': warm_s,
+            'warm_beats_cold': warm_s < cold_s,
+            'cold_cache_hit': int(gauges_cold.get('corpus_cache_hit',
+                                                  -1)),
+            'warm_cache_hit': int(gauges_warm.get('corpus_cache_hit',
+                                                  -1)),
+            'killed_pid': pid_1,
+            'restarted_pid': pid_2,
+        },
+        'compiles': {
+            'warmup': c_warm,
+            'during_load_phase1': compiles_load,
+            'warmup_after_restart': c_warm2,
+            'during_load_phase2': compiles_load_2,
+            'per_query': (None if None in (compiles_load,
+                                           compiles_load_2)
+                          else (compiles_load + compiles_load_2)
+                          / max(len(ok), 1)),
+        },
+        'supervision': {
+            'outcome': recovery.get('outcome'),
+            'restarts': recovery.get('restarts'),
+            'supervisor_rc': rc,
+        },
+        'metrics_endpoint_bytes': (len(metrics_text)
+                                   if isinstance(metrics_text, str)
+                                   else None),
+        'healthz_code': health_code,
+    }
+
+    problems = []
+    if record['supervision']['outcome'] != 'completed':
+        problems.append(f"outcome {record['supervision']['outcome']}")
+    if record['supervision']['restarts'] != 1:
+        problems.append(f"restarts {record['supervision']['restarts']}")
+    if record['restart']['warm_cache_hit'] != 1:
+        problems.append('warm restart did not hit the corpus cache')
+    if record['restart']['cold_cache_hit'] != 0:
+        problems.append('cold start unexpectedly hit a cache')
+    if not record['restart']['warm_beats_cold']:
+        problems.append(f'warm {warm_s}s did not beat cold {cold_s}s')
+    if compiles_load is None or compiles_load_2 is None:
+        # A failed /status scrape means the compile account was never
+        # MEASURED — that must read as a failed gate, not as zero.
+        problems.append(f'compile account unmeasured (phase1 '
+                        f'{compiles_load}, phase2 {compiles_load_2}: '
+                        f'a compile-counter scrape failed)')
+    elif compiles_load or compiles_load_2:
+        problems.append(f'per-query compiles: {compiles_load} + '
+                        f'{compiles_load_2} after warmup')
+    if record['queries_failed']:
+        problems.append(f"{record['queries_failed']} queries failed")
+    record['outcome'] = ('completed' if not problems
+                         else f'failed ({"; ".join(problems)})')
+
+    out = json.dumps(record, indent=1)
+    print(out)
+    if args.out:
+        with open(args.out, 'w') as f:
+            f.write(out + '\n')
+        print(f'# wrote {args.out}', file=sys.stderr)
+    return 0 if not problems else 1
+
+
+if __name__ == '__main__':
+    sys.exit(main())

@@ -488,15 +488,17 @@ def test_flight_dump_on_observer_api(tmp_path):
         obs.close()
 
 
-def test_port_collision_degrades_to_no_plane(tmp_path):
-    """Two processes handed the same fixed --obs-port must not die:
-    the loser keeps observing without a plane (telemetry never takes
-    the run down)."""
+def test_port_collision_moves_to_ephemeral(tmp_path):
+    """Two processes handed the same fixed --obs-port must not die —
+    and since the serve subsystem, the loser's plane MOVES to an
+    ephemeral port instead of dropping (tests/obs/test_port_retry.py
+    pins the heartbeat re-advertisement half of the story)."""
     a = _observer(tmp_path / 'a', obs_port=0)
     try:
         b = _observer(tmp_path / 'b', obs_port=a.live_port)
         try:
-            assert b.live_port is None
+            assert b.live_port is not None
+            assert b.live_port != a.live_port
             assert b.enabled
             with b.step():
                 pass                      # still fully functional

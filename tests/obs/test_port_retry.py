@@ -1,0 +1,73 @@
+"""Port-in-use degradation: the live plane MOVES to an ephemeral port
+instead of dropping, re-advertises through heartbeat.json, and the
+supervisor-side scrape follows the moved endpoint. Two processes: the
+squatter owning the requested port is a real separate process, like the
+lingering predecessor worker this bugfix exists for."""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+from dgmc_tpu.obs.live import probe_healthz
+from dgmc_tpu.obs.run import RunObserver
+
+#: Child that binds a port, reports it, and holds it until killed.
+SQUATTER = r'''
+import socket, sys, time
+s = socket.socket()
+s.bind(("", 0))
+s.listen(1)
+print(s.getsockname()[1], flush=True)
+time.sleep(60)
+'''
+
+
+def test_plane_moves_and_heartbeat_readvertises(tmp_path):
+    squatter = subprocess.Popen([sys.executable, '-c', SQUATTER],
+                                stdout=subprocess.PIPE, text=True)
+    try:
+        taken = int(squatter.stdout.readline())
+        obs = RunObserver(str(tmp_path), obs_port=taken,
+                          watchdog_deadline_s=60)
+        try:
+            # The plane survived on a DIFFERENT (ephemeral) port.
+            assert obs.live_port is not None
+            assert obs.live_port != taken
+            # heartbeat.json advertises the MOVED port...
+            hb_path = os.path.join(str(tmp_path), 'heartbeat.json')
+            deadline = time.time() + 10
+            hb = {}
+            while time.time() < deadline:
+                try:
+                    with open(hb_path) as f:
+                        hb = json.load(f)
+                    if hb.get('port'):
+                        break
+                except (OSError, ValueError):
+                    pass
+                time.sleep(0.1)
+            assert hb.get('port') == obs.live_port
+            # ...and the supervisor-style scrape at the advertised port
+            # reaches a healthy plane (this is exactly the discovery
+            # path Supervisor._healthz_verdict walks).
+            res = probe_healthz(hb['port'])
+            assert res is not None
+            code, payload = res
+            assert code == 200 and payload['healthy']
+            assert payload['pid'] == os.getpid()
+        finally:
+            obs.close()
+    finally:
+        squatter.kill()
+        squatter.wait()
+
+
+def test_ephemeral_request_unaffected(tmp_path):
+    obs = RunObserver(str(tmp_path), obs_port=0)
+    try:
+        assert obs.live_port
+        assert probe_healthz(obs.live_port) is not None
+    finally:
+        obs.close()

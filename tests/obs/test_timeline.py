@@ -152,3 +152,79 @@ def test_scale_offload_column(tmp_path):
     (line7,) = [ln for ln in table.splitlines() if ln.strip().
                 startswith('7 ')]
     assert ' - ' in line7
+
+
+def test_serve_family_rows(tmp_path):
+    """SERVE rounds carry their own headline set: query-latency
+    p50/p95, QPS under concurrent load, clients, the warm
+    restart-to-first-answer and the restart count — rendered as their
+    own trajectory section."""
+    _write(tmp_path, 'SERVE_r01.json', {
+        'round': 1,
+        'supervision': {'outcome': 'completed', 'restarts': 1},
+        'latency': {'server_p50_ms': 111.8, 'server_p95_ms': 134.8,
+                    'client_p50_ms': 118.8},
+        'qps': 28.6, 'clients': 4,
+        'restart': {'cold_first_answer_s': 12.7,
+                    'warm_first_answer_s': 10.8,
+                    'warm_beats_cold': True}})
+    rows = collect_rounds([str(tmp_path)])
+    (r,) = rows
+    assert r['family'] == 'SERVE'
+    assert r['latency_p50_ms'] == 111.8
+    assert r['latency_p95_ms'] == 134.8
+    assert r['qps'] == 28.6
+    assert r['clients'] == 4
+    assert r['restarts'] == 1
+    assert r['warm_restart_s'] == 10.8
+    # The chaos kill is part of the protocol: the restart count is a
+    # COLUMN, not an outcome-string warning like the training families.
+    assert r['outcome'] == 'completed'
+    table = render(rows)
+    assert 'SERVE trajectory' in table
+    assert 'restarts' in table and 'QPS' in table
+    (line,) = [ln for ln in table.splitlines()
+               if ln.strip().startswith('1 ')]
+    assert '10.80s' in line
+
+
+def test_serve_falls_back_to_client_latency(tmp_path):
+    _write(tmp_path, 'SERVE_r02.json', {
+        'round': 2, 'supervision': {'outcome': 'completed',
+                                    'restarts': 0},
+        'latency': {'client_p50_ms': 9.0, 'client_p95_ms': 14.0},
+        'qps': 100.0, 'clients': 2})
+    (r,) = collect_rounds([str(tmp_path)])
+    assert r['latency_p50_ms'] == 9.0
+    assert r['latency_p95_ms'] == 14.0
+    render(collect_rounds([str(tmp_path)]))
+
+
+def test_cli_over_committed_serve_round():
+    """The committed SERVE_r01 evidence: a supervised load round with
+    one (deliberate) restart, zero per-query compiles, and the warm
+    restart beating the cold start."""
+    out = subprocess.run(
+        [sys.executable, '-m', 'dgmc_tpu.obs.timeline',
+         'benchmarks', '--json'],
+        cwd=REPO, capture_output=True, text=True)
+    assert out.returncode == 0, out.stderr
+    rows = json.loads(out.stdout)
+    by_key = {(r['family'], r['round']): r for r in rows}
+    serve = by_key[('SERVE', 1)]
+    assert serve['outcome'] == 'completed'
+    assert serve['restarts'] == 1
+    assert serve['clients'] >= 4
+    assert serve['latency_p50_ms'] > 0
+    assert serve['latency_p95_ms'] >= serve['latency_p50_ms']
+    assert serve['qps'] > 0
+    # The round record's own acceptance gates, re-asserted over the
+    # committed file (the CI serve-evidence pin).
+    with open(os.path.join(REPO, 'benchmarks', 'SERVE_r01.json')) as f:
+        rec = json.load(f)
+    assert rec['outcome'] == 'completed'
+    assert rec['compiles']['per_query'] == 0
+    assert rec['restart']['warm_beats_cold'] is True
+    assert rec['restart']['warm_cache_hit'] == 1
+    assert rec['restart']['cold_cache_hit'] == 0
+    assert rec['queries_failed'] == 0

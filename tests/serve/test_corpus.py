@@ -1,0 +1,95 @@
+"""Corpus cache: build → persist → verified hit; stale/corrupt caches
+rebuild instead of serving wrong embeddings."""
+
+import json
+import os
+
+import jax
+import numpy as np
+
+from dgmc_tpu.models import RelCNN
+from dgmc_tpu.serve.corpus import (CACHE_MANIFEST, CACHE_TABLE,
+                                   load_cache, load_or_build,
+                                   params_fingerprint, synthetic_corpus)
+
+
+def _psi1(dim=8, feat=6, seed=0):
+    psi = RelCNN(feat, dim, 1, batch_norm=False, cat=True, lin=True,
+                 dropout=0.0)
+    corpus = synthetic_corpus(20, 40, feat, seed=3)
+    g = corpus.graph_batch(dummy_x=False)
+    params = psi.init(jax.random.key(seed), g.x, g, train=False)['params']
+    return psi, params, corpus
+
+
+def test_build_then_hit(tmp_path):
+    psi, params, corpus = _psi1()
+    cache = str(tmp_path / 'cache')
+    idx1, info1 = load_or_build(cache, psi, params, corpus,
+                                checkpoint_step=7)
+    assert info1['cache'].startswith('miss')
+    assert os.path.exists(os.path.join(cache, CACHE_TABLE))
+    manifest = json.load(open(os.path.join(cache, CACHE_MANIFEST)))
+    assert manifest['checkpoint_step'] == 7
+    assert CACHE_TABLE in manifest['files']
+
+    idx2, info2 = load_or_build(cache, psi, params, corpus,
+                                checkpoint_step=7)
+    assert info2['cache'] == 'hit'
+    np.testing.assert_array_equal(idx1.h_t, idx2.h_t)
+
+
+def test_changed_params_rebuild(tmp_path):
+    psi, params, corpus = _psi1()
+    cache = str(tmp_path / 'cache')
+    load_or_build(cache, psi, params, corpus)
+    _, params2, _ = _psi1(seed=1)
+    assert params_fingerprint(params) != params_fingerprint(params2)
+    _, info = load_or_build(cache, psi, params2, corpus)
+    assert info['cache'] == 'miss:params-mismatch'
+    # ...and the rewritten cache now hits for the NEW params.
+    _, info2 = load_or_build(cache, psi, params2, corpus)
+    assert info2['cache'] == 'hit'
+
+
+def test_changed_corpus_rebuild(tmp_path):
+    psi, params, corpus = _psi1()
+    cache = str(tmp_path / 'cache')
+    load_or_build(cache, psi, params, corpus)
+    corpus2 = synthetic_corpus(20, 40, corpus.feat_dim, seed=99)
+    _, info = load_or_build(cache, psi, params, corpus2)
+    assert info['cache'] == 'miss:corpus-mismatch'
+
+
+def test_corrupt_table_rebuilds(tmp_path):
+    psi, params, corpus = _psi1()
+    cache = str(tmp_path / 'cache')
+    idx, _ = load_or_build(cache, psi, params, corpus)
+    table = os.path.join(cache, CACHE_TABLE)
+    with open(table, 'r+b') as f:
+        f.seek(200)
+        f.write(b'\xff\xff\xff\xff')
+    h, reason = load_cache(cache, corpus.fingerprint(),
+                           params_fingerprint(params))
+    assert h is None and reason == f'sha256-mismatch:{CACHE_TABLE}'
+    idx2, info = load_or_build(cache, psi, params, corpus)
+    assert info['cache'] == 'miss:' + reason
+    np.testing.assert_array_equal(idx.h_t, idx2.h_t)
+
+
+def test_truncated_table_rebuilds(tmp_path):
+    psi, params, corpus = _psi1()
+    cache = str(tmp_path / 'cache')
+    load_or_build(cache, psi, params, corpus)
+    table = os.path.join(cache, CACHE_TABLE)
+    with open(table, 'r+b') as f:
+        f.truncate(os.path.getsize(table) // 2)
+    h, reason = load_cache(cache, corpus.fingerprint(),
+                           params_fingerprint(params))
+    assert h is None and reason == f'size-mismatch:{CACHE_TABLE}'
+
+
+def test_no_cache_dir_always_builds():
+    psi, params, corpus = _psi1()
+    _, info = load_or_build(None, psi, params, corpus)
+    assert info['cache'] == 'miss:disabled'

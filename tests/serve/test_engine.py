@@ -1,0 +1,166 @@
+"""Match engine: serving answers are bit-identical to a full in-graph
+forward under the same checkpoint, across corpus-placement tiers, and
+deterministic across repeats. Also pins DGMC's precomputed-table
+argument contract."""
+
+import jax
+import numpy as np
+import pytest
+
+from dgmc_tpu.models import DGMC, RelCNN
+from dgmc_tpu.serve.client import sample_query
+from dgmc_tpu.serve.corpus import (CorpusIndex, compute_embeddings,
+                                   synthetic_corpus)
+from dgmc_tpu.serve.engine import MatchEngine
+from dgmc_tpu.serve.router import QueryRouter
+
+FEAT, K = 12, 5
+
+
+def _setup(offload=False, num_steps=2):
+    corpus = synthetic_corpus(64, 200, FEAT, seed=0)
+    psi_1 = RelCNN(FEAT, 16, 2, batch_norm=False, cat=True, lin=True,
+                   dropout=0.0)
+    psi_2 = RelCNN(8, 8, 1, batch_norm=False, cat=True, lin=True,
+                   dropout=0.0)
+    model = DGMC(psi_1, psi_2, num_steps=num_steps, k=K)
+    g_t = corpus.graph_batch(dummy_x=False)
+    g_q, _ = sample_query(corpus.x, 6, 14, seed=1)
+    from dgmc_tpu.utils.data import pad_graphs
+    q = pad_graphs([g_q], 8, 16)
+    key = jax.random.key(0)
+    variables = model.init(
+        {'params': key, 'noise': key, 'negatives': key, 'dropout': key},
+        q, g_t, train=False)
+    h_t = compute_embeddings(psi_1, variables['params']['psi_1'], corpus)
+    index = CorpusIndex(corpus, h_t, {})
+    router = QueryRouter([(8, 16)], corpus.num_nodes, corpus.num_edges)
+    engine = MatchEngine(model, variables, index, router, max_results=3,
+                         noise_seed=9, offload=offload, offload_chunk=16)
+    engine.warm()
+    return model, variables, g_t, engine, g_q
+
+
+def _reference_answer(model, variables, engine, g_q):
+    """The full in-graph COMPILED forward (ψ₁ both sides, in-graph
+    search) at the engine's padded shape and noise key — what serving
+    must equal bitwise. Jitted like the engine's executable: eager
+    op-by-op dispatch reassociates float reductions differently from
+    any fused program, so eager-vs-compiled is the one comparison that
+    legitimately differs in the last ulp."""
+    bucket = engine.router.route(g_q.num_nodes, g_q.num_edges)
+    from dgmc_tpu.utils.data import pad_graphs
+    q = pad_graphs([g_q], bucket.nodes, bucket.edges)
+    g_t = engine.index.corpus.graph_batch(dummy_x=False)
+
+    @jax.jit
+    def full(variables, q, g_t, key):
+        S_0, S_L = model.apply(variables, q, g_t, train=False,
+                               rngs={'noise': key})
+        v, p = jax.lax.top_k(S_L.val, 3)
+        return v, jax.numpy.take_along_axis(S_L.idx, p, axis=-1)
+
+    v, i = full(variables, q, g_t, jax.random.key(9))
+    n = g_q.num_nodes
+    return np.asarray(v)[0, :n], np.asarray(i)[0, :n]
+
+
+@pytest.mark.parametrize('offload', [False, True])
+def test_engine_equals_full_forward(offload):
+    model, variables, g_t, engine, g_q = _setup(offload=offload)
+    answer = engine.match(g_q)
+    ref_v, ref_i = _reference_answer(model, variables, engine, g_q)
+    got_i = np.array([[c[0] for c in m['candidates']]
+                      for m in answer['matches']])
+    got_v = np.array([[c[1] for c in m['candidates']]
+                      for m in answer['matches']], np.float32)
+    np.testing.assert_array_equal(got_i, ref_i)
+    np.testing.assert_array_equal(got_v, ref_v.astype(np.float32))
+
+
+@pytest.mark.parametrize('offload', [False, True])
+def test_engine_deterministic_repeats(offload):
+    _, _, _, engine, g_q = _setup(offload=offload)
+    a = engine.match(g_q)
+    b = engine.match(g_q)
+    assert a == b
+    assert engine.query_count == 2
+
+
+@pytest.mark.parametrize('offload', [False, True])
+def test_query_path_is_execute_only_after_warm(offload):
+    """The zero-per-query-compile contract at the engine layer: after
+    warm(), a query triggers NO compile event — including the offload
+    tier's host-driven merge step (_corpus_merge is jitted per shape
+    and must compile during warm(), not on the first live query after
+    a restart)."""
+    from dgmc_tpu.obs.registry import CompileWatcher
+    from dgmc_tpu.ops.offload import _corpus_merge
+    _corpus_merge.cache_clear()     # a prior test must not pre-warm it
+    _, _, _, engine, g_q = _setup(offload=offload)
+    with CompileWatcher() as w:
+        engine.match(g_q)
+        first = w.count()
+        engine.match(g_q)
+    assert first == 0, [e.get('key') for e in w.events]
+    assert w.count() == 0
+
+
+def test_device_and_offload_tiers_agree():
+    _, _, _, dev_engine, g_q = _setup(offload=False)
+    _, _, _, off_engine, _ = _setup(offload=True)
+    a = dev_engine.match(g_q)
+    b = off_engine.match(g_q)
+    assert a['matches'] == b['matches']
+
+
+def test_dense_engine_rejected():
+    corpus = synthetic_corpus(16, 30, FEAT, seed=0)
+    psi_1 = RelCNN(FEAT, 8, 1, batch_norm=False)
+    psi_2 = RelCNN(4, 4, 1, batch_norm=False)
+    model = DGMC(psi_1, psi_2, num_steps=1, k=-1)
+    router = QueryRouter([(8, 16)], 16, 30)
+    with pytest.raises(ValueError, match='sparse'):
+        MatchEngine(model, {}, CorpusIndex(corpus, np.zeros((1, 16, 8)),
+                                           {}), router)
+
+
+def test_feature_width_mismatch_rejected():
+    _, _, _, engine, _ = _setup()
+    from dgmc_tpu.utils.data import Graph
+    bad = Graph(edge_index=np.zeros((2, 0), np.int64),
+                x=np.ones((4, FEAT + 1), np.float32))
+    with pytest.raises(ValueError, match='feature width'):
+        engine.match(bad)
+
+
+def test_model_rejects_bad_precomputed_args():
+    corpus = synthetic_corpus(16, 30, FEAT, seed=0)
+    psi_1 = RelCNN(FEAT, 8, 1, batch_norm=False)
+    psi_2 = RelCNN(4, 4, 1, batch_norm=False)
+    g = corpus.graph_batch(dummy_x=False)
+    key = jax.random.key(0)
+    sparse = DGMC(psi_1, psi_2, num_steps=1, k=3)
+    variables = sparse.init(
+        {'params': key, 'noise': key, 'negatives': key, 'dropout': key},
+        g, g, train=False)
+    S_idx = np.zeros((1, 16, 3), np.int32)
+    cand = np.zeros((1, 16, 3, 8), np.float32)
+    with pytest.raises(ValueError, match='train=False'):
+        sparse.apply(variables, g, g, train=True, S_idx=S_idx,
+                     rngs={'noise': key, 'negatives': key,
+                           'dropout': key})
+    with pytest.raises(ValueError, match='meaningless without'):
+        sparse.apply(variables, g, g, train=False, h_t_cand=cand,
+                     rngs={'noise': key})
+    with pytest.raises(ValueError, match='candidates but the model'):
+        sparse.apply(variables, g, g, train=False,
+                     S_idx=np.zeros((1, 16, 4), np.int32),
+                     h_t_cand=np.zeros((1, 16, 4, 8), np.float32),
+                     rngs={'noise': key})
+    with pytest.raises(ValueError, match='sparse variant'):
+        # The dense variant has no shortlist: precomputed candidate
+        # args must be refused outright.
+        DGMC(psi_1, psi_2, num_steps=1, k=-1).apply(
+            variables, g, g, train=False, S_idx=S_idx,
+            rngs={'noise': key})

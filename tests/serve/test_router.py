@@ -1,0 +1,102 @@
+"""Query router: bucket parsing/routing, the one-definition signature
+contract with the recompile lint (pinned on every registry specimen),
+and the structured unknown-bucket error."""
+
+import jax
+import numpy as np
+import pytest
+
+from dgmc_tpu.analysis import recompile
+from dgmc_tpu.analysis.registry import default_specimens
+from dgmc_tpu.serve import router as router_mod
+from dgmc_tpu.serve.router import (Bucket, QueryRouter,
+                                   UnknownBucketError, parse_buckets)
+
+
+def test_parse_buckets():
+    assert parse_buckets('32x96, 16x48,32x96') == [
+        Bucket(16, 48), Bucket(32, 96)]
+    with pytest.raises(ValueError):
+        parse_buckets('32')
+    with pytest.raises(ValueError):
+        parse_buckets('0x4')
+    with pytest.raises(ValueError):
+        parse_buckets('')
+
+
+def test_route_smallest_fit():
+    r = QueryRouter([(16, 48), (32, 96), (32, 200)], 100, 400)
+    assert r.route(10, 40) == Bucket(16, 48)
+    assert r.route(16, 48) == Bucket(16, 48)
+    assert r.route(17, 48) == Bucket(32, 96)
+    # Fits the node budget of 32x96 but not its edge budget: the
+    # wider-edge declaration wins.
+    assert r.route(20, 150) == Bucket(32, 200)
+
+
+def test_unknown_bucket_is_structured():
+    r = QueryRouter([(16, 48)], 100, 400)
+    with pytest.raises(UnknownBucketError) as ei:
+        r.route(17, 10)
+    payload = ei.value.payload
+    assert payload['error'] == 'unknown-bucket'
+    assert payload['query'] == {'nodes': 17, 'edges': 10}
+    assert payload['buckets'] == ['16x48']
+
+
+def test_signature_is_the_lint_definition():
+    """ONE definition: the router imports the recompile lint's public
+    ``bucket_signature`` — not a copy of it."""
+    assert router_mod.bucket_signature is recompile.bucket_signature
+
+
+def _pair_batch_rows(args):
+    """Padding-bucket telemetry rows a specimen's PairBatch args would
+    collate as (the ``pad_pair_batch`` recording format)."""
+    from dgmc_tpu.utils.data import PairBatch
+    rows = []
+    for leaf in args:
+        if isinstance(leaf, PairBatch):
+            b, n_s = leaf.s.x.shape[0], leaf.s.x.shape[1]
+            n_t, e_s = leaf.t.x.shape[1], leaf.s.senders.shape[1]
+            e_t = leaf.t.senders.shape[1]
+            rows.append({'batch': b, 'nodes': f'{n_s}x{n_t}',
+                         'edges': f'{e_s}x{e_t}'})
+    return rows
+
+
+def test_router_and_lint_agree_on_every_registry_specimen():
+    """The serve router's executable-table key and the recompile lint's
+    churn hash must be the SAME function of the same row — asserted
+    over every registry specimen's actual pair shapes."""
+    checked = 0
+    for spec in default_specimens():
+        if spec.min_devices and jax.device_count() < spec.min_devices:
+            continue
+        built = spec.build()
+        for row in _pair_batch_rows(built.get('args', ())):
+            n_s, n_t = (int(v) for v in row['nodes'].split('x'))
+            e_s, e_t = (int(v) for v in row['edges'].split('x'))
+            r = QueryRouter([(n_s, e_s)], n_t, e_t)
+            bucket = r.route(n_s, e_s)
+            want_row = dict(row, batch=1)
+            assert r.bucket_row(bucket) == want_row
+            assert (r.signature(bucket)
+                    == recompile.bucket_signature(want_row))
+            checked += 1
+    assert checked >= 3, 'registry specimens stopped carrying PairBatch'
+
+
+def test_pad_and_record(tmp_path):
+    from dgmc_tpu.obs.registry import padding_bucket_table
+    from dgmc_tpu.utils.data import Graph
+    r = QueryRouter([(8, 12)], 50, 60)
+    g = Graph(edge_index=np.array([[0, 1], [1, 2]]),
+              x=np.ones((5, 4), np.float32))
+    q = r.pad_query(g, r.route(5, 2))
+    assert q.x.shape == (1, 8, 4)
+    assert q.senders.shape == (1, 12)
+    assert q.node_mask.sum() == 5 and q.edge_mask.sum() == 2
+    rows = [row for row in padding_bucket_table()
+            if row.get('nodes') == '8x50' and row.get('edges') == '12x60']
+    assert rows and rows[0]['count'] >= 1

@@ -1,0 +1,197 @@
+"""The serving worker over real HTTP: /match answers, concurrent-query
+determinism (N threaded clients == sequential, bit for bit), structured
+4xx for unknown buckets and malformed queries, /metrics through the
+strict Prometheus parser, and the warm-restart cache hit."""
+
+import argparse
+import concurrent.futures
+import json
+
+import numpy as np
+import pytest
+
+from dgmc_tpu.serve.client import (get_json, post_match, query_payload,
+                                   sample_query)
+from dgmc_tpu.serve.corpus import synthetic_corpus
+from dgmc_tpu.serve.service import ServeService, add_serve_args
+from tests.obs.test_live import parse_exposition
+
+CORPUS = dict(nodes=256, edges=1024, dim=16)
+
+
+def _args(tmp_path, obs='obs', **over):
+    argv = [
+        '--ckpt_dir', str(tmp_path / 'ckpt'), '--init-missing',
+        '--corpus-nodes', str(CORPUS['nodes']),
+        '--corpus-edges', str(CORPUS['edges']),
+        '--corpus-dim', str(CORPUS['dim']),
+        '--dim', '16', '--rnd_dim', '8', '--num_layers', '1',
+        '--num_steps', '2', '--k', '5', '--buckets', '8x16',
+        '--max-results', '3',
+        '--obs-dir', str(tmp_path / obs), '--obs-port', '0',
+    ]
+    for k, v in over.items():
+        argv += [k] + ([str(v)] if v is not None else [])
+    parser = argparse.ArgumentParser()
+    add_serve_args(parser)
+    return parser.parse_args(argv)
+
+
+@pytest.fixture(scope='module')
+def service(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp('serve')
+    svc = ServeService(_args(tmp)).start()
+    yield svc
+    svc.stop()
+    svc.close()
+
+
+def _query(seed):
+    x = synthetic_corpus(**{'num_nodes': CORPUS['nodes'],
+                            'num_edges': CORPUS['edges'],
+                            'dim': CORPUS['dim']}).x
+    g, gt = sample_query(x, 6, 12, seed=seed)
+    return query_payload(g), gt
+
+
+def test_match_answers(service):
+    payload, gt = _query(0)
+    code, resp = post_match(service.port, payload)
+    assert code == 200
+    assert resp['bucket'] == '8x16'
+    assert resp['nodes'] == 6
+    assert len(resp['matches']) == 6
+    m = resp['matches'][0]
+    assert set(m) == {'node', 'target', 'score', 'candidates', 'initial'}
+    assert len(m['candidates']) == 3
+    # Ranked: candidate probabilities descend.
+    probs = [c[1] for c in m['candidates']]
+    assert probs == sorted(probs, reverse=True)
+    assert 0 <= m['target'] < CORPUS['nodes']
+    assert resp['latency_ms'] > 0
+
+
+def test_concurrent_equals_sequential(service):
+    """The determinism satellite: N threaded clients firing the same
+    query set get answers bit-identical (ties, candidate order, scores
+    — everything but the latency stamp) to the same queries issued
+    sequentially."""
+    queries = [_query(seed)[0] for seed in range(6)]
+
+    def strip(resp):
+        resp = dict(resp)
+        resp.pop('latency_ms')
+        return resp
+
+    sequential = [strip(post_match(service.port, q)[1])
+                  for q in queries]
+    with concurrent.futures.ThreadPoolExecutor(max_workers=6) as ex:
+        rounds = [list(ex.map(
+            lambda q: strip(post_match(service.port, q)[1]), queries))
+            for _ in range(3)]
+    for got in rounds:
+        assert json.dumps(got, sort_keys=True) \
+            == json.dumps(sequential, sort_keys=True)
+
+
+def test_unknown_bucket_is_4xx(service):
+    x = synthetic_corpus(**{'num_nodes': CORPUS['nodes'],
+                            'num_edges': CORPUS['edges'],
+                            'dim': CORPUS['dim']}).x
+    g, _ = sample_query(x, 30, 60, seed=5)      # outside 8x16
+    code, resp = post_match(service.port, query_payload(g))
+    assert code == 400
+    assert resp['error'] == 'unknown-bucket'
+    assert resp['buckets'] == ['8x16']
+    assert resp['query'] == {'nodes': 30, 'edges': 60}
+
+
+def test_unwarmed_bucket_is_structured_503(service):
+    """A routed bucket whose executable is missing (warm() skipped or
+    raced) is a structured 503 — never an inline compile, never a bare
+    500 that loses the payload."""
+    saved = dict(service.engine._exec)
+    service.engine._exec.clear()
+    try:
+        code, resp = post_match(service.port, _query(4)[0])
+    finally:
+        service.engine._exec.update(saved)
+    assert code == 503
+    assert resp['error'] == 'bucket-not-warm'
+    assert '8x16' in resp['detail']
+
+
+def test_malformed_queries_are_4xx(service):
+    import urllib.request
+    req = urllib.request.Request(
+        f'http://127.0.0.1:{service.port}/match', data=b'not json',
+        method='POST')
+    try:
+        urllib.request.urlopen(req, timeout=10)
+        code = 200
+    except urllib.error.HTTPError as e:
+        code = e.code
+        resp = json.loads(e.read())
+    assert code == 400 and resp['error'] == 'bad-query'
+    # Wrong feature width: structured 400, names both widths.
+    code, resp = post_match(service.port,
+                            {'nodes': [[1.0, 2.0]], 'edges': []})
+    assert code == 400
+    assert 'feature width' in resp['detail']
+    # GET on /match: 405 with the schema hint.
+    code, resp = get_json(service.port, '/match')
+    assert code == 405 and 'schema' in resp
+
+
+def test_metrics_strict_parse_and_gauges(service):
+    post_match(service.port, _query(1)[0])
+    code, text = get_json(service.port, '/metrics')
+    assert code == 200
+    families = parse_exposition(text)
+    assert families['dgmc_step_latency_seconds']['type'] == 'histogram'
+    counts = [v for (name, labels, v)
+              in families['dgmc_step_latency_seconds']['samples']
+              if name.endswith('_count')]
+    assert counts and float(counts[0]) >= 1
+    code, health = get_json(service.port, '/healthz')
+    assert code == 200
+    gauges = health['gauges']
+    assert gauges['serve_ready'] == 1
+    assert gauges['serve_buckets_warm'] == 1
+    assert gauges['corpus_cache_hit'] == 0
+    assert gauges['queries_served'] >= 1
+
+
+def test_padding_buckets_in_status(service):
+    """The router records collations in the registry: a recorded serve
+    run's /status (== timings.json) carries the padding-bucket rows the
+    RCP202 compile-churn cross-check reads."""
+    post_match(service.port, _query(2)[0])
+    _, status = get_json(service.port, '/status')
+    rows = status.get('padding_buckets') or []
+    serve_rows = [r for r in rows
+                  if r.get('nodes') == f'8x{CORPUS["nodes"]}']
+    assert serve_rows and serve_rows[0]['count'] >= 1
+
+
+@pytest.mark.slow
+def test_warm_restart_hits_cache(tmp_path):
+    """A second worker over the same checkpoint dir skips the ψ₁ corpus
+    recompute: verified cache hit, gauge exported, loads faster than it
+    builds."""
+    svc1 = ServeService(_args(tmp_path, obs='obs1')).start()
+    assert svc1.cache_info['cache'].startswith('miss')
+    h1 = np.load(tmp_path / 'ckpt' / 'corpus_cache' / 'h_t.npy')
+    svc1.stop()
+    svc1.close()
+    svc2 = ServeService(_args(tmp_path, obs='obs2')).start()
+    try:
+        assert svc2.cache_info['cache'] == 'hit'
+        _, health = get_json(svc2.port, '/healthz')
+        assert health['gauges']['corpus_cache_hit'] == 1
+        np.testing.assert_array_equal(svc2.engine.index.h_t, h1)
+        code, _resp = post_match(svc2.port, _query(3)[0])
+        assert code == 200
+    finally:
+        svc2.stop()
+        svc2.close()
