@@ -129,6 +129,34 @@ def stage_of(op_name: str) -> str:
     return 'other'
 
 
+#: Serving-path span vocabulary (``obs/qtrace.py``): the fixed set of
+#: per-query spans a ``/match`` request decomposes into, in pipeline
+#: order. Lives HERE, next to :data:`STAGE_NAMES`, because the two
+#: vocabularies must reconcile rather than fork: each span that wraps
+#: device work maps onto the model stages via
+#: :data:`SERVE_SPAN_STAGES`, so the static cost account, the profiler
+#: trace, and the served span tree all speak one dialect.
+SERVE_SPAN_NAMES = ('admission_queue_wait', 'bucket_resolve',
+                    'pad_and_stage', 'device_execute', 'shortlist_merge',
+                    'consensus_rerank', 'serialize')
+
+#: Which model stages (:data:`STAGE_NAMES` members) each serve span
+#: covers. Host-only spans (queueing, routing, padding, JSON) map to
+#: the empty tuple — they have no device-stage twin by construction.
+#: ``device_execute`` is the fused forward on the device corpus tier;
+#: the host-offload tier splits it from the candidate gather
+#: (``shortlist_merge``) and the rerank (``consensus_rerank``).
+SERVE_SPAN_STAGES = {
+    'admission_queue_wait': (),
+    'bucket_resolve': (),
+    'pad_and_stage': (),
+    'device_execute': ('psi1', 'initial_corr', 'topk'),
+    'shortlist_merge': ('topk',),
+    'consensus_rerank': ('consensus_iter', 'psi2'),
+    'serialize': (),
+}
+
+
 # ---------------------------------------------------------------------------
 # Structured HLO module parsing
 # ---------------------------------------------------------------------------

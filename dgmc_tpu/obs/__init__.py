@@ -83,6 +83,15 @@ measurements come from:
   (``--min-measured-overlap``, ``--max-idle-regression``). Device-less
   captures degrade to host-track attribution with device fields
   marked unavailable.
+- :mod:`~dgmc_tpu.obs.qtrace` — per-query tracing for the serve path:
+  W3C ``traceparent`` adoption/minting, span trees over a fixed stage
+  vocabulary shared with the static/measured planes, deterministic
+  bounded retention (slowest-K reservoir + every error + seeded
+  sample) into ``qtrace.jsonl``, per-stage ``/metrics`` histograms,
+  and ``python -m dgmc_tpu.obs.qtrace <obs-dir>`` attributing the
+  serve p95−p50 tail gap to a named stage (``--chrome`` exports span
+  trees beside profiler captures; ``obs.diff`` gates per-stage p95
+  via ``--max-stage-p95-regression``).
 
 Model code carries :func:`jax.named_scope` annotations for the matching
 pipeline's stages (``psi1``, ``initial_corr``, ``topk``,

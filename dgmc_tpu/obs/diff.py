@@ -84,6 +84,12 @@ regression thresholds:
   ``obs.aggregate``) growing past ``--max-skew-regression`` fails;
   runs without aggregation skip the row (the artifact is produced by a
   separate tool, so absence is not evidence of regression).
+- **serve stage p95** — each serve stage's p95 latency
+  (``qtrace_summary.json``, see ``obs.qtrace``) growing past
+  ``--max-stage-p95-regression`` fails. Off unless configured (like
+  ``--min-overlap``): training runs carry no qtrace account. When on,
+  a serving candidate that LOST the per-stage account the baseline had
+  fails — tail-latency attribution is itself a gated artifact.
 
 When a gated key is absent from one side, the row's note names WHICH
 run lacks it and lists the gated keys that run *does* carry, so a CI
@@ -121,6 +127,9 @@ DEFAULT_THRESHOLDS = {
     #: Absolute measured-overlap floor (obs.attribution); None = gate
     #: off unless asked, same contract as min_overlap.
     'min_measured_overlap': None,
+    #: Serve per-stage p95 regression (qtrace_summary.json); None =
+    #: gate off unless asked — training runs carry no qtrace account.
+    'stage_p95': None,
     'idle': 0.25,
     #: Logged metrics whose FINAL values must be exactly equal between
     #: the runs (tuple of keys; empty = gate off). The
@@ -491,6 +500,47 @@ def diff_runs(a, b, thresholds=None, allow_kernel_fallback=False):
                          or 'absent',
                          None, None, status, note))
 
+    # -- serve per-stage latency (qtrace) ---------------------------------
+    # Gate only when configured (like min_overlap): training runs have
+    # no qtrace summary, and a default-on gate would spuriously skip or
+    # fail every non-serving diff. When on, the lost-account rule
+    # applies: a serving candidate that stopped producing the per-stage
+    # account the baseline had fails — the attribution layer is itself
+    # a gated artifact.
+    sthr = thr.get('stage_p95')
+    if sthr is not None:
+        qa = a.get('qtrace_stages') or {}
+        qb = b.get('qtrace_stages') or {}
+        if not qa:
+            rows.append(_row('qtrace_stages', None, len(qb) or None,
+                             None, sthr, 'skipped',
+                             'baseline has no qtrace stage account'))
+        elif not qb:
+            rows.append(_row('qtrace_stages', len(qa), None, None, sthr,
+                             'REGRESSION',
+                             'candidate lost the qtrace stage account '
+                             'the baseline had'))
+        else:
+            for stage in sorted(qa):
+                pa95 = (qa[stage] or {}).get('p95_ms')
+                sb = qb.get(stage) or {}
+                pb95 = sb.get('p95_ms')
+                key = f'qtrace[{stage}].p95_ms'
+                if pa95 is None:
+                    continue
+                if pb95 is None:
+                    rows.append(_row(key, pa95, None, None, sthr,
+                                     'REGRESSION',
+                                     'stage account missing from '
+                                     'candidate'))
+                    continue
+                d = _rel(pa95, pb95)
+                if d is None:
+                    rows.append(_row(key, pa95, pb95, None, sthr,
+                                     'skipped', 'zero baseline'))
+                    continue
+                gate(key, pa95, pb95, round(d, 4), sthr, d > sthr)
+
     # -- probes -----------------------------------------------------------
     fn = b.get('first_nonfinite')
     if fn:
@@ -627,6 +677,15 @@ def main(argv=None):
                              '(recovery.json; a candidate whose '
                              'supervisor gave up fails unconditionally; '
                              'default %(default)s)')
+    parser.add_argument('--max-stage-p95-regression', type=float,
+                        default=DEFAULT_THRESHOLDS['stage_p95'],
+                        metavar='FRAC',
+                        help='allowed fractional increase of each serve '
+                             'stage\'s p95 latency '
+                             '(qtrace_summary.json; off unless set — '
+                             'training runs carry no qtrace account; a '
+                             'serving candidate that lost a stage '
+                             'account the baseline had fails)')
     parser.add_argument('--require-equal', type=str, default=None,
                         metavar='KEY[,KEY...]',
                         help='comma-separated logged-metric keys whose '
@@ -669,6 +728,7 @@ def main(argv=None):
             'min_overlap': args.min_overlap,
             'static_peak': args.max_peak_regression,
             'min_measured_overlap': args.min_measured_overlap,
+            'stage_p95': args.max_stage_p95_regression,
             'idle': args.max_idle_regression,
             'require_equal': tuple(
                 k.strip() for k in (args.require_equal or '').split(',')

@@ -44,6 +44,7 @@ monitor processes.
 import bisect
 import collections
 import http.server
+import inspect
 import json
 import math
 import os
@@ -347,6 +348,24 @@ def histogram_family(name, help_text, hist_snapshot):
 # HTTP plane
 # ---------------------------------------------------------------------------
 
+def _accepts_headers(handler):
+    """Whether a route handler declares the optional third positional
+    parameter (request headers). Decided ONCE at mount time from the
+    signature — never by catching ``TypeError`` at call time, which
+    would mask genuine arity bugs inside the handler."""
+    try:
+        sig = inspect.signature(handler)
+    except (TypeError, ValueError):
+        return True
+    params = list(sig.parameters.values())
+    if any(p.kind == p.VAR_POSITIONAL for p in params):
+        return True
+    positional = [p for p in params
+                  if p.kind in (p.POSITIONAL_ONLY,
+                                p.POSITIONAL_OR_KEYWORD)]
+    return len(positional) >= 3
+
+
 class TelemetryServer:
     """Threaded HTTP server for the three endpoints (plus app routes).
 
@@ -372,6 +391,15 @@ class TelemetryServer:
             unknown-bucket 400). A handler that *raises* still yields
             the generic 500, like the telemetry callbacks.
 
+            A handler that declares a THIRD positional parameter is
+            additionally passed the request headers as a lowercase-keyed
+            dict (``handler(method, body_bytes, headers)``) — how the
+            serve plane receives ``traceparent`` — and any handler may
+            return a 3-tuple ``(status_code, payload_dict,
+            response_headers_dict)`` to attach extra response headers
+            (the trace-context echo). Two-argument handlers and
+            2-tuple returns keep working unchanged.
+
     A callback that raises yields a 500 carrying the error text; the
     serving thread itself must survive anything the callbacks do.
     """
@@ -384,6 +412,8 @@ class TelemetryServer:
         self._metrics_fn = metrics_fn
         self._status_fn = status_fn
         self._routes = dict(routes or {})
+        self._route_takes_headers = {
+            path: _accepts_headers(fn) for path, fn in self._routes.items()}
         self._server = None
         self._thread = None
         self.port = None
@@ -398,18 +428,21 @@ class TelemetryServer:
             def log_message(self, *args):   # no stderr chatter per scrape
                 pass
 
-            def _respond(self, code, body, ctype):
+            def _respond(self, code, body, ctype, extra_headers=None):
                 data = body.encode('utf-8')
                 self.send_response(code)
                 self.send_header('Content-Type', ctype)
                 self.send_header('Content-Length', str(len(data)))
+                for k, v in (extra_headers or {}).items():
+                    self.send_header(str(k), str(v))
                 self.end_headers()
                 self.wfile.write(data)
 
-            def _json(self, code, payload):
+            def _json(self, code, payload, extra_headers=None):
                 self._respond(code, json.dumps(_json_safe(payload),
                                                indent=1),
-                              'application/json; charset=utf-8')
+                              'application/json; charset=utf-8',
+                              extra_headers)
 
             def _endpoints(self):
                 return (['/healthz', '/metrics', '/status']
@@ -421,8 +454,19 @@ class TelemetryServer:
                     if path in plane._routes:
                         n = int(self.headers.get('Content-Length') or 0)
                         body = self.rfile.read(n) if n else b''
-                        code, payload = plane._routes[path](method, body)
-                        self._json(code, payload)
+                        handler = plane._routes[path]
+                        if plane._route_takes_headers.get(path):
+                            hdrs = {k.lower(): v
+                                    for k, v in self.headers.items()}
+                            out = handler(method, body, hdrs)
+                        else:
+                            out = handler(method, body)
+                        if len(out) == 3:
+                            code, payload, resp_hdrs = out
+                        else:
+                            code, payload = out
+                            resp_hdrs = None
+                        self._json(code, payload, resp_hdrs)
                     elif method != 'GET':
                         self._json(405, {
                             'error': f'{method} not supported on {path}',

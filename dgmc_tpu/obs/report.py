@@ -90,6 +90,8 @@ def load_run(path):
             'flight': _read_json(os.path.join(path, 'flight.json')),
             'attribution': _read_json(
                 os.path.join(path, 'attribution.json')),
+            'qtrace': _read_json(
+                os.path.join(path, 'qtrace_summary.json')),
         }
         if run['timings'] is None and not run['metrics']:
             from dgmc_tpu.resilience.supervisor import (ATTEMPT_PREFIX,
@@ -129,7 +131,7 @@ def load_run(path):
     return {'path': path, 'metrics': _read_jsonl(path), 'timings': None,
             'memory': None, 'dispatch': None, 'efficiency': None,
             'aggregate': None, 'hang': None, 'recovery': None,
-            'flight': None, 'attribution': None}
+            'flight': None, 'attribution': None, 'qtrace': None}
 
 
 def peak_memory(memory):
@@ -256,6 +258,29 @@ def summarize(run):
         if meas:
             out['measured_device_available'] = meas.get(
                 'device_available')
+
+    qtrace = run.get('qtrace')
+    if qtrace:
+        # The serve plane's per-query account: per-stage quantiles for
+        # the diff's --max-stage-p95-regression gate, plus the gap
+        # attribution headline the timeline's SERVE rows render.
+        out['qtrace_queries'] = qtrace.get('queries')
+        out['qtrace_errors'] = qtrace.get('errors')
+        e2e = qtrace.get('end_to_end') or {}
+        for key in ('p50_ms', 'p95_ms', 'p99_ms'):
+            if e2e.get(key) is not None:
+                out[f'qtrace_{key}'] = e2e[key]
+        stages = qtrace.get('stages') or {}
+        if stages:
+            out['qtrace_stages'] = {
+                name: {k: q.get(k) for k in
+                       ('count', 'p50_ms', 'p95_ms', 'p99_ms')}
+                for name, q in stages.items()}
+        gap = qtrace.get('gap_attribution') or {}
+        if gap.get('dominant_stage'):
+            out['qtrace_dominant_stage'] = gap['dominant_stage']
+        if gap.get('p95_minus_p50_ms') is not None:
+            out['qtrace_gap_ms'] = gap['p95_minus_p50_ms']
 
     flight = run.get('flight')
     if flight:

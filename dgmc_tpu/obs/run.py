@@ -192,6 +192,7 @@ class RunObserver:
         self._live_hist = None
         self._server = None
         self._live_gauges = {}
+        self._metrics_providers = []
         self._last_efficiency = None
         self._last_activity = time.time()
         self._dispatch_sink = None
@@ -854,7 +855,24 @@ class RunObserver:
                 families.append((
                     f'dgmc_{name}', 'gauge',
                     f'Run-published gauge {name}.', [('', {}, value)]))
+        for provider in self._metrics_providers:
+            families.extend(provider() or [])
         return live.prometheus_exposition(families)
+
+    def add_metrics_provider(self, provider):
+        """Register a 0-arg callable returning extra metric families
+        (the ``prometheus_exposition`` ``(name, type, help, samples)``
+        shape) appended to every ``/metrics`` scrape — how subsystems
+        with their own labelled counters (the serve plane's per-class
+        query errors and per-stage qtrace histograms) join the
+        exposition without the observer knowing their schema. A
+        provider that raises fails the scrape with the generic 500,
+        exactly like the built-in callbacks."""
+        if not callable(provider):
+            raise TypeError(f'metrics provider must be callable: '
+                            f'{provider!r}')
+        self._metrics_providers.append(provider)
+        return self
 
     def _watchdog_context(self):
         """Run-state snapshot for the hang report (called from the

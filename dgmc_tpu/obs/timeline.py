@@ -142,11 +142,17 @@ def parse_round(family, number, path):
         # 1 restart is the healthy shape, not a regression).
         lat = d.get('latency') or {}
         restart = d.get('restart') or {}
+        # r02+ rounds carry a per-query trace account (obs.qtrace):
+        # p99 and the stage the p95−p50 gap attributes to. Older
+        # rounds simply lack the block — the columns render '-'.
+        qt = d.get('qtrace') or {}
         row.update({
             'latency_p50_ms': _first(lat.get('server_p50_ms'),
                                      lat.get('client_p50_ms')),
             'latency_p95_ms': _first(lat.get('server_p95_ms'),
                                      lat.get('client_p95_ms')),
+            'latency_p99_ms': qt.get('p99_ms'),
+            'dominant_stage': qt.get('dominant_stage'),
             'qps': d.get('qps'),
             'clients': d.get('clients'),
             'restarts': _first(_get(d, 'supervision', 'restarts'), 0),
@@ -202,23 +208,28 @@ def _fmt_offload(off):
 
 def _render_serve(fam_rows, lines):
     """SERVE rows carry a different headline set than the training
-    families: per-query latency p50/p95, sustained QPS, concurrent
-    clients, warm restart-to-first-answer, restart count."""
+    families: per-query latency p50/p95/p99, sustained QPS, concurrent
+    clients, warm restart-to-first-answer, restart count, and the
+    stage the tail gap attributes to (``obs.qtrace``; rounds predating
+    the trace account render '-')."""
     lines.append('== SERVE trajectory ==')
-    lines.append(f'  {"round":>5} {"p50":>9} {"p95":>9} {"QPS":>7} '
-                 f'{"clients":>7} {"warm rta":>9} {"restarts":>8}'
-                 f'  outcome')
+    lines.append(f'  {"round":>5} {"p50":>9} {"p95":>9} {"p99":>9} '
+                 f'{"QPS":>7} {"clients":>7} {"warm rta":>9} '
+                 f'{"restarts":>8} {"tail stage":>16}  outcome')
     for r in fam_rows:
         p50 = r.get('latency_p50_ms')
         p95 = r.get('latency_p95_ms')
+        p99 = r.get('latency_p99_ms')
         lines.append(
             f'  {r["round"]:>5} '
             f'{fmt_seconds(p50 / 1e3) if p50 is not None else "-":>9} '
             f'{fmt_seconds(p95 / 1e3) if p95 is not None else "-":>9} '
+            f'{fmt_seconds(p99 / 1e3) if p99 is not None else "-":>9} '
             f'{_fmt(r.get("qps")):>7} '
             f'{_fmt(r.get("clients"), "{:d}"):>7} '
             f'{_fmt(r.get("warm_restart_s"), "{:.2f}s"):>9} '
-            f'{_fmt(r.get("restarts"), "{:d}"):>8}'
+            f'{_fmt(r.get("restarts"), "{:d}"):>8} '
+            f'{r.get("dominant_stage") or "-":>16}'
             f'  {r.get("outcome", "?")}')
 
 

@@ -52,7 +52,8 @@ from typing import Dict, List, Tuple
 # Shared vocabulary with the static models: the SAME scope names the
 # lowered HLO pins and the SAME collective-op table the SHD/SCH tiers
 # walk. (hlo_comm is pure text analysis — no jax import.)
-from dgmc_tpu.analysis.hlo_comm import (COLLECTIVE_OPS, STAGE_NAMES,
+from dgmc_tpu.analysis.hlo_comm import (COLLECTIVE_OPS, SERVE_SPAN_NAMES,
+                                        SERVE_SPAN_STAGES, STAGE_NAMES,
                                         stage_of)
 
 __all__ = [
@@ -60,6 +61,7 @@ __all__ = [
     'build_tracks', 'merge_intervals', 'sum_intervals',
     'intersect_intervals', 'event_stage', 'is_comm_event',
     'is_host_wait_event', 'STAGE_NAMES', 'COLLECTIVE_OPS',
+    'SERVE_SPAN_NAMES', 'SERVE_SPAN_STAGES',
 ]
 
 

@@ -48,23 +48,50 @@ def query_payload(graph):
             'edges': np.asarray(graph.edge_index).T.tolist()}
 
 
-def post_match(port, payload, host='127.0.0.1', timeout_s=60.0):
+def post_match(port, payload, host='127.0.0.1', timeout_s=60.0,
+               traceparent=None, qtrace=None):
     """POST one query; returns ``(status_code, response_dict)`` or
-    ``None`` when the endpoint is unreachable."""
+    ``None`` when the endpoint is unreachable.
+
+    ``traceparent`` propagates a W3C trace context to the worker (the
+    server echoes the id back — in the payload's ``trace_id`` and the
+    response ``traceparent`` header, surfaced as
+    ``response['server_traceparent']``). ``qtrace=False`` sends
+    ``x-qtrace: off``, opting this one request out of tracing (the
+    bench's overhead-measurement path). The client-observed wall time
+    is attached as ``response['client_ms']`` so callers can account
+    client-vs-server latency skew per query: ``client_ms`` minus the
+    server's ``trace_ms`` is the wire + HTTP + JSON overhead the
+    server-side span tree cannot see."""
     body = json.dumps(payload).encode('utf-8')
+    headers = {'Content-Type': 'application/json'}
+    if traceparent:
+        headers['traceparent'] = traceparent
+    if qtrace is False:
+        headers['x-qtrace'] = 'off'
     req = urllib.request.Request(
         f'http://{host}:{int(port)}/match', data=body,
-        headers={'Content-Type': 'application/json'}, method='POST')
+        headers=headers, method='POST')
+    t0 = time.perf_counter()
     try:
         with urllib.request.urlopen(req, timeout=timeout_s) as resp:
-            return resp.status, json.loads(resp.read().decode('utf-8'))
+            out = json.loads(resp.read().decode('utf-8'))
+            code = resp.status
+            echoed = resp.headers.get('traceparent')
     except urllib.error.HTTPError as e:
         try:
-            return e.code, json.loads(e.read().decode('utf-8'))
+            out = json.loads(e.read().decode('utf-8'))
         except Exception:
-            return e.code, {}
+            out = {}
+        code = e.code
+        echoed = e.headers.get('traceparent') if e.headers else None
     except Exception:
         return None
+    if isinstance(out, dict):
+        out['client_ms'] = round((time.perf_counter() - t0) * 1e3, 3)
+        if echoed:
+            out['server_traceparent'] = echoed
+    return code, out
 
 
 def get_json(port, path, host='127.0.0.1', timeout_s=10.0):

@@ -29,12 +29,20 @@ and the per-query latency histogram must measure execution, not lock
 convoys racing the accelerator.
 """
 
+import contextlib
 import threading
 import time
 
 import numpy as np
 
 __all__ = ['MatchEngine']
+
+
+@contextlib.contextmanager
+def _null_span(name):
+    """Span sink for untraced calls (warmup, tests, tracing opt-out):
+    the query path reads identically with tracing on or off."""
+    yield
 
 
 class MatchEngine:
@@ -240,7 +248,7 @@ class MatchEngine:
 
     # -- the query path ----------------------------------------------------
 
-    def match(self, graph):
+    def match(self, graph, trace=None):
         """Answer one query :class:`~dgmc_tpu.utils.data.Graph`.
 
         Routes, pads, executes the bucket's warm executable, and
@@ -249,46 +257,67 @@ class MatchEngine:
         outside the declared bucket space and :class:`ValueError` for a
         malformed one — both map to structured 4xx at the HTTP layer.
         Thread-safe; execution is serialized (see module docstring).
+
+        ``trace`` is an optional :class:`~dgmc_tpu.obs.qtrace.
+        QueryTrace`: each phase of the query path runs under its span
+        from the shared serve vocabulary, including the lock acquire
+        (``admission_queue_wait``) — the convoy the latency histogram
+        deliberately excludes is exactly what the trace must expose.
         """
-        if graph.x is None:
-            raise ValueError('query graphs need node features x')
-        if graph.x.shape[1] != self.index.corpus.feat_dim:
-            raise ValueError(
-                f'query feature width {graph.x.shape[1]} != corpus '
-                f'feature width {self.index.corpus.feat_dim}')
-        n_real = graph.num_nodes
-        bucket = self.router.route(n_real, graph.num_edges)
-        sig = self.router.signature(bucket)
-        info = self._exec.get(sig)
-        if info is None:
-            raise UnknownExecutableError(bucket, sig)
-        q = self.router.pad_query(graph, bucket)
-        with self._lock:
+        span = trace.span if trace is not None else _null_span
+        with span('bucket_resolve'):
+            if graph.x is None:
+                raise ValueError('query graphs need node features x')
+            if graph.x.shape[1] != self.index.corpus.feat_dim:
+                raise ValueError(
+                    f'query feature width {graph.x.shape[1]} != corpus '
+                    f'feature width {self.index.corpus.feat_dim}')
+            n_real = graph.num_nodes
+            bucket = self.router.route(n_real, graph.num_edges)
+            sig = self.router.signature(bucket)
+            info = self._exec.get(sig)
+            if info is None:
+                raise UnknownExecutableError(bucket, sig)
+        with span('pad_and_stage'):
+            q = self.router.pad_query(graph, bucket)
+        with span('admission_queue_wait'):
+            self._lock.acquire()
+        try:
             obs = self._obs
             step = obs.step() if obs is not None else _null()
             t0 = time.perf_counter()
             with step:
-                out = self._execute(info, q)
-                out = {k: np.asarray(v) for k, v in out.items()}
+                out = self._execute(info, q, span)
             self.last_latency_s = time.perf_counter() - t0
             info['queries'] += 1
             self.query_count += 1
-        return self._answer(bucket, n_real, out)
+        finally:
+            self._lock.release()
+        with span('serialize'):
+            return self._answer(bucket, n_real, out)
 
-    def _execute(self, info, q):
+    def _execute(self, info, q, span=_null_span):
         import jax
-        q = jax.device_put(q, self._device)
+        with span('pad_and_stage'):
+            q = jax.device_put(q, self._device)
         if not self.offload:
-            return info['exec'](self._variables, q, self._t_graph,
-                                self._h_t_dev, self._noise_key)
+            with span('device_execute'):
+                out = info['exec'](self._variables, q, self._t_graph,
+                                   self._h_t_dev, self._noise_key)
+                return {k: np.asarray(v) for k, v in out.items()}
         from dgmc_tpu.ops.offload import offloaded_corpus_topk
-        h_s = info['embed'](self._psi1_vars(), q)
-        _vals, idx, _stats = offloaded_corpus_topk(
-            h_s, self._h_t_host, self.model.k, self.offload_chunk,
-            depth=self.prefetch_depth, device=self._device)
-        h_t_cand = self._h_t_host[0][idx[0]][None]
-        return info['exec'](self._variables, q, self._t_graph, idx,
-                            h_t_cand, self._noise_key)
+        with span('device_execute'):
+            h_s = info['embed'](self._psi1_vars(), q)
+            _vals, idx, _stats = offloaded_corpus_topk(
+                h_s, self._h_t_host, self.model.k, self.offload_chunk,
+                depth=self.prefetch_depth, device=self._device)
+        with span('shortlist_merge'):
+            idx_host = np.asarray(idx)
+            h_t_cand = self._h_t_host[0][idx_host[0]][None]
+        with span('consensus_rerank'):
+            out = info['exec'](self._variables, q, self._t_graph, idx,
+                               h_t_cand, self._noise_key)
+            return {k: np.asarray(v) for k, v in out.items()}
 
     def _answer(self, bucket, n_real, out):
         matches = []

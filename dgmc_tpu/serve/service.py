@@ -26,6 +26,7 @@ gets restarted.
 """
 
 import argparse
+import collections
 import json
 import os
 import signal
@@ -35,10 +36,17 @@ import time
 
 import numpy as np
 
+from dgmc_tpu.obs.qtrace import QueryTracer
 from dgmc_tpu.serve.router import (DEFAULT_BUCKETS, QueryRouter,
                                    UnknownBucketError, parse_buckets)
 
-__all__ = ['ServeService', 'add_serve_args', 'main']
+__all__ = ['ServeService', 'add_serve_args', 'main', 'ERROR_CLASSES']
+
+#: Per-class query-error labels in the Prometheus exposition
+#: (``dgmc_query_errors_total{class=...}``): HTTP code + cause, every
+#: class pre-seeded at 0 so scrapers always see the full label set.
+ERROR_CLASSES = ('bad-query-400', 'bucket-miss-400', 'method-405',
+                 'engine-500', 'warming-503', 'bucket-not-warm-503')
 
 
 def add_serve_args(parser):
@@ -121,6 +129,26 @@ def add_serve_args(parser):
                              'serving is deterministic — identical '
                              'queries get bit-identical answers')
     parser.add_argument('--seed', type=int, default=0)
+    parser.add_argument('--qtrace-sample', '--qtrace_sample',
+                        dest='qtrace_sample', type=float, default=0.05,
+                        help='deterministic keep fraction for per-query '
+                             'span trees beyond the slowest-K reservoir '
+                             'and errors (hash of seed+trace id, not '
+                             'random; default %(default)s)')
+    parser.add_argument('--qtrace-slowest', '--qtrace_slowest',
+                        dest='qtrace_slowest', type=int, default=8,
+                        help='always-keep reservoir: the K slowest '
+                             'queries (default %(default)s)')
+    parser.add_argument('--qtrace-capacity', '--qtrace_capacity',
+                        dest='qtrace_capacity', type=int, default=256,
+                        help='sampled-ring bound; qtrace.jsonl holds at '
+                             'most capacity + error ring + K records '
+                             '(default %(default)s)')
+    parser.add_argument('--slo-ms', '--slo_ms', dest='slo_ms',
+                        type=float, default=0.0,
+                        help='end-to-end query SLO in ms; a breaching '
+                             'query dumps the flight recorder with its '
+                             'span tree attached (0 = off)')
     from dgmc_tpu.obs import add_obs_flag
     from dgmc_tpu.resilience import add_supervisor_args
     add_obs_flag(parser)
@@ -151,12 +179,24 @@ class ServeService:
         self.ready = False
         self.phases = {}
         self.queries_served = 0
-        self.query_errors = 0
+        self.query_errors = collections.Counter(
+            {cls: 0 for cls in ERROR_CLASSES})
         # Handler threads (ThreadingHTTPServer: one per request) bump
         # these outside the engine's execution lock — the non-atomic
         # += needs its own lock or concurrent clients lose increments.
         self._counts = threading.Lock()
         self._stop = threading.Event()
+        self.qtracer = None
+        if getattr(args, 'obs_dir', None):
+            slo_ms = getattr(args, 'slo_ms', 0.0) or 0.0
+            self.qtracer = QueryTracer(
+                path=os.path.join(args.obs_dir, 'qtrace.jsonl'),
+                sample_rate=getattr(args, 'qtrace_sample', 0.05),
+                slowest_k=getattr(args, 'qtrace_slowest', 8),
+                capacity=getattr(args, 'qtrace_capacity', 256),
+                seed=getattr(args, 'seed', 0),
+                slo_s=(slo_ms / 1e3) if slo_ms > 0 else None,
+                on_breach=self._on_slo_breach)
 
     # -- startup -----------------------------------------------------------
 
@@ -173,6 +213,7 @@ class ServeService:
                                watchdog_deadline_s=args.watchdog_deadline,
                                obs_port=args.obs_port,
                                routes={'/match': self.handle_match})
+        self.obs.add_metrics_provider(self._serve_metric_families)
         self.port = self.obs.live_port
         obs = self.obs
 
@@ -287,21 +328,79 @@ class ServeService:
         w = self.obs._watcher
         return (w.summary() or {}).get('events', 0) if w else 0
 
-    def _count_error(self):
+    def _count_error(self, cls):
         with self._counts:
-            self.query_errors += 1
+            self.query_errors[cls] += 1
+
+    def _on_slo_breach(self, record):
+        """SLO-breach hook: dump the flight recorder NOW with the
+        offending span tree attached — the trailing run context and
+        the slow query's own decomposition in one artifact."""
+        obs = self.obs
+        if obs is not None:
+            obs.flight_dump('slo-breach', extra={'qtrace': record})
+
+    def _serve_metric_families(self):
+        """Serve-plane metric families for the observer's ``/metrics``
+        exposition: per-class error counters plus the qtrace per-stage
+        histograms and retention counters."""
+        with self._counts:
+            errors = dict(self.query_errors)
+        families = [(
+            'dgmc_query_errors_total', 'counter',
+            'Query errors by class (HTTP code + cause).',
+            [('', {'class': cls}, errors.get(cls, 0))
+             for cls in ERROR_CLASSES])]
+        if self.qtracer is not None:
+            families.extend(self.qtracer.metric_families())
+        return families
 
     # -- the /match route --------------------------------------------------
 
-    def handle_match(self, method, body):
-        """``(method, body bytes) -> (code, payload)`` for the plane's
-        route table. Every failure is structured: 405 wrong method, 503
-        warming up, 400 malformed / unknown bucket, 500 engine fault."""
+    def handle_match(self, method, body, headers=None):
+        """``(method, body bytes, headers) -> (code, payload[,
+        headers])`` for the plane's route table. Every failure is
+        structured AND counted per class: 405 wrong method, 503 warming
+        up / bucket not warm, 400 malformed / unknown bucket, 500
+        engine fault.
+
+        Every request gets a trace: the W3C ``traceparent`` header is
+        adopted when present (and echoed back in the response headers),
+        otherwise a deterministic id is minted. Successful answers
+        carry ``trace_id`` + per-stage ``stages_ms`` + the end-to-end
+        ``trace_ms``; the ``x-qtrace: off`` header opts one request out
+        entirely (the bench's overhead-measurement path)."""
+        headers = headers or {}
+        tracer = self.qtracer
+        if tracer is not None and str(
+                headers.get('x-qtrace', '')).lower() in ('off', '0',
+                                                         'false'):
+            tracer = None
+        trace = tracer.start(headers.get('traceparent')) \
+            if tracer is not None else None
+        code, payload = self._match_inner(method, body, trace)
+        if trace is None:
+            return code, payload
+        record = tracer.finish(
+            trace, status=code,
+            bucket=payload.get('bucket') if code == 200 else None,
+            error=None if code == 200 else payload.get('error'))
+        payload['trace_id'] = trace.trace_id
+        if code == 200:
+            payload['stages_ms'] = trace.stage_ms()
+            payload['trace_ms'] = record['total_ms']
+        tracer.maybe_flush()
+        return code, payload, {
+            'traceparent': trace.response_traceparent()}
+
+    def _match_inner(self, method, body, trace):
         if method != 'POST':
+            self._count_error('method-405')
             return 405, {'error': 'POST a JSON query to /match',
                          'schema': {'nodes': '[[feat,...],...]',
                                     'edges': '[[src,dst],...]'}}
         if not self.ready:
+            self._count_error('warming-503')
             return 503, {'error': 'warming-up',
                          'phases': dict(self.phases)}
         try:
@@ -317,22 +416,26 @@ class ServeService:
             graph = Graph(edge_index=edges, x=x)
         except (ValueError, KeyError, TypeError,
                 UnicodeDecodeError) as e:
-            self._count_error()
+            self._count_error('bad-query-400')
             return 400, {'error': 'bad-query',
                          'detail': f'{type(e).__name__}: {e}'}
         t0 = time.perf_counter()
         from dgmc_tpu.serve.engine import UnknownExecutableError
         try:
-            answer = self.engine.match(graph)
+            answer = self.engine.match(graph, trace=trace)
         except UnknownBucketError as e:
-            self._count_error()
+            self._count_error('bucket-miss-400')
             return 400, e.payload
         except UnknownExecutableError as e:
-            self._count_error()
+            self._count_error('bucket-not-warm-503')
             return 503, e.payload
         except ValueError as e:
-            self._count_error()
+            self._count_error('bad-query-400')
             return 400, {'error': 'bad-query',
+                         'detail': f'{type(e).__name__}: {e}'}
+        except Exception as e:       # noqa: BLE001 — counted 500
+            self._count_error('engine-500')
+            return 500, {'error': 'engine-fault',
                          'detail': f'{type(e).__name__}: {e}'}
         with self._counts:
             self.queries_served += 1
@@ -361,6 +464,8 @@ class ServeService:
                 self.obs.watchdog.beat('idle')
             if time.time() - last_flush >= flush_every_s:
                 self.obs.flush()
+                if self.qtracer is not None:
+                    self.qtracer.flush()
                 last_flush = time.time()
         self.close()
         return 0
@@ -369,6 +474,8 @@ class ServeService:
         self._stop.set()
 
     def close(self):
+        if self.qtracer is not None:
+            self.qtracer.flush()
         if self.obs is not None:
             self.obs.flush()
             self.obs.close()

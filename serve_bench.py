@@ -34,9 +34,21 @@ The record carries server-side latency p50/p95 (the worker's own
 per-query histogrammed account), client-observed p50/p95, sustained
 QPS, the cold/warm restart split, the compile account and Hits@1
 against the sampled queries' known ground truth.
+
+Since r02 every load query carries a client-minted W3C ``traceparent``
+and the record additionally carries the ``qtrace`` attribution block
+(``obs.qtrace``): per-stage p50/p95, the p95−p50 tail gap attributed
+to a named stage, the client-vs-server latency skew (``client_ms``
+minus the server's ``trace_ms`` — the wire + HTTP + JSON overhead the
+server-side span tree cannot see), and the measured tracing overhead
+(alternating traced / ``x-qtrace: off`` probes; the driver gates the
+traced p50 penalty at <5%). The per-stage sums must cover the traced
+end-to-end total within tolerance — a span tree that loses the query's
+time is a failed round, not a cosmetic gap.
 """
 
 import argparse
+import hashlib
 import json
 import os
 import shutil
@@ -47,6 +59,7 @@ import threading
 import time
 
 from dgmc_tpu.obs.observe import percentile
+from dgmc_tpu.obs.qtrace import format_traceparent
 from dgmc_tpu.serve.client import (discover_endpoint, get_json,
                                    post_match, query_payload,
                                    sample_query)
@@ -132,25 +145,39 @@ def compile_events(port):
     return (st[1].get('compile') or {}).get('events')
 
 
+def mint_traceparent(tag):
+    """A deterministic client-side W3C trace context for one bench
+    query: the bench OWNS the trace ids, and the server must adopt and
+    echo them (asserted as the trace-adoption gate)."""
+    trace_id = hashlib.sha256(f'{tag}:trace'.encode()).hexdigest()[:32]
+    span_id = hashlib.sha256(f'{tag}:span'.encode()).hexdigest()[:16]
+    return trace_id, format_traceparent(trace_id, span_id)
+
+
 def run_clients(jobs_per_client, endpoint, deadline_s=600.0,
-                progress=None, pace_s=0.0):
+                progress=None, pace_s=0.0, trace_tag=''):
     """N threads, each draining its job list; latencies + hits come
     back per client. A failed POST (the mid-run kill window) refreshes
     the endpoint and retries the SAME query until the deadline.
     ``progress`` (a mutable ``{'done': n}``) lets the driver time the
     chaos kill against real completions; ``pace_s`` spaces a client's
-    queries so a load phase stays open long enough to be killed into."""
+    queries so a load phase stays open long enough to be killed into.
+    Each query carries a bench-minted ``traceparent`` and the result
+    rows collect the server's span-tree account (``stages_ms``,
+    ``trace_ms``) beside the client clock (``client_ms``)."""
     results = [[] for _ in jobs_per_client]
 
     def client(tid):
-        for payload, gt in jobs_per_client[tid]:
+        for qi, (payload, gt) in enumerate(jobs_per_client[tid]):
             if pace_s:
                 time.sleep(pace_s)
+            want_id, tp = mint_traceparent(f'{trace_tag}:{tid}:{qi}')
             t_end = time.time() + deadline_s
             while True:
                 port = endpoint.port
                 t0 = time.perf_counter()
-                r = (post_match(port, payload, timeout_s=60.0)
+                r = (post_match(port, payload, timeout_s=60.0,
+                                traceparent=tp)
                      if port else None)
                 if r is not None and r[0] == 200:
                     lat = time.perf_counter() - t0
@@ -159,7 +186,12 @@ def run_clients(jobs_per_client, endpoint, deadline_s=600.0,
                         if m['target'] == int(t))
                     results[tid].append(
                         {'latency_s': lat, 'hits': hits, 'n': len(gt),
-                         'server_ms': r[1].get('latency_ms')})
+                         'server_ms': r[1].get('latency_ms'),
+                         'stages_ms': r[1].get('stages_ms'),
+                         'trace_ms': r[1].get('trace_ms'),
+                         'client_ms': r[1].get('client_ms'),
+                         'trace_adopted':
+                             r[1].get('trace_id') == want_id})
                     if progress is not None:
                         progress['done'] = progress.get('done', 0) + 1
                     break
@@ -177,6 +209,82 @@ def run_clients(jobs_per_client, endpoint, deadline_s=600.0,
     for t in threads:
         t.join()
     return results, time.perf_counter() - t0
+
+
+def measure_overhead(endpoint, payload, samples_per_arm=24):
+    """Tracing overhead on the sampled-off path: one sequential client
+    alternating traced queries against ``x-qtrace: off`` ones (same
+    payload, same bucket, interleaved so drift hits both arms equally).
+    Returns ``{'traced_p50_ms', 'untraced_p50_ms', 'overhead_frac',
+    'samples_per_arm'}`` — ``overhead_frac`` is the traced-p50 penalty
+    the driver gates at <5%."""
+    traced, untraced = [], []
+    for i in range(2 * samples_per_arm):
+        is_traced = (i % 2 == 0)
+        t0 = time.perf_counter()
+        r = post_match(endpoint.port, payload, timeout_s=60.0,
+                       qtrace=None if is_traced else False)
+        dt = (time.perf_counter() - t0) * 1e3
+        if r is not None and r[0] == 200:
+            (traced if is_traced else untraced).append(dt)
+    if not traced or not untraced:
+        return {'traced_p50_ms': None, 'untraced_p50_ms': None,
+                'overhead_frac': None,
+                'samples_per_arm': samples_per_arm}
+    p_t = percentile(sorted(traced), 0.5)
+    p_u = percentile(sorted(untraced), 0.5)
+    return {'traced_p50_ms': round(p_t, 3),
+            'untraced_p50_ms': round(p_u, 3),
+            'overhead_frac': round((p_t - p_u) / p_u, 4),
+            'samples_per_arm': samples_per_arm}
+
+
+def qtrace_attribution(ok_rows):
+    """The ``qtrace`` block from the clients' collected span accounts:
+    end-to-end trace percentiles, per-stage p50/p95, the p95−p50 tail
+    gap attributed to its dominant stage, span-tree coverage of the
+    total, and the client-vs-server skew. ``None`` when no query
+    carried a span tree (the unmeasured-account gate)."""
+    traced = [r for r in ok_rows
+              if r.get('stages_ms') and r.get('trace_ms') is not None]
+    if not traced:
+        return None
+    totals = sorted(r['trace_ms'] for r in traced)
+    stage_samples = {}
+    for r in traced:
+        for name, ms in r['stages_ms'].items():
+            stage_samples.setdefault(name, []).append(ms)
+    stage_p50, stage_p95, gap_by_stage = {}, {}, {}
+    for name, vals in sorted(stage_samples.items()):
+        vals.sort()
+        stage_p50[name] = round(percentile(vals, 0.5), 3)
+        stage_p95[name] = round(percentile(vals, 0.95), 3)
+        gap_by_stage[name] = round(stage_p95[name] - stage_p50[name], 3)
+    dominant = max(gap_by_stage, key=lambda s: gap_by_stage[s])
+    coverage = sorted(sum(r['stages_ms'].values()) / r['trace_ms']
+                      for r in traced if r['trace_ms'] > 0)
+    skews = sorted(r['client_ms'] - r['trace_ms'] for r in traced
+                   if r.get('client_ms') is not None)
+    return {
+        'traced_queries': len(traced),
+        'trace_adopted': sum(1 for r in traced
+                             if r.get('trace_adopted')),
+        'p50_ms': round(percentile(totals, 0.5), 3),
+        'p95_ms': round(percentile(totals, 0.95), 3),
+        'p99_ms': round(percentile(totals, 0.99), 3),
+        'stage_p50_ms': stage_p50,
+        'stage_p95_ms': stage_p95,
+        'gap_ms': round(percentile(totals, 0.95)
+                        - percentile(totals, 0.5), 3),
+        'gap_attribution_ms': gap_by_stage,
+        'dominant_stage': dominant,
+        'stage_sum_coverage_p50': (round(percentile(coverage, 0.5), 4)
+                                   if coverage else None),
+        'client_server_skew_p50_ms': (
+            round(percentile(skews, 0.5), 3) if skews else None),
+        'client_server_skew_p95_ms': (
+            round(percentile(skews, 0.95), 3) if skews else None),
+    }
 
 
 def main(argv=None):
@@ -236,7 +344,7 @@ def main(argv=None):
         c_warm = compile_events(endpoint.port)
         half = [j[:len(j) // 2] for j in jobs]
         rest = [j[len(j) // 2:] for j in jobs]
-        res1, wall1 = run_clients(half, endpoint)
+        res1, wall1 = run_clients(half, endpoint, trace_tag='p1')
         c_after_1 = compile_events(endpoint.port)
 
         # Chaos: SIGKILL the WORKER (not the supervisor) while phase-2
@@ -254,7 +362,8 @@ def main(argv=None):
 
         def phase2():
             holder['res'], holder['wall'] = run_clients(
-                rest, endpoint, progress=progress, pace_s=pace)
+                rest, endpoint, progress=progress, pace_s=pace,
+                trace_tag='p2')
 
         th = threading.Thread(target=phase2)
         th.start()
@@ -282,6 +391,13 @@ def main(argv=None):
         th.join()
         res2, wall2 = holder['res'], holder['wall']
         c_after_2 = compile_events(endpoint.port)
+
+        # Tracing-overhead phase: sequential alternating traced /
+        # x-qtrace:off probes against the restarted (quiet) worker —
+        # the traced-p50 penalty must stay under 5%.
+        overhead = measure_overhead(endpoint, probe_payload)
+        print(f'# tracing overhead: {overhead}', file=sys.stderr,
+              flush=True)
 
         status = get_json(endpoint.port, '/status')[1]
         health_code, health_final = get_json(endpoint.port, '/healthz')
@@ -313,6 +429,9 @@ def main(argv=None):
 
     flat = [r for res in (res1, res2) for c in res for r in c]
     ok = [r for r in flat if not r.get('failed')]
+    qtrace_block = qtrace_attribution(ok)
+    if qtrace_block is not None:
+        qtrace_block['overhead'] = overhead
     lats = sorted(r['latency_s'] for r in ok)
     server_ms = sorted(r['server_ms'] for r in ok
                        if r.get('server_ms') is not None)
@@ -368,6 +487,7 @@ def main(argv=None):
                 if steps.get('p95_s') else None),
         },
         'hits_at_1': round(hits / total_gt, 4) if total_gt else None,
+        'qtrace': qtrace_block,
         'restart': {
             'cold_first_answer_s': cold_s,
             'warm_first_answer_s': warm_s,
@@ -422,6 +542,29 @@ def main(argv=None):
                         f'{compiles_load_2} after warmup')
     if record['queries_failed']:
         problems.append(f"{record['queries_failed']} queries failed")
+    if qtrace_block is None:
+        problems.append('qtrace account unmeasured (no query returned '
+                        'a span tree)')
+    else:
+        # The span tree must COVER the traced end-to-end total: the
+        # untimed remainder (HTTP body parse, dispatch glue) is bounded
+        # by tolerance; a sum past the total is a broken clock.
+        cov = qtrace_block['stage_sum_coverage_p50']
+        if cov is None or not (0.70 <= cov <= 1.02):
+            problems.append(f'stage sums do not cover the traced '
+                            f'total (p50 coverage {cov})')
+        if qtrace_block['trace_adopted'] \
+                < qtrace_block['traced_queries']:
+            problems.append(
+                f"server adopted only "
+                f"{qtrace_block['trace_adopted']}/"
+                f"{qtrace_block['traced_queries']} client trace ids")
+        frac = (qtrace_block.get('overhead') or {}).get('overhead_frac')
+        if frac is None:
+            problems.append('tracing overhead unmeasured')
+        elif frac >= 0.05:
+            problems.append(f'tracing overhead {frac:.1%} >= 5% '
+                            f'on p50')
     record['outcome'] = ('completed' if not problems
                          else f'failed ({"; ".join(problems)})')
 

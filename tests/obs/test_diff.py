@@ -439,3 +439,67 @@ def test_require_equal_missing_key_fails_either_side(tmp_path, capsys):
     assert diff_mod.main([a, b, '--require-equal', 'loss,hits1']) == 1
     out = capsys.readouterr().out
     assert 'equal:hits1' in out
+
+
+def _write_qtrace(run_dir, stages, queries=100):
+    payload = {
+        'queries': queries, 'errors': 0,
+        'stage_vocabulary': sorted(stages),
+        'end_to_end': {'count': queries, 'p50_ms': 10.0,
+                       'p95_ms': 20.0, 'p99_ms': 30.0},
+        'stages': {name: {'count': queries, 'p50_ms': p50,
+                          'p95_ms': p95, 'p99_ms': p95 * 1.2}
+                   for name, (p50, p95) in stages.items()},
+    }
+    with open(os.path.join(run_dir, 'qtrace_summary.json'), 'w') as f:
+        json.dump(payload, f)
+
+
+def test_stage_p95_gate_off_by_default(tmp_path):
+    """Without --max-stage-p95-regression the qtrace account is not
+    gated at all: a serving regression pair still exits 0, and a pair
+    of training runs (no qtrace file) is untouched."""
+    a = write_run(tmp_path, 'a')
+    b = write_run(tmp_path, 'b')
+    _write_qtrace(a, {'device_execute': (10.0, 20.0)})
+    _write_qtrace(b, {'device_execute': (10.0, 200.0)})
+    assert diff_mod.main([a, b]) == 0
+
+
+def test_stage_p95_gate_fires_when_configured(tmp_path, capsys):
+    a = write_run(tmp_path, 'a')
+    b = write_run(tmp_path, 'b')
+    _write_qtrace(a, {'device_execute': (10.0, 20.0),
+                      'serialize': (0.1, 0.2)})
+    _write_qtrace(b, {'device_execute': (10.0, 31.0),   # +55% p95
+                      'serialize': (0.1, 0.2)})
+    assert diff_mod.main([a, b,
+                          '--max-stage-p95-regression', '0.5']) == 1
+    out = capsys.readouterr().out
+    assert 'qtrace[device_execute].p95_ms' in out
+    # The same pair passes under a looser bound; the untouched stage
+    # never fires.
+    assert diff_mod.main([a, b,
+                          '--max-stage-p95-regression', '0.6']) == 0
+
+
+def test_stage_p95_lost_account_is_regression(tmp_path, capsys):
+    """A candidate that stopped producing the per-stage account the
+    baseline had fails when the gate is on; a baseline without one
+    skips (first traced round has nothing to compare against)."""
+    a = write_run(tmp_path, 'a')
+    b = write_run(tmp_path, 'b')
+    _write_qtrace(a, {'device_execute': (10.0, 20.0)})
+    assert diff_mod.main([a, b,
+                          '--max-stage-p95-regression', '0.5']) == 1
+    assert 'lost the qtrace stage account' in capsys.readouterr().out
+    # Stage present in baseline but missing from candidate: same rule.
+    _write_qtrace(b, {'serialize': (0.1, 0.2)})
+    assert diff_mod.main([a, b,
+                          '--max-stage-p95-regression', '0.5']) == 1
+    # No baseline account: skipped, not failed.
+    c = write_run(tmp_path, 'c')
+    d = write_run(tmp_path, 'd')
+    _write_qtrace(d, {'device_execute': (10.0, 20.0)})
+    assert diff_mod.main([c, d,
+                          '--max-stage-p95-regression', '0.5']) == 0

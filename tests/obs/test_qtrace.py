@@ -1,0 +1,294 @@
+"""Per-query trace retention contract (``obs.qtrace``): the stage
+vocabulary is pinned to the shared model-stage dialect, sampling is
+deterministic and bounded, the slowest-K reservoir holds exactly the K
+slowest, errors are never sampled out, and the exports (Chrome trace,
+/metrics exposition, report CLI) strict-parse."""
+
+import json
+
+import pytest
+
+from dgmc_tpu.analysis.hlo_comm import STAGE_NAMES
+from dgmc_tpu.obs import qtrace as qt
+from dgmc_tpu.obs import trace_events
+from dgmc_tpu.obs.live import prometheus_exposition
+from tests.obs.test_live import parse_exposition
+
+
+def make_trace(tracer, total_s, spans=None, traceparent=None):
+    """One synthetic closed trace: pre-timed spans + a forced total."""
+    trace = tracer.start(traceparent)
+    for name, start_s, dur_s in spans or [
+            ('bucket_resolve', 0.0, 0.001),
+            ('device_execute', 0.001, total_s * 0.8),
+            ('serialize', 0.001 + total_s * 0.8, 0.001)]:
+        trace.record(name, start_s, dur_s)
+    return trace
+
+
+# ---------------------------------------------------------------------------
+# Stage vocabulary: one dialect, enforced at record time
+# ---------------------------------------------------------------------------
+
+def test_stage_vocabulary_identity():
+    """The serve span vocabulary IS the one the static/measured planes
+    share: defined once in analysis.hlo_comm, re-exported verbatim, and
+    every device-facing span maps onto STAGE_NAMES members only."""
+    from dgmc_tpu.analysis import hlo_comm
+    from dgmc_tpu.obs import trace_events as te
+    assert qt.SERVE_SPAN_NAMES == hlo_comm.SERVE_SPAN_NAMES
+    assert qt.SERVE_SPAN_NAMES == te.SERVE_SPAN_NAMES
+    assert qt.SERVE_SPAN_STAGES is hlo_comm.SERVE_SPAN_STAGES
+    assert set(qt.SERVE_SPAN_STAGES) == set(qt.SERVE_SPAN_NAMES)
+    assert qt.SERVE_SPAN_NAMES == (
+        'admission_queue_wait', 'bucket_resolve', 'pad_and_stage',
+        'device_execute', 'shortlist_merge', 'consensus_rerank',
+        'serialize')
+    for name, stages in qt.SERVE_SPAN_STAGES.items():
+        assert set(stages) <= set(STAGE_NAMES), name
+
+
+def test_unknown_span_name_raises():
+    tracer = qt.QueryTracer(path=None)
+    trace = tracer.start()
+    with pytest.raises(ValueError, match='unknown serve span'):
+        with trace.span('made_up_stage'):
+            pass
+    with pytest.raises(ValueError, match='unknown serve span'):
+        trace.record('psi1', 0.0, 0.001)   # model stage != span name
+
+
+# ---------------------------------------------------------------------------
+# traceparent: adopt when valid, mint deterministically otherwise
+# ---------------------------------------------------------------------------
+
+def test_traceparent_parse_and_format():
+    tid, sid = 'ab' * 16, 'cd' * 8
+    assert qt.parse_traceparent(f'00-{tid}-{sid}-01') == (tid, sid)
+    assert qt.format_traceparent(tid, sid) == f'00-{tid}-{sid}-01'
+    for bad in (None, '', 'garbage', f'00-{tid}-{sid}',
+                f'00-{"0" * 32}-{sid}-01',     # all-zero trace id
+                f'00-{tid}-{"0" * 16}-01',     # all-zero parent
+                f'00-{tid[:-2]}-{sid}-01'):    # short trace id
+        assert qt.parse_traceparent(bad) is None, bad
+
+
+def test_start_adopts_or_mints():
+    tracer = qt.QueryTracer(path=None, seed=7)
+    tid, sid = '12' * 16, '34' * 8
+    adopted = tracer.start(f'00-{tid}-{sid}-01')
+    assert adopted.trace_id == tid
+    assert adopted.parent_id == sid
+    minted = tracer.start('not-a-traceparent')
+    assert minted.parent_id is None
+    assert len(minted.trace_id) == 32
+    int(minted.trace_id, 16)
+    # Minting is a pure function of (seed, seq): same worker replay
+    # mints the same ids.
+    again = qt.QueryTracer(path=None, seed=7)
+    again.start()
+    assert again.start().trace_id == minted.trace_id
+
+
+# ---------------------------------------------------------------------------
+# Retention: deterministic sample, exact slowest-K, errors never lost
+# ---------------------------------------------------------------------------
+
+def run_load(tracer, n=60, error_every=None):
+    """Feed ``n`` synthetic queries with distinct totals (ms == seq+1);
+    every ``error_every``-th finishes as a 500."""
+    for i in range(n):
+        trace = make_trace(tracer, (i + 1) * 1e-3)
+        is_err = error_every is not None and i % error_every == 0
+        tracer.finish(trace, status=500 if is_err else 200,
+                      bucket='16x48',
+                      error='engine-fault' if is_err else None,
+                      total_s=(i + 1) * 1e-3)
+
+
+def kept_ids(path):
+    with open(path) as f:
+        return [(json.loads(line)['trace_id'],
+                 tuple(json.loads(line)['kept']))
+                for line in f if line.strip()]
+
+
+def test_sampling_deterministic_and_bounded(tmp_path):
+    """Same seed -> byte-identical kept-set across two independent
+    tracers; the file never exceeds capacity+error_capacity+slowest_k."""
+    paths = [str(tmp_path / f'{i}' / 'qtrace.jsonl') for i in (0, 1)]
+    kept = []
+    for path in paths:
+        tracer = qt.QueryTracer(path=path, sample_rate=0.3, slowest_k=4,
+                                capacity=16, error_capacity=8, seed=42)
+        run_load(tracer, n=80, error_every=9)
+        assert tracer.flush()
+        kept.append(kept_ids(path))
+    assert kept[0] == kept[1]
+    assert len(kept[0]) <= 16 + 8 + 4
+    reasons = {r for _tid, rs in kept[0] for r in rs}
+    assert reasons <= {'sampled', 'slowest', 'error'}
+    assert 'sampled' in reasons and 'slowest' in reasons \
+        and 'error' in reasons
+    # A different seed keeps a different sampled subset (the decision
+    # hashes the seed, not just the trace id).
+    other = qt.QueryTracer(path=str(tmp_path / 'other.jsonl'),
+                           sample_rate=0.3, slowest_k=4, capacity=16,
+                           error_capacity=8, seed=43)
+    run_load(other, n=80, error_every=9)
+    other.flush()
+    assert kept_ids(str(tmp_path / 'other.jsonl')) != kept[0]
+
+
+def test_slowest_k_reservoir_exact(tmp_path):
+    """sample_rate 0 isolates the reservoir: exactly K records, and
+    they are exactly the K slowest queries."""
+    path = str(tmp_path / 'qtrace.jsonl')
+    tracer = qt.QueryTracer(path=path, sample_rate=0.0, slowest_k=5,
+                            capacity=64, seed=0)
+    run_load(tracer, n=40)
+    tracer.flush()
+    records = [json.loads(line) for line in open(path) if line.strip()]
+    assert len(records) == 5
+    assert all(r['kept'] == ['slowest'] for r in records)
+    # run_load's totals are (seq+1) ms: the slowest five are seqs 35-39.
+    assert sorted(r['seq'] for r in records) == [35, 36, 37, 38, 39]
+
+
+def test_errors_never_sampled_out(tmp_path):
+    """Every error is kept while the ring has room; past the bound the
+    OLDEST are evicted and the truncation is counted, never silent."""
+    path = str(tmp_path / 'qtrace.jsonl')
+    tracer = qt.QueryTracer(path=path, sample_rate=0.0, slowest_k=0,
+                            capacity=0, error_capacity=10, seed=0)
+    run_load(tracer, n=30, error_every=1)    # 30 errors, ring of 10
+    tracer.flush()
+    records = [json.loads(line) for line in open(path) if line.strip()]
+    assert len(records) == 10
+    assert all(r['kept'] == ['error'] for r in records)
+    assert [r['seq'] for r in records] == list(range(20, 30))
+    summary = tracer.summary()
+    assert summary['errors'] == 30
+    assert summary['errors_truncated'] == 20
+    # Below the bound nothing is lost.
+    t2 = qt.QueryTracer(path=None, sample_rate=0.0, slowest_k=0,
+                        capacity=0, error_capacity=10)
+    run_load(t2, n=8, error_every=1)
+    assert t2.summary()['errors'] == 8
+    assert t2.summary()['errors_truncated'] == 0
+
+
+def test_slo_breach_hook_fires_with_record():
+    breached = []
+    tracer = qt.QueryTracer(path=None, slo_s=0.010,
+                            on_breach=breached.append)
+    run_load(tracer, n=20)                  # totals 1..20 ms, slo 10 ms
+    assert tracer.summary()['slo_breaches'] == 10
+    assert len(breached) == 10
+    assert all(r['total_ms'] > 10.0 for r in breached)
+    assert all(r['spans'] for r in breached)
+
+
+# ---------------------------------------------------------------------------
+# Summaries and exports
+# ---------------------------------------------------------------------------
+
+def test_summary_gap_attribution(tmp_path):
+    path = str(tmp_path / 'qtrace.jsonl')
+    tracer = qt.QueryTracer(path=path, sample_rate=1.0, slowest_k=2,
+                            seed=0)
+    run_load(tracer, n=50)
+    tracer.flush()
+    summary = json.load(open(tracer.summary_path))
+    assert summary['queries'] == 50
+    assert summary['stage_vocabulary'] == list(qt.SERVE_SPAN_NAMES)
+    e2e = summary['end_to_end']
+    # Histogram quantiles on the x1.25 ladder: within 25% of exact.
+    assert e2e['count'] == 50
+    assert abs(e2e['p50_ms'] - 25.5) / 25.5 < 0.25
+    gap = summary['gap_attribution']
+    # run_load puts 80% of each total in device_execute: the spread
+    # must attribute there.
+    assert gap['dominant_stage'] == 'device_execute'
+    assert gap['p95_minus_p50_ms'] > 0
+    # Exact (kept-set) attribution agrees on the dominant stage.
+    records, loaded_summary, _ = qt.load_records(str(tmp_path))
+    assert loaded_summary['queries'] == 50
+    pct = qt.stage_percentiles(records)
+    attr = qt.gap_attribution(pct)
+    assert attr['dominant_stage'] == 'device_execute'
+    assert 0 < attr['dominant_share'] <= 1.0
+
+
+def test_chrome_export_parses_through_trace_events(tmp_path):
+    tracer = qt.QueryTracer(path=None, sample_rate=1.0)
+    records = [tracer.finish(make_trace(tracer, 0.02), total_s=0.02)
+               for _ in range(3)]
+    payload = qt.chrome_trace_events(records)
+    path = tmp_path / 'qtrace.trace.json'
+    path.write_text(json.dumps(payload))
+    loaded = trace_events.read_trace_file(str(path))
+    tracks = trace_events.build_tracks(loaded['traceEvents'])
+    assert len(tracks) == 3                  # one thread row per query
+    for track in tracks:
+        assert track.process == 'dgmc-qtrace'
+        assert track.thread.startswith('query ')
+        names = {name for _ts, _dur, name, _args in track.slices}
+        assert names <= set(qt.SERVE_SPAN_NAMES)
+        for _ts, _dur, name, args in track.slices:
+            assert args['stages'] == list(qt.SERVE_SPAN_STAGES[name])
+
+
+def test_metric_families_strict_exposition():
+    tracer = qt.QueryTracer(path=None, sample_rate=1.0, slo_s=0.010)
+    run_load(tracer, n=20, error_every=7)
+    text = prometheus_exposition(tracer.metric_families())
+    families = parse_exposition(text)
+    stage_fam = families['dgmc_query_stage_seconds']
+    assert stage_fam['type'] == 'histogram'
+    counts = {s[1]['stage']: s[2] for s in stage_fam['samples']
+              if s[0].endswith('_count')}
+    assert set(counts) == set(qt.SERVE_SPAN_NAMES)
+    assert counts['device_execute'] == 20
+    assert counts['shortlist_merge'] == 0    # unexercised stage: 0, not
+    assert families['dgmc_query_trace_seconds']['type'] == 'histogram'
+    kept = {s[1]['reason']: s[2]
+            for s in families['dgmc_qtrace_kept_total']['samples']}
+    assert set(kept) == {'sampled', 'slowest', 'error'}
+    assert kept['error'] == 3
+    [(_, _, n_q)] = families['dgmc_qtrace_queries_total']['samples']
+    assert n_q == 20
+    [(_, _, n_b)] = \
+        families['dgmc_qtrace_slo_breaches_total']['samples']
+    assert n_b == 10
+
+
+def test_report_cli(tmp_path, capsys):
+    obs = tmp_path / 'obs'
+    tracer = qt.QueryTracer(path=str(obs / 'qtrace.jsonl'),
+                            sample_rate=1.0, slowest_k=2, seed=0)
+    run_load(tracer, n=12, error_every=5)
+    tracer.flush()
+    chrome_out = str(tmp_path / 'qtrace.chrome.json')
+    assert qt.main([str(obs), '--slowest', '2',
+                    '--chrome', chrome_out]) == 0
+    out = capsys.readouterr().out
+    assert 'dominant stage: device_execute' in out
+    assert 'trace ' in out                   # a span tree was printed
+    trace_events.read_trace_file(chrome_out)
+    assert qt.main([str(obs), '--json']) == 0
+    payload = json.loads(capsys.readouterr().out)
+    assert payload['gap_attribution']['dominant_stage'] \
+        == 'device_execute'
+    # Supervised layout: the dir resolves to the LAST attempt.
+    sup = tmp_path / 'sup'
+    for attempt, n in (('attempt_0', 3), ('attempt_1', 7)):
+        t = qt.QueryTracer(path=str(sup / attempt / 'qtrace.jsonl'),
+                           sample_rate=1.0, seed=0)
+        run_load(t, n=n)
+        t.flush()
+    records, summary, resolved = qt.load_records(str(sup))
+    assert 'attempt_1' in resolved
+    assert summary['queries'] == 7
+    # Missing account: a clear error, not a traceback.
+    assert qt.main([str(tmp_path / 'nowhere')]) == 1

@@ -188,6 +188,37 @@ def test_serve_family_rows(tmp_path):
     assert '10.80s' in line
 
 
+def test_serve_qtrace_columns(tmp_path):
+    """r02+ rounds carry the qtrace attribution block: p99 and the
+    dominant tail stage become columns; a pre-qtrace round renders
+    '-' in both, not a crash."""
+    _write(tmp_path, 'SERVE_r01.json', {
+        'round': 1, 'supervision': {'outcome': 'completed',
+                                    'restarts': 1},
+        'latency': {'server_p50_ms': 111.8, 'server_p95_ms': 134.8},
+        'qps': 28.6, 'clients': 4})
+    _write(tmp_path, 'SERVE_r02.json', {
+        'round': 2, 'supervision': {'outcome': 'completed',
+                                    'restarts': 1},
+        'latency': {'server_p50_ms': 100.0, 'server_p95_ms': 150.0},
+        'qps': 30.0, 'clients': 4,
+        'qtrace': {'p99_ms': 201.5,
+                   'dominant_stage': 'admission_queue_wait'}})
+    r1, r2 = collect_rounds([str(tmp_path)])
+    assert r1['latency_p99_ms'] is None
+    assert r1['dominant_stage'] is None
+    assert r2['latency_p99_ms'] == 201.5
+    assert r2['dominant_stage'] == 'admission_queue_wait'
+    table = render([r1, r2])
+    assert 'p99' in table and 'tail stage' in table
+    (line1,) = [ln for ln in table.splitlines()
+                if ln.strip().startswith('1 ')]
+    (line2,) = [ln for ln in table.splitlines()
+                if ln.strip().startswith('2 ')]
+    assert 'admission_queue_wait' in line2 and '201.50 ms' in line2
+    assert 'admission_queue_wait' not in line1
+
+
 def test_serve_falls_back_to_client_latency(tmp_path):
     _write(tmp_path, 'SERVE_r02.json', {
         'round': 2, 'supervision': {'outcome': 'completed',
@@ -228,3 +259,17 @@ def test_cli_over_committed_serve_round():
     assert rec['restart']['warm_cache_hit'] == 1
     assert rec['restart']['cold_cache_hit'] == 0
     assert rec['queries_failed'] == 0
+    # r02 adds the per-query trace account; its gates re-asserted over
+    # the committed file the same way.
+    serve2 = by_key[('SERVE', 2)]
+    assert serve2['outcome'] == 'completed'
+    assert serve2['latency_p99_ms'] >= serve2['latency_p95_ms'] > 0
+    with open(os.path.join(REPO, 'benchmarks', 'SERVE_r02.json')) as f:
+        rec2 = json.load(f)
+    qt = rec2['qtrace']
+    assert rec2['compiles']['per_query'] == 0
+    assert qt['trace_adopted'] == qt['traced_queries'] > 0
+    assert 0.70 <= qt['stage_sum_coverage_p50'] <= 1.02
+    assert qt['overhead']['overhead_frac'] < 0.05
+    assert qt['dominant_stage'] in qt['stage_p95_ms']
+    assert serve2['dominant_stage'] == qt['dominant_stage']

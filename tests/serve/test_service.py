@@ -80,7 +80,9 @@ def test_concurrent_equals_sequential(service):
 
     def strip(resp):
         resp = dict(resp)
-        resp.pop('latency_ms')
+        for key in ('latency_ms', 'client_ms', 'trace_id', 'trace_ms',
+                    'stages_ms', 'server_traceparent'):
+            resp.pop(key, None)
         return resp
 
     sequential = [strip(post_match(service.port, q)[1])
@@ -160,6 +162,117 @@ def test_metrics_strict_parse_and_gauges(service):
     assert gauges['serve_buckets_warm'] == 1
     assert gauges['corpus_cache_hit'] == 0
     assert gauges['queries_served'] >= 1
+
+
+def test_trace_id_and_stages_in_response(service):
+    """The tentpole's wire surface: a W3C traceparent is adopted and
+    echoed (header + payload), the answer carries the per-stage
+    decomposition in the shared span vocabulary, and the spans sum to
+    no more than the end-to-end trace clock."""
+    from dgmc_tpu.obs.qtrace import SERVE_SPAN_NAMES
+    sent_id = 'ab' * 16
+    tp = f'00-{sent_id}-{"cd" * 8}-01'
+    code, resp = post_match(service.port, _query(6)[0], traceparent=tp)
+    assert code == 200
+    assert resp['trace_id'] == sent_id
+    assert resp['server_traceparent'].startswith(f'00-{sent_id}-')
+    stages = resp['stages_ms']
+    assert stages and set(stages) <= set(SERVE_SPAN_NAMES)
+    for name in ('bucket_resolve', 'pad_and_stage',
+                 'admission_queue_wait', 'device_execute', 'serialize'):
+        assert name in stages
+    assert sum(stages.values()) <= resp['trace_ms'] + 1e-6
+    # The client-observed clock covers the whole server handler.
+    assert resp['client_ms'] > 0
+    # A malformed traceparent mints a fresh id instead of failing.
+    code, resp = post_match(service.port, _query(6)[0],
+                            traceparent='garbage-header')
+    assert code == 200
+    assert len(resp['trace_id']) == 32 and resp['trace_id'] != sent_id
+    # The kept-set lands in a real, bounded qtrace.jsonl.
+    tracer = service.qtracer
+    assert tracer.flush()
+    with open(tracer.path) as f:
+        lines = [json.loads(ln) for ln in f if ln.strip()]
+    assert lines
+    bound = (tracer.capacity + tracer.error_capacity
+             + tracer.slowest_k)
+    assert len(lines) <= bound
+    assert all(rec['kept'] for rec in lines)
+
+
+def test_qtrace_optout_header(service):
+    """``x-qtrace: off`` skips tracing for that request only — the
+    overhead-measurement path must cost nothing."""
+    before = service.qtracer.summary()['queries']
+    code, resp = post_match(service.port, _query(7)[0], qtrace=False)
+    assert code == 200
+    assert 'trace_id' not in resp and 'stages_ms' not in resp
+    assert 'server_traceparent' not in resp
+    assert service.qtracer.summary()['queries'] == before
+
+
+def test_error_classes_strict_parse(service):
+    """Satellite 1: the single error counter is gone; every error class
+    is a labelled Prometheus counter, strict-parsed."""
+    get_json(service.port, '/match')                       # method-405
+    post_match(service.port, {'nodes': 'nope'})          # bad-query-400
+    x = synthetic_corpus(**{'num_nodes': CORPUS['nodes'],
+                            'num_edges': CORPUS['edges'],
+                            'dim': CORPUS['dim']}).x
+    g, _ = sample_query(x, 30, 60, seed=11)            # bucket-miss-400
+    post_match(service.port, query_payload(g))
+    saved = dict(service.engine._exec)
+    service.engine._exec.clear()                  # bucket-not-warm-503
+    try:
+        post_match(service.port, _query(8)[0])
+    finally:
+        service.engine._exec.update(saved)
+    orig = service.engine.match                           # engine-500
+
+    def boom(*_a, **_k):
+        raise RuntimeError('boom')
+
+    service.engine.match = boom
+    try:
+        code, resp = post_match(service.port, _query(9)[0])
+    finally:
+        service.engine.match = orig
+    assert code == 500 and resp['error'] == 'engine-fault'
+
+    from dgmc_tpu.serve.service import ERROR_CLASSES
+    _, text = get_json(service.port, '/metrics')
+    fam = parse_exposition(text)['dgmc_query_errors_total']
+    assert fam['type'] == 'counter'
+    counts = {labels['class']: value
+              for (_n, labels, value) in fam['samples']}
+    # The FULL label set is always exported, hit or not.
+    assert set(counts) == set(ERROR_CLASSES)
+    for cls in ('method-405', 'bad-query-400', 'bucket-miss-400',
+                'bucket-not-warm-503', 'engine-500'):
+        assert counts[cls] >= 1, cls
+
+
+def test_stage_histograms_in_metrics(service):
+    """Per-stage qtrace histograms export through /metrics with the
+    stage label, strict-parsed."""
+    post_match(service.port, _query(3)[0])
+    _, text = get_json(service.port, '/metrics')
+    families = parse_exposition(text)
+    fam = families['dgmc_query_stage_seconds']
+    assert fam['type'] == 'histogram'
+    counts = {labels['stage']: value
+              for (name, labels, value) in fam['samples']
+              if name.endswith('_count')}
+    from dgmc_tpu.obs.qtrace import SERVE_SPAN_NAMES
+    assert set(counts) == set(SERVE_SPAN_NAMES)
+    assert counts['device_execute'] >= 1
+    assert counts['serialize'] >= 1
+    kept = {labels['reason']: value
+            for (_n, labels, value)
+            in families['dgmc_qtrace_kept_total']['samples']}
+    assert kept['slowest'] >= 1
+    assert families['dgmc_qtrace_queries_total']['samples'][0][2] >= 1
 
 
 def test_padding_buckets_in_status(service):
