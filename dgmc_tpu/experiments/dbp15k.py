@@ -34,6 +34,7 @@ import jax
 import numpy as np
 
 from dgmc_tpu.models import DGMC, RelCNN
+from dgmc_tpu.models.evalsum import eval_summary
 from dgmc_tpu.obs import (RunObserver, add_obs_flag, add_profile_flag,
                           start_profile)
 from dgmc_tpu.train import (MetricLogger, create_train_state, make_eval_step,
@@ -592,10 +593,12 @@ def main(argv=None):
             per_epoch = (time.time() - t_span) / max(span, 1)
             last_print_epoch, t_span = epoch, time.time()
             loss = float(host['loss'])
-            n = max(float(host['count']), 1.0)
-            hits1 = float(host['correct']) / n
-            hits10 = float(host['hits@10']) / n
+            summary = eval_summary(host['count'], loss=loss,
+                                   hits1=host['correct'],
+                                   hits10=host['hits@10'])
+            hits1, hits10 = summary['hits1'], summary['hits10']
             last_eval = {'loss': loss, 'hits1': hits1, 'hits10': hits10}
+            obs.quality_eval('dbp15k', summary, step=epoch)
             guard_metrics = {}
             if guard_mon is not None:
                 guard_metrics = {

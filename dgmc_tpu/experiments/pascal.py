@@ -19,6 +19,7 @@ import jax.numpy as jnp
 
 from dgmc_tpu.data import Cartesian, Compose, Delaunay, Distance, FaceToEdge
 from dgmc_tpu.models import DGMC, SplineCNN
+from dgmc_tpu.models.evalsum import eval_summary
 from dgmc_tpu.obs import (RunObserver, add_obs_flag, add_profile_flag,
                           start_profile)
 from dgmc_tpu.train import (MetricLogger, create_train_state, make_eval_step,
@@ -165,10 +166,10 @@ def main(argv=None):
                 correct = correct + out['correct']
                 n += float(out['count'])
                 if n >= args.test_samples:
-                    return float(correct) / n
+                    return eval_summary(n, hits1=correct)['hits1']
             if n == seen:  # empty split / no valid GT: avoid spinning
                 break
-        return float(correct) / max(n, 1)
+        return eval_summary(n, hits1=correct)['hits1']
 
     # Auto-resume at epoch granularity. Unlike dbp15k the per-epoch PRNG
     # stream depends on the shuffled batch count, so a resumed run's stream
@@ -224,6 +225,8 @@ def main(argv=None):
         logger.log(epoch, loss=loss, mean_acc=accs[-1])
         obs.log(epoch, loss=loss, mean_acc=accs[-1],
                 epoch_s=round(time.time() - t0, 3))
+        obs.quality_eval('pascal', step=epoch, loss=loss,
+                         hits1=accs[-1] / 100)
         obs.snapshot_memory(f'epoch{epoch}')
         if ckpt:
             ckpt.save(epoch, state)

@@ -23,6 +23,7 @@ import numpy as np
 from dgmc_tpu.data import (Cartesian, Compose, Constant, KNNGraph,
                            RandomGraphPairs)
 from dgmc_tpu.models import DGMC, SplineCNN, metrics
+from dgmc_tpu.models.evalsum import eval_summary
 from dgmc_tpu.obs import (RunObserver, add_obs_flag, add_profile_flag,
                           start_profile)
 from dgmc_tpu.utils import PairLoader, pad_pair_batch
@@ -163,13 +164,18 @@ def main(argv=None):
         obs.fence_devices(tot_loss)
         host = jax.device_get({'l': tot_loss, 'c': tot_correct})
         loss = float(host['l']) / len(train_loader)
-        acc = float(host['c']) / max(tot_n, 1)
+        acc = eval_summary(tot_n, hits1=host['c'])['hits1']
         print(f'Epoch: {epoch:02d}, Loss: {loss:.4f},'
               f' Acc: {acc:.2f},'
               f' {time.time() - t0:.1f}s')
         logger.log(epoch, loss=loss, train_acc=acc)
         obs.log(epoch, loss=loss, train_acc=acc,
                 epoch_s=round(time.time() - t0, 3))
+        # Train-side account first: when an eval split follows below it
+        # overwrites the run headline, so the headline is always the
+        # most meaningful split this configuration ran.
+        obs.quality_eval('pascal_pf_train', step=epoch, loss=loss,
+                         hits1=acc)
         obs.snapshot_memory(f'epoch{epoch}')
 
         if syn_eval_loader is not None:
@@ -187,13 +193,15 @@ def main(argv=None):
                 out = syn_eval_step(state, b, sub)
                 correct = correct + out['correct']
                 n += float(np.asarray(b.y_mask).sum())
-            eval_acc = float(correct) / max(n, 1)
+            eval_acc = eval_summary(n, hits1=correct)['hits1']
             print(f'Held-out synthetic: {100 * eval_acc:.2f}')
             # Logged as a 0-1 fraction, the same unit as train_acc in
             # this JSONL (the percentage is print-only, mirroring the
             # reference's printed tables).
             logger.log(epoch, synthetic_eval_acc=eval_acc)
             obs.log(epoch, synthetic_eval_acc=eval_acc)
+            obs.quality_eval('pascal_pf', step=epoch, loss=loss,
+                             hits1=eval_acc)
 
         if test_datasets:
             accs = []
@@ -211,11 +219,13 @@ def main(argv=None):
                     correct = correct + metrics.acc(S_L, b.y, b.y_mask,
                                                     reduction='sum')
                     n += float(b.y_mask.sum())
-                accs.append(100 * float(correct) / max(n, 1))
+                accs.append(100 * eval_summary(n, hits1=correct)['hits1'])
             accs.append(sum(accs) / len(accs))
             print(' '.join(c[:5].ljust(5) for c in CATEGORIES) + ' mean')
             print(' '.join(f'{a:.1f}'.ljust(5) for a in accs))
             logger.log(epoch, mean_acc=accs[-1])
+            obs.quality_eval('pascal_pf', step=epoch, loss=loss,
+                             hits1=accs[-1] / 100)
     prof.close()
     logger.close()
     obs.close()

@@ -23,6 +23,7 @@ import numpy as np
 
 from dgmc_tpu.data import Cartesian, Compose, Delaunay, Distance, FaceToEdge
 from dgmc_tpu.models import DGMC, SplineCNN
+from dgmc_tpu.models.evalsum import eval_summary
 from dgmc_tpu.obs import (RunObserver, add_obs_flag, add_profile_flag,
                           start_profile)
 from dgmc_tpu.train import (Checkpointer, MetricLogger, create_train_state,
@@ -260,10 +261,10 @@ def main(argv=None):
                 correct = correct + out['correct']
                 n += float(out['count'])  # one fetch per batch
                 if n >= args.test_samples:
-                    return float(correct) / n
+                    return eval_summary(n, hits1=correct)['hits1']
             if n == seen:  # empty split: avoid spinning forever
                 break
-        return float(correct) / max(n, 1)
+        return eval_summary(n, hits1=correct)['hits1']
 
     def run(i):
         nonlocal key
@@ -295,6 +296,8 @@ def main(argv=None):
         print(' '.join(f'{a:.2f}'.ljust(13) for a in accs))
         logger.log(i, stage='run', accs=accs)
         obs.log(i, stage='run', mean_acc=sum(accs) / len(accs))
+        obs.quality_eval('willow', step=i,
+                         hits1=sum(accs) / len(accs) / 100)
         obs.snapshot_memory(f'run{i}')
         return accs
 

@@ -1,0 +1,36 @@
+"""Shared host-side eval accounting: raw correct-counts to fractions.
+
+Every experiment CLI evaluates the same way: device-side reductions
+(``metrics.acc(..., reduction='sum')`` and friends) accumulate raw
+correct COUNTS across batches, and the host divides by the number of
+scored pairs at the end. Before this module each CLI hand-rolled that
+division (four slightly different ``correct / max(n, 1)`` spellings);
+now there is exactly one, and the quality plane
+(:mod:`dgmc_tpu.obs.quality`) consumes its output directly.
+
+Deliberately jax-free: the obs readers import it on boxes without an
+accelerator stack.
+"""
+
+__all__ = ['eval_summary']
+
+
+def eval_summary(count, loss=None, **counts):
+    """Named eval fractions from raw summed counts.
+
+    ``count`` is the number of scored pairs (the denominator); each
+    keyword is a raw correct-count (e.g. ``hits1=correct_sum,
+    hits10=hits10_sum``) and comes back as ``count``-normalized
+    fraction under the same name. ``loss`` passes through unchanged
+    (it is already a mean, not a count). The ``max(count, 1)`` guard
+    keeps an empty eval split at 0.0 rather than NaN — but ``count``
+    itself is reported as-is so an empty account stays visible.
+    """
+    n = float(count)
+    denom = max(n, 1.0)
+    out = {'count': n}
+    if loss is not None:
+        out['loss'] = float(loss)
+    for name, c in counts.items():
+        out[name] = float(c) / denom
+    return out

@@ -130,6 +130,14 @@ DEFAULT_THRESHOLDS = {
     #: Serve per-stage p95 regression (qtrace_summary.json); None =
     #: gate off unless asked — training runs carry no qtrace account.
     'stage_p95': None,
+    #: Relative Hits@1 regression bound (quality.json headline); None =
+    #: gate off unless asked. The lost-account rule still applies
+    #: unconditionally: a candidate that stopped reporting the quality
+    #: account the baseline had fails.
+    'hits1': None,
+    #: Absolute Hits@1 floor; None = gate off unless asked
+    #: (min_overlap semantics — ROADMAP item 2's paper-parity pin).
+    'min_hits1': None,
     'idle': 0.25,
     #: Logged metrics whose FINAL values must be exactly equal between
     #: the runs (tuple of keys; empty = gate off). The
@@ -144,6 +152,7 @@ GATED_KEYS = (
     'step_p50_s', 'step_p95_s', 'steps_per_sec', 'compile_events',
     'peak_memory_bytes', 'mfu', 'arith_intensity', 'overlap_fraction',
     'static_peak_bytes', 'measured_overlap_fraction', 'idle_fraction',
+    'hits1',
 )
 
 
@@ -366,6 +375,44 @@ def diff_runs(a, b, thresholds=None, allow_kernel_fallback=False):
                          None if None in (ov_a, ov_b)
                          else round(ov_b - ov_a, 4), floor, 'info',
                          'no --min-overlap floor configured'))
+
+    # -- Hits@1 (quality plane) -------------------------------------------
+    # The paper's headline metric, gated both ways:
+    # --max-hits1-regression bounds the RELATIVE drop against the
+    # baseline; --min-hits1 is an absolute floor (min_overlap
+    # semantics). Either way, a candidate that lost the quality account
+    # the baseline carried FAILS unconditionally — an eval loop that
+    # silently stopped reporting accuracy must read as a regression,
+    # never as a pass.
+    h_a, h_b = a.get('hits1'), b.get('hits1')
+    h_lim = thr.get('hits1')
+    h_floor = thr.get('min_hits1')
+    if h_a is not None and h_b is None:
+        rows.append(_row('hits1', h_a, h_b, None, h_lim, 'REGRESSION',
+                         _missing_note('candidate', b)))
+    else:
+        if h_lim is not None and h_a is None and h_b is not None:
+            rows.append(_row('hits1', h_a, h_b, None, h_lim, 'skipped',
+                             _missing_note('baseline', a)))
+        elif h_lim is not None and h_a is not None and h_b is not None:
+            d = _rel(h_a, h_b)
+            if d is None:
+                rows.append(_row('hits1', h_a, h_b, None, h_lim,
+                                 'skipped', 'zero baseline'))
+            else:
+                gate('hits1', h_a, h_b, round(d, 4), h_lim, -d > h_lim)
+        if h_floor is not None and h_b is not None:
+            gate('min_hits1', h_a, h_b,
+                 None if h_a is None else round(h_b - h_a, 4), h_floor,
+                 h_b < h_floor,
+                 'Hits@1 under the absolute floor'
+                 if h_b < h_floor else '')
+        if h_b is not None and h_lim is None and h_floor is None:
+            rows.append(_row(
+                'hits1', h_a, h_b,
+                None if h_a is None else round(h_b - h_a, 4), None,
+                'info',
+                'no --max-hits1-regression / --min-hits1 configured'))
 
     # -- measured comm/compute overlap ------------------------------------
     # The profiler-trace counterpart of the modeled floor above, same
@@ -686,6 +733,20 @@ def main(argv=None):
                              'training runs carry no qtrace account; a '
                              'serving candidate that lost a stage '
                              'account the baseline had fails)')
+    parser.add_argument('--max-hits1-regression', type=float,
+                        default=DEFAULT_THRESHOLDS['hits1'],
+                        metavar='FRAC',
+                        help='allowed fractional Hits@1 decrease '
+                             '(quality.json headline; off unless set — '
+                             'a candidate that lost the quality account '
+                             'the baseline had fails unconditionally)')
+    parser.add_argument('--min-hits1', type=float,
+                        default=DEFAULT_THRESHOLDS['min_hits1'],
+                        metavar='FRAC',
+                        help='absolute Hits@1 floor (quality.json '
+                             'headline; the paper-parity pin — same '
+                             'lost-account semantics as --min-overlap; '
+                             'default: floor off)')
     parser.add_argument('--require-equal', type=str, default=None,
                         metavar='KEY[,KEY...]',
                         help='comma-separated logged-metric keys whose '
@@ -729,6 +790,8 @@ def main(argv=None):
             'static_peak': args.max_peak_regression,
             'min_measured_overlap': args.min_measured_overlap,
             'stage_p95': args.max_stage_p95_regression,
+            'hits1': args.max_hits1_regression,
+            'min_hits1': args.min_hits1,
             'idle': args.max_idle_regression,
             'require_equal': tuple(
                 k.strip() for k in (args.require_equal or '').split(',')

@@ -92,6 +92,7 @@ def load_run(path):
                 os.path.join(path, 'attribution.json')),
             'qtrace': _read_json(
                 os.path.join(path, 'qtrace_summary.json')),
+            'quality': _read_json(os.path.join(path, 'quality.json')),
         }
         if run['timings'] is None and not run['metrics']:
             from dgmc_tpu.resilience.supervisor import (ATTEMPT_PREFIX,
@@ -131,7 +132,8 @@ def load_run(path):
     return {'path': path, 'metrics': _read_jsonl(path), 'timings': None,
             'memory': None, 'dispatch': None, 'efficiency': None,
             'aggregate': None, 'hang': None, 'recovery': None,
-            'flight': None, 'attribution': None, 'qtrace': None}
+            'flight': None, 'attribution': None, 'qtrace': None,
+            'quality': None}
 
 
 def peak_memory(memory):
@@ -281,6 +283,40 @@ def summarize(run):
             out['qtrace_dominant_stage'] = gap['dominant_stage']
         if gap.get('p95_minus_p50_ms') is not None:
             out['qtrace_gap_ms'] = gap['p95_minus_p50_ms']
+
+    quality = run.get('quality')
+    if quality:
+        # The quality plane (quality.json): the run's headline eval
+        # metrics become FLAT summary keys — hits1/hits10/mrr/loss are
+        # what obs.diff's --max-hits1-regression / --min-hits1 gates
+        # read, and a run that stopped emitting them must LOSE the keys
+        # (lost-account-fails), never inherit stale ones.
+        headline = (quality.get('headline') or {}).get('metrics') or {}
+        for key, val in headline.items():
+            if val is not None:
+                out[key] = val
+        scenarios = quality.get('scenarios') or {}
+        if scenarios:
+            out['quality_scenarios'] = {
+                name: {m: v.get('last')
+                       for m, v in (sc.get('metrics') or {}).items()}
+                for name, sc in scenarios.items()}
+        consensus = quality.get('consensus') or {}
+        if consensus.get('iterations'):
+            out['consensus_iterations'] = consensus['iterations']
+            out['consensus_converged_at'] = consensus.get('converged_at')
+        serve_q = quality.get('serve') or {}
+        if serve_q.get('queries'):
+            out['quality_queries'] = serve_q['queries']
+            out['quality_low_confidence'] = serve_q.get('low_confidence')
+            out['quality_saturated_queries'] = serve_q.get(
+                'saturated_queries')
+        audit = serve_q.get('audit') or {}
+        if audit.get('audited'):
+            out['audit_queries'] = audit['audited']
+            out['audit_recall_mean'] = audit.get('recall_mean')
+            out['audit_recall_min'] = audit.get('recall_min')
+            out['audit_exact'] = audit.get('exact')
 
     flight = run.get('flight')
     if flight:
@@ -561,6 +597,34 @@ def render(run):
             fn = s['first_nonfinite']
             lines.append(f'  FIRST NON-FINITE at step {fn.get("step")} '
                          f'stage {fn.get("stage")!r}')
+
+    quality = run.get('quality')
+    if quality and (s.get('quality_scenarios') or s.get('quality_queries')
+                    or s.get('consensus_iterations')):
+        lines.append('-- quality plane --')
+        for name, mets in (s.get('quality_scenarios') or {}).items():
+            rendered = '  '.join(
+                f'{m}={v:.4f}' for m, v in sorted(mets.items())
+                if isinstance(v, (int, float)))
+            lines.append(f'  {name:<16} {rendered}')
+        if s.get('consensus_iterations'):
+            conv = s.get('consensus_converged_at')
+            lines.append(
+                f'  consensus        {s["consensus_iterations"]} '
+                f'iterations, converged at '
+                f'{conv if conv is not None else "never (tol)"}')
+        if s.get('quality_queries'):
+            lines.append(
+                f'  serve confidence {s["quality_queries"]} queries, '
+                f'{s.get("quality_low_confidence", 0)} low-confidence, '
+                f'{s.get("quality_saturated_queries", 0)} shortlist-'
+                f'saturated')
+        if s.get('audit_queries'):
+            rmin = s.get('audit_recall_min')
+            lines.append(
+                f'  shadow audit     {s["audit_queries"]} audited, '
+                f'{s.get("audit_exact", 0)} exact, recall min '
+                f'{rmin if rmin is not None else "-"}')
 
     lines.append('-- metrics --')
     lines.append(f'  records          {s["metrics_records"]}')

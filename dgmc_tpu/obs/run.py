@@ -193,6 +193,11 @@ class RunObserver:
         self._server = None
         self._live_gauges = {}
         self._metrics_providers = []
+        self._status_sections = {}
+        #: Quality plane: the run-level accuracy account (Hits@k / MRR /
+        #: loss per scenario, consensus convergence, serve confidence),
+        #: flushed as quality.json beside the latency artifacts.
+        self.quality = None
         self._last_efficiency = None
         self._last_activity = time.time()
         self._dispatch_sink = None
@@ -227,7 +232,9 @@ class RunObserver:
         if self.enabled:
             os.makedirs(obs_dir, exist_ok=True)
             from dgmc_tpu.obs import live as live_mod
+            from dgmc_tpu.obs import quality as quality_mod
             self._live_mod = live_mod
+            self.quality = quality_mod.QualityTracker()
             # Always-on: the ring buffer is O(capacity) memory and a
             # record is one deque append — the trailing context must
             # exist BEFORE anyone knows an anomaly is coming.
@@ -251,7 +258,7 @@ class RunObserver:
                     return live_mod.TelemetryServer(
                         port, health_fn=self.health,
                         metrics_fn=self.prometheus_metrics,
-                        status_fn=self.timings, routes=routes,
+                        status_fn=self.status, routes=routes,
                         # All interfaces by default (external probers
                         # are the point); DGMC_TPU_OBS_BIND narrows it
                         # (e.g. 127.0.0.1 on multi-tenant machines).
@@ -519,6 +526,11 @@ class RunObserver:
             self._probe_seen += 1
             self._metrics.log(self._step_index, probe=name, value=value,
                               **meta)
+        if name == 'consensus_delta' and self.quality is not None:
+            # The refinement loop's per-iteration correction norm feeds
+            # the quality plane's iterations-to-converge account (the
+            # probe's `step` meta is the consensus iteration index).
+            self.quality.observe_consensus(meta.get('step'), value)
         if self.flight is not None:
             try:
                 fval = float(value)
@@ -874,6 +886,42 @@ class RunObserver:
         self._metrics_providers.append(provider)
         return self
 
+    def add_status_section(self, name, fn):
+        """Register a 0-arg callable whose payload joins every
+        ``/status`` scrape under ``name`` — how the serve plane folds
+        the ``qtrace_summary.json`` block into the same response as the
+        timing account ("how fast AND how good" in one scrape). A
+        section that raises degrades to an ``{'error': ...}`` stub
+        instead of failing the whole status page."""
+        if not callable(fn):
+            raise TypeError(f'status section must be callable: {fn!r}')
+        self._status_sections[name] = fn
+        return self
+
+    def quality_eval(self, scenario, summary=None, step=None, **metrics):
+        """Record one eval summary on the quality plane (no-op without
+        an obs dir). Accepts either the ``eval_summary`` dict or named
+        fractions directly."""
+        if self.quality is None:
+            return
+        payload = dict(summary) if summary else {}
+        payload.update(metrics)
+        self.quality.observe_eval(scenario, payload, step=step)
+
+    def status(self):
+        """The ``/status`` payload: the timing account at the top level
+        (scrape compatibility — ``compile``/``steps``/... keep their
+        place) plus the quality block and any registered sections."""
+        out = self.timings()
+        if self.quality is not None:
+            out['quality'] = self.quality.payload()
+        for name, fn in self._status_sections.items():
+            try:
+                out[name] = fn()
+            except Exception as e:  # degrade, don't 500 the scrape
+                out[name] = {'error': f'{type(e).__name__}: {e}'}
+        return out
+
     def _watchdog_context(self):
         """Run-state snapshot for the hang report (called from the
         watchdog thread; cached there for the lock-free signal path)."""
@@ -932,6 +980,8 @@ class RunObserver:
         if not self.enabled:
             return
         self._write('timings.json', self.timings())
+        if self.quality is not None:
+            self._write('quality.json', self.quality.payload())
         self._write('memory.json', {'snapshots': self._snapshots})
         self._write('dispatch.json', {'counts': self._since(
             dispatch_table(), self._dispatch_base)})

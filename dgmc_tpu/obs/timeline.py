@@ -126,6 +126,10 @@ def parse_round(family, number, path):
             d.get('overlap_fraction'),
             _get(d, 'timing', 'overlap_fraction')),
         'skew': _get(d, 'timing', 'per_device_step_skew_ratio'),
+        # Quality plane (PR 17+): rounds carrying a 'quality' block get
+        # accuracy columns; older rounds simply render '-'.
+        'hits1': _first(_get(d, 'quality', 'hits1'),
+                        d.get('hits_at_1')),
     }
     off = d.get('offload') or {}
     if off:
@@ -146,7 +150,13 @@ def parse_round(family, number, path):
         # p99 and the stage the p95−p50 gap attributes to. Older
         # rounds simply lack the block — the columns render '-'.
         qt = d.get('qtrace') or {}
+        # r03+ rounds add the quality account: per-query confidence
+        # and the shadow audit's worst-case shortlist recall.
+        quality = d.get('quality') or {}
+        audit = quality.get('audit') or {}
         row.update({
+            'audit_recall': audit.get('recall_min'),
+            'saturated_frac': quality.get('saturated_frac'),
             'latency_p50_ms': _first(lat.get('server_p50_ms'),
                                      lat.get('client_p50_ms')),
             'latency_p95_ms': _first(lat.get('server_p95_ms'),
@@ -215,7 +225,8 @@ def _render_serve(fam_rows, lines):
     lines.append('== SERVE trajectory ==')
     lines.append(f'  {"round":>5} {"p50":>9} {"p95":>9} {"p99":>9} '
                  f'{"QPS":>7} {"clients":>7} {"warm rta":>9} '
-                 f'{"restarts":>8} {"tail stage":>16}  outcome')
+                 f'{"restarts":>8} {"tail stage":>16} '
+                 f'{"hits@1":>7} {"audit":>7}  outcome')
     for r in fam_rows:
         p50 = r.get('latency_p50_ms')
         p95 = r.get('latency_p95_ms')
@@ -229,7 +240,9 @@ def _render_serve(fam_rows, lines):
             f'{_fmt(r.get("clients"), "{:d}"):>7} '
             f'{_fmt(r.get("warm_restart_s"), "{:.2f}s"):>9} '
             f'{_fmt(r.get("restarts"), "{:d}"):>8} '
-            f'{r.get("dominant_stage") or "-":>16}'
+            f'{r.get("dominant_stage") or "-":>16} '
+            f'{_fmt(r.get("hits1"), "{:.4f}"):>7} '
+            f'{_fmt(r.get("audit_recall"), "{:.2f}"):>7}'
             f'  {r.get("outcome", "?")}')
 
 
@@ -243,11 +256,13 @@ def render(rows):
             _render_serve(fam_rows, lines)
             continue
         offload_col = any(r.get('offload') for r in fam_rows)
+        hits1_col = any(r.get('hits1') is not None for r in fam_rows)
         lines.append(f'== {family} trajectory ==')
         lines.append(f'  {"round":>5} {"pairs/s":>9} {"step p50":>11} '
                      f'{"MFU":>8} {"overlap":>8} {"skew":>7} '
                      f'{"dev":>4}'
                      + (f' {"offload":>9}' if offload_col else '')
+                     + (f' {"hits@1":>7}' if hits1_col else '')
                      + '  outcome')
         for r in fam_rows:
             p50 = r.get('step_p50_ms')
@@ -261,6 +276,8 @@ def render(rows):
                 f'{_fmt(r.get("devices"), "{:d}"):>4}'
                 + (f' {_fmt_offload(r.get("offload")):>9}'
                    if offload_col else '')
+                + (f' {_fmt(r.get("hits1"), "{:.4f}"):>7}'
+                   if hits1_col else '')
                 + f'  {r.get("outcome", "?")}')
     if not lines:
         lines.append('(no BENCH_r*/MULTICHIP_r*/SCALE_r*.json rounds '

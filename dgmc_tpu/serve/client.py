@@ -15,7 +15,7 @@ import urllib.request
 import numpy as np
 
 __all__ = ['sample_query', 'query_payload', 'post_match', 'get_json',
-           'discover_endpoint']
+           'discover_endpoint', 'confidence_of']
 
 
 def sample_query(corpus_x, num_nodes, num_edges, seed=0, noise=0.6):
@@ -92,6 +92,21 @@ def post_match(port, payload, host='127.0.0.1', timeout_s=60.0,
         if echoed:
             out['server_traceparent'] = echoed
     return code, out
+
+
+def confidence_of(response):
+    """The per-query confidence block of a ``/match`` answer.
+
+    Successful answers carry a ``quality`` dict beside ``stages_ms`` —
+    the engine's in-graph proxies (``entropy``, ``margin``,
+    ``correction``, ``saturation``, ``saturated_frac``; see the serve
+    docs for semantics). Returns ``{}`` for errors and for answers from
+    servers predating the quality plane, so callers can always iterate
+    it."""
+    if not isinstance(response, dict):
+        return {}
+    quality = response.get('quality')
+    return dict(quality) if isinstance(quality, dict) else {}
 
 
 def get_json(port, path, host='127.0.0.1', timeout_s=10.0):

@@ -71,7 +71,7 @@ class MatchEngine:
 
     def __init__(self, model, variables, index, router, max_results=5,
                  noise_seed=0, offload=False, offload_chunk=4096,
-                 prefetch_depth=None, obs=None):
+                 prefetch_depth=None, obs=None, audit=False):
         import jax
 
         if model.k < 1:
@@ -83,6 +83,7 @@ class MatchEngine:
         self.router = router
         self.max_results = int(min(max_results, model.k))
         self.offload = bool(offload)
+        self.audit = bool(audit)
         self.offload_chunk = int(offload_chunk)
         if prefetch_depth is None:
             from dgmc_tpu.ops.offload import DEFAULT_PREFETCH_DEPTH
@@ -110,28 +111,63 @@ class MatchEngine:
     def _match_fn(self):
         import jax
         import jax.numpy as jnp
+
+        from dgmc_tpu.obs import probes as _probes
         model, r = self.model, self.max_results
 
-        def ranked(S_0, S_L):
+        def ranked(S_0, S_L, node_mask):
             top_v, pos = jax.lax.top_k(S_L.val, r)
             top_i = jnp.take_along_axis(S_L.idx, pos, axis=-1)
             v0, p0 = jax.lax.top_k(S_0.val, 1)
             i0 = jnp.take_along_axis(S_0.idx, p0, axis=-1)
+            # -- per-query confidence proxies, computed in-graph on the
+            # already-resident correspondence (cost: O(N·k) elementwise,
+            # invisible next to the consensus rerank). Masked means over
+            # the REAL query nodes only; padded rows contribute zero.
+            mask = node_mask.astype(jnp.float32)
+            denom = jnp.maximum(jnp.sum(mask), 1.0)
+
+            def row_mean(x):
+                return jnp.sum(x.astype(jnp.float32) * mask) / denom
+
+            k = S_L.val.shape[-1]
+            if k >= 2:
+                top2, _ = jax.lax.top_k(S_L.val, 2)
+                margin = row_mean(top2[..., 0] - top2[..., 1])
+            else:
+                # Degenerate shortlist of one: the margin is the full
+                # top-1 mass (no runner-up to subtract).
+                margin = row_mean(top_v[..., 0])
+            # Shortlist slots are ordered by the initial score (top_k is
+            # sorted), so the winning slot's index IS the selected
+            # match's rank inside the shortlist; rank k-1 means the
+            # answer sat on the shortlist boundary and a wider search
+            # could have changed it.
+            sel_rank = pos[..., 0].astype(jnp.float32)
+            saturated = ((pos[..., 0] == k - 1).astype(jnp.float32)
+                         if k > 1 else jnp.zeros_like(sel_rank))
             return {'cand_idx': top_i, 'cand_prob': top_v,
-                    'initial_idx': i0[..., 0], 'initial_prob': v0[..., 0]}
+                    'initial_idx': i0[..., 0], 'initial_prob': v0[..., 0],
+                    'shortlist_idx': S_L.idx,
+                    'q_entropy': _probes.entropy(S_L.val, node_mask),
+                    'q_margin': margin,
+                    'q_correction': _probes.delta_norm(
+                        S_L.val, S_0.val, node_mask),
+                    'q_saturation': row_mean(sel_rank / max(k - 1, 1)),
+                    'q_saturated_frac': row_mean(saturated)}
 
         if self.offload:
             def match(variables, q_graph, t_graph, S_idx, h_t_cand, key):
                 S_0, S_L = model.apply(
                     variables, q_graph, t_graph, train=False,
                     rngs={'noise': key}, S_idx=S_idx, h_t_cand=h_t_cand)
-                return ranked(S_0, S_L)
+                return ranked(S_0, S_L, q_graph.node_mask)
         else:
             def match(variables, q_graph, t_graph, h_t, key):
                 S_0, S_L = model.apply(
                     variables, q_graph, t_graph, train=False,
                     rngs={'noise': key}, h_t=h_t)
-                return ranked(S_0, S_L)
+                return ranked(S_0, S_L, q_graph.node_mask)
         return match
 
     def _embed_fn(self):
@@ -203,7 +239,12 @@ class MatchEngine:
                     compiled = jit_match.lower(
                         self._variables, tpl, self._t_graph,
                         self._h_t_dev, self._noise_key).compile()
-                    embed_c = None
+                    # The query path does not need ψ₁ standalone on the
+                    # device tier, but the shadow audit's exhaustive
+                    # re-scan does — compile it here in BOTH tiers so
+                    # the audit never compiles on a live process.
+                    embed_c = jit_embed.lower(
+                        self._psi1_vars(), tpl).compile()
             info = {'bucket': bucket,
                     'exec': compiled,
                     'embed': embed_c,
@@ -221,6 +262,22 @@ class MatchEngine:
                 with (self._obs.compile_label(label) if self._obs
                       else _null()):
                     self._execute(info, tpl)
+                info['compile_s'] = round(time.perf_counter() - t0, 3)
+            if self.audit:
+                # Same discipline for the shadow audit's exhaustive
+                # scan: its host-driven merge steps are jitted per
+                # shape config, and those compiles belong in the
+                # warmup account — the audit thread must stay
+                # execute-only on a live process. The sweep scans a
+                # TRUNCATED table slice (one full chunk + the ragged
+                # tail): jit shape configs depend on the chunk shapes,
+                # not the chunk count, so this compiles everything the
+                # full-corpus audit scan executes at a fraction of the
+                # warm-window cost (warm-beats-cold margins are thin).
+                with (self._obs.compile_label(label) if self._obs
+                      else _null()):
+                    self.exhaustive_topk(tpl, info,
+                                         table=self._audit_warm_slice())
                 info['compile_s'] = round(time.perf_counter() - t0, 3)
             mem = compiled_memory(compiled)
             if mem:
@@ -319,6 +376,42 @@ class MatchEngine:
                                h_t_cand, self._noise_key)
             return {k: np.asarray(v) for k, v in out.items()}
 
+    def _audit_warm_slice(self):
+        """The smallest table slice whose streamed scan walks every jit
+        shape config the full-corpus audit scan walks: one full chunk
+        plus the ragged tail (or the whole table when it fits in one
+        chunk). Used only by the warm() template sweep."""
+        n = self._h_t_host.shape[1]
+        chunk = self.offload_chunk
+        if n <= chunk:
+            return self._h_t_host
+        return self._h_t_host[:, :chunk + (n % chunk)]
+
+    def exhaustive_topk(self, q_padded, info, table=None):
+        """Exhaustive corpus top-k for one padded query batch — the
+        shadow audit's reference scan: query-side ψ₁ through the warm
+        embed executable, then the host-driven streamed scan over the
+        FULL host-resident corpus table (bit-identical tie-breaking to
+        the in-graph shortlist). Deliberately lock-free: the audit runs
+        off the hot path and must not convoy live queries.
+
+        ``table`` overrides the scanned table (the warm() sweep passes
+        the truncated compile-coverage slice); the live audit always
+        scans the full corpus.
+
+        Returns the ``[1, N, k]`` candidate index array (host numpy).
+        """
+        import jax
+
+        from dgmc_tpu.ops.offload import offloaded_corpus_topk
+        q = jax.device_put(q_padded, self._device)
+        h_s = info['embed'](self._psi1_vars(), q)
+        _vals, idx, _stats = offloaded_corpus_topk(
+            h_s, self._h_t_host if table is None else table,
+            self.model.k, self.offload_chunk,
+            depth=self.prefetch_depth, device=self._device)
+        return np.asarray(idx)
+
     def _answer(self, bucket, n_real, out):
         matches = []
         for i in range(n_real):
@@ -337,6 +430,24 @@ class MatchEngine:
             'signature': self.router.signature(bucket),
             'nodes': n_real,
             'matches': matches,
+            # Per-query confidence proxies (deterministic: the fixed
+            # noise key makes them a pure function of the query).
+            'quality': {
+                'entropy': round(float(out['q_entropy']), 6),
+                'margin': round(float(out['q_margin']), 6),
+                'correction': round(float(out['q_correction']), 6),
+                'saturation': round(float(out['q_saturation']), 6),
+                'saturated_frac': round(float(out['q_saturated_frac']),
+                                        6),
+            },
+            # Internal (popped by the HTTP layer before serialization):
+            # the served shortlist rows the shadow audit compares
+            # against the exhaustive scan. Plain int lists, not the
+            # device array — answers stay ==-comparable (the repeat-
+            # determinism pin) and drop no device buffer reference.
+            '_audit': {'shortlist_idx': [
+                [int(t) for t in row]
+                for row in out['shortlist_idx'][0, :n_real]]},
         }
 
 

@@ -149,6 +149,23 @@ def add_serve_args(parser):
                         help='end-to-end query SLO in ms; a breaching '
                              'query dumps the flight recorder with its '
                              'span tree attached (0 = off)')
+    parser.add_argument('--min-margin', '--min_margin',
+                        dest='min_margin', type=float, default=0.0,
+                        help='low-confidence floor on the per-query '
+                             'top-1/top-2 margin: a served answer whose '
+                             'margin falls below it dumps the flight '
+                             'recorder with the offending query attached '
+                             '— the SLO pattern applied to accuracy '
+                             '(0 = off)')
+    parser.add_argument('--audit-sample', '--audit_sample',
+                        dest='audit_sample', type=float, default=0.0,
+                        help='shadow-audit keep fraction: that share of '
+                             'live queries (deterministic hash of '
+                             'seed+trace id) is re-scored through the '
+                             'exhaustive corpus scan off the hot lock, '
+                             'and shortlist recall@k against the served '
+                             'answer lands in quality.json — on the '
+                             'exact tiers recall must be 1.0 (0 = off)')
     from dgmc_tpu.obs import add_obs_flag
     from dgmc_tpu.resilience import add_supervisor_args
     add_obs_flag(parser)
@@ -186,6 +203,8 @@ class ServeService:
         # += needs its own lock or concurrent clients lose increments.
         self._counts = threading.Lock()
         self._stop = threading.Event()
+        self.low_confidence = 0
+        self.auditor = None
         self.qtracer = None
         if getattr(args, 'obs_dir', None):
             slo_ms = getattr(args, 'slo_ms', 0.0) or 0.0
@@ -237,13 +256,30 @@ class ServeService:
         router = QueryRouter(parse_buckets(args.buckets),
                              corpus.num_nodes, corpus.num_edges)
         from dgmc_tpu.serve.engine import MatchEngine
+        audit_rate = getattr(args, 'audit_sample', 0.0) or 0.0
         self.engine = MatchEngine(
             model, variables, index, router,
             max_results=args.max_results, noise_seed=args.noise_seed,
             offload=args.offload_corpus,
             offload_chunk=args.offload_chunk,
-            prefetch_depth=args.prefetch_depth or None, obs=obs)
+            prefetch_depth=args.prefetch_depth or None, obs=obs,
+            audit=audit_rate > 0)
         warm_report = phase('warm', self.engine.warm)
+
+        if obs.quality is not None and audit_rate > 0:
+            obs.quality.set_audit_params(audit_rate,
+                                         getattr(args, 'seed', 0))
+        if audit_rate > 0:
+            from dgmc_tpu.serve.audit import ShadowAuditor
+            self.auditor = ShadowAuditor(
+                self.engine, obs.quality, sample_rate=audit_rate,
+                seed=getattr(args, 'seed', 0))
+        # One scrape answers "how fast AND how good": the qtrace
+        # summary joins /status beside the observer's own quality block.
+        if self.qtracer is not None:
+            obs.add_status_section('qtrace', self.qtracer.summary)
+        if obs.quality is not None:
+            obs.add_metrics_provider(obs.quality.metric_families)
 
         self.phases['ready_s'] = round(time.perf_counter() - t_start, 3)
         cache_hit = cache_info['cache'] == 'hit'
@@ -251,6 +287,9 @@ class ServeService:
         obs.set_gauge('corpus_cache_hit', 1 if cache_hit else 0)
         obs.set_gauge('serve_buckets_warm', self.engine.buckets_warm)
         obs.set_gauge('queries_served', 0)
+        obs.set_gauge('low_confidence_breaches', 0)
+        if self.auditor is not None:
+            obs.set_gauge('audited_queries', 0)
         warm_compiles = self._compile_events()
         obs.set_gauge('serve_warmup_compiles', warm_compiles)
         obs.log(0, event='serve_ready', cache=cache_info['cache'],
@@ -441,9 +480,45 @@ class ServeService:
             self.queries_served += 1
             served = self.queries_served
         self.obs.set_gauge('queries_served', served)
+        audit_info = answer.pop('_audit', None)
+        self._observe_quality(answer, graph, trace, audit_info)
         answer['latency_ms'] = round(
             (time.perf_counter() - t0) * 1e3, 3)
         return 200, answer
+
+    def _observe_quality(self, answer, graph, trace, audit_info):
+        """Quality-plane bookkeeping for one served answer: histogram
+        the confidence proxies, fire the --min-margin breach hook, and
+        hand the sampled query to the shadow auditor."""
+        quality = answer.get('quality') or {}
+        tracker = self.obs.quality
+        if tracker is not None and quality:
+            tracker.observe_query(quality)
+        min_margin = getattr(self.args, 'min_margin', 0.0) or 0.0
+        margin = quality.get('margin')
+        if min_margin > 0 and margin is not None \
+                and margin < min_margin:
+            with self._counts:
+                self.low_confidence += 1
+                breaches = self.low_confidence
+            if tracker is not None:
+                tracker.record_low_confidence()
+            self.obs.set_gauge('low_confidence_breaches', breaches)
+            # The qtrace SLO pattern applied to accuracy: dump the
+            # flight recorder NOW, with the under-confident query
+            # attached — trailing run context + the offending answer's
+            # own confidence decomposition in one artifact.
+            self.obs.flight_dump('low-confidence', extra={
+                'quality': dict(quality),
+                'min_margin': min_margin,
+                'query': {'bucket': answer.get('bucket'),
+                          'nodes': answer.get('nodes'),
+                          'trace_id': (trace.trace_id
+                                       if trace is not None else None)},
+            })
+        if self.auditor is not None and trace is not None \
+                and audit_info is not None:
+            self.auditor.maybe_submit(trace.trace_id, graph, audit_info)
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -463,6 +538,9 @@ class ServeService:
             if self.obs.watchdog is not None:
                 self.obs.watchdog.beat('idle')
             if time.time() - last_flush >= flush_every_s:
+                if self.auditor is not None:
+                    self.obs.set_gauge('audited_queries',
+                                       self.auditor.audited)
                 self.obs.flush()
                 if self.qtracer is not None:
                     self.qtracer.flush()
@@ -474,6 +552,14 @@ class ServeService:
         self._stop.set()
 
     def close(self):
+        if self.auditor is not None:
+            # Finish the queued audits so the final quality.json and
+            # gauges carry the complete account, then stop the thread.
+            self.auditor.drain(timeout_s=30.0)
+            self.auditor.close()
+            if self.obs is not None:
+                self.obs.set_gauge('audited_queries',
+                                   self.auditor.audited)
         if self.qtracer is not None:
             self.qtracer.flush()
         if self.obs is not None:

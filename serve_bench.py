@@ -45,6 +45,18 @@ server-side span tree cannot see), and the measured tracing overhead
 traced p50 penalty at <5%). The per-stage sums must cover the traced
 end-to-end total within tolerance — a span tree that loses the query's
 time is a failed round, not a cosmetic gap.
+
+Since r03 the record also carries the ``quality`` account: Hits@1
+against the sampled ground truth, the distribution of the per-query
+confidence proxies every answer returns beside ``stages_ms``
+(``entropy``, ``margin``, ``correction``, ``saturation``), the
+shortlist-saturation fraction, and the shadow-audit block scraped from
+the drained worker's ``quality.json`` (sampled queries re-scored
+through the exhaustive scan off the hot lock). The driver gates on the
+audit: recall against the exhaustive reference must be exactly 1.0 —
+the engine's shortlist tiers are bit-exact, so anything less is a
+correctness bug, not noise — and the saturation fraction must be
+MEASURED (``None`` means the confidence plane never reported).
 """
 
 import argparse
@@ -60,8 +72,8 @@ import time
 
 from dgmc_tpu.obs.observe import percentile
 from dgmc_tpu.obs.qtrace import format_traceparent
-from dgmc_tpu.serve.client import (discover_endpoint, get_json,
-                                   post_match, query_payload,
+from dgmc_tpu.serve.client import (confidence_of, discover_endpoint,
+                                   get_json, post_match, query_payload,
                                    sample_query)
 from dgmc_tpu.serve.corpus import synthetic_corpus
 
@@ -94,6 +106,16 @@ def parse_args(argv=None):
                    help='run the service in the host-RAM corpus tier')
     p.add_argument('--startup-timeout', dest='startup_timeout',
                    type=float, default=300.0)
+    p.add_argument('--audit-sample', dest='audit_sample', type=float,
+                   default=1.0,
+                   help='shadow-audit sample rate passed to the '
+                        'service (1.0: every query is re-scored '
+                        'through the exhaustive scan, so the recall '
+                        'gate is deterministic; 0 disables)')
+    p.add_argument('--min-margin', dest='min_margin', type=float,
+                   default=0.0,
+                   help='low-confidence margin threshold passed to '
+                        'the service (0 disables the breach hook)')
     p.add_argument('--seed', type=int, default=0)
     return p.parse_args(argv)
 
@@ -190,6 +212,7 @@ def run_clients(jobs_per_client, endpoint, deadline_s=600.0,
                          'stages_ms': r[1].get('stages_ms'),
                          'trace_ms': r[1].get('trace_ms'),
                          'client_ms': r[1].get('client_ms'),
+                         'quality': confidence_of(r[1]),
                          'trace_adopted':
                              r[1].get('trace_id') == want_id})
                     if progress is not None:
@@ -287,6 +310,67 @@ def qtrace_attribution(ok_rows):
     }
 
 
+def quality_account(ok_rows, serve_quality):
+    """The round's ``quality`` block: per-query confidence
+    distributions collected client-side (every 200 answer carries the
+    engine's proxies beside ``stages_ms``) plus the worker's own
+    serve-side account — ``low_confidence`` breaches and the
+    shadow-audit evidence. The caller stamps ``hits1`` in afterwards
+    (it owns the ground truth)."""
+    samples = {}
+    sat = []
+    for r in ok_rows:
+        q = r.get('quality') or {}
+        for sig in ('entropy', 'margin', 'correction', 'saturation'):
+            if q.get(sig) is not None:
+                samples.setdefault(sig, []).append(float(q[sig]))
+        if q.get('saturated_frac') is not None:
+            sat.append(float(q['saturated_frac']))
+    signals = {}
+    for sig, vals in sorted(samples.items()):
+        vals.sort()
+        signals[sig] = {'mean': round(sum(vals) / len(vals), 6),
+                        'p50': round(percentile(vals, 0.5), 6),
+                        'p95': round(percentile(vals, 0.95), 6)}
+    return {
+        'signals': signals,
+        'saturated_frac': (round(sum(sat) / len(sat), 6)
+                           if sat else None),
+        'low_confidence': serve_quality.get('low_confidence'),
+        'audit': serve_quality.get('audit'),
+    }
+
+
+def read_worker_quality(obs_root):
+    """The worker's drained ``quality.json`` ``serve`` block. Read from
+    disk AFTER teardown (freshest attempt wins — the post-chaos
+    worker's account): the graceful close drains the shadow-audit
+    queue before the final flush, so the on-disk audit numbers are
+    complete, unlike a live ``/status`` scrape racing the audit
+    thread."""
+    dirs = [obs_root]
+    try:
+        dirs += [os.path.join(obs_root, d)
+                 for d in sorted(os.listdir(obs_root))
+                 if d.startswith('attempt_')]
+    except OSError:
+        pass
+    best = None
+    for d in dirs:
+        path = os.path.join(d, 'quality.json')
+        try:
+            mtime = os.path.getmtime(path)
+            with open(path) as f:
+                payload = json.load(f)
+        except (OSError, ValueError):
+            continue
+        if best is None or mtime > best[0]:
+            best = (mtime, payload)
+    if best is None or not isinstance(best[1], dict):
+        return {}
+    return best[1].get('serve') or {}
+
+
 def main(argv=None):
     args = parse_args(argv)
     work = os.path.abspath(args.workdir)
@@ -308,6 +392,9 @@ def main(argv=None):
         '--num_steps', str(args.num_steps), '--k', str(args.k),
         '--obs-dir', obs_root, '--obs-port', '0',
         '--watchdog-deadline', '120',
+        '--audit-sample', str(args.audit_sample),
+        '--min-margin', str(args.min_margin),
+        '--seed', str(args.seed),
     ] + (['--offload-corpus'] if args.offload_corpus else [])
 
     # Query pool: mixed bucket sizes, deterministic, ground truth known.
@@ -432,11 +519,14 @@ def main(argv=None):
     qtrace_block = qtrace_attribution(ok)
     if qtrace_block is not None:
         qtrace_block['overhead'] = overhead
+    quality_block = quality_account(ok, read_worker_quality(obs_root))
     lats = sorted(r['latency_s'] for r in ok)
     server_ms = sorted(r['server_ms'] for r in ok
                        if r.get('server_ms') is not None)
     hits = sum(r['hits'] for r in ok)
     total_gt = sum(r['n'] for r in ok)
+    quality_block['hits1'] = (round(hits / total_gt, 4)
+                              if total_gt else None)
     steps = (status.get('steps') or {})
     compiles_load = ((c_after_1 - c_warm)
                      if None not in (c_after_1, c_warm) else None)
@@ -487,6 +577,7 @@ def main(argv=None):
                 if steps.get('p95_s') else None),
         },
         'hits_at_1': round(hits / total_gt, 4) if total_gt else None,
+        'quality': quality_block,
         'qtrace': qtrace_block,
         'restart': {
             'cold_first_answer_s': cold_s,
@@ -565,6 +656,20 @@ def main(argv=None):
         elif frac >= 0.05:
             problems.append(f'tracing overhead {frac:.1%} >= 5% '
                             f'on p50')
+    if quality_block['saturated_frac'] is None:
+        problems.append('confidence plane unmeasured (no answer '
+                        'carried a quality block)')
+    audit = quality_block.get('audit') or {}
+    if args.audit_sample > 0:
+        if not audit.get('audited'):
+            problems.append('shadow audit unmeasured (audit enabled '
+                            'but no query was re-scored)')
+        elif audit.get('recall_min') != 1.0:
+            # Both shortlist tiers are bit-exact against the exhaustive
+            # scan, so any recall below 1.0 is a correctness bug.
+            problems.append(f"shadow-audit recall_min "
+                            f"{audit.get('recall_min')} != 1.0 against "
+                            f"the exhaustive reference")
     record['outcome'] = ('completed' if not problems
                          else f'failed ({"; ".join(problems)})')
 

@@ -1,0 +1,254 @@
+"""Quality plane contract: the pinned quality.json schema, deterministic
+shadow-audit sampling, and the accuracy diff gates' teeth."""
+
+import json
+import os
+
+from dgmc_tpu.models.evalsum import eval_summary
+from dgmc_tpu.obs import diff as diff_mod
+from dgmc_tpu.obs.live import prometheus_exposition
+from dgmc_tpu.obs.quality import (AUDIT_TRACE_ID_CAP, QUALITY_SIGNALS,
+                                  QualityTracker, audit_keep)
+from tests.obs.test_diff import write_run
+from tests.obs.test_live import parse_exposition
+
+
+# ---------------------------------------------------------------------------
+# eval_summary (the shared helper every experiment CLI routes through)
+# ---------------------------------------------------------------------------
+
+def test_eval_summary_normalizes_counts():
+    s = eval_summary(200, loss=1.25, hits1=100, hits10=150)
+    assert s == {'count': 200.0, 'loss': 1.25, 'hits1': 0.5,
+                 'hits10': 0.75}
+
+
+def test_eval_summary_empty_split_is_zero_not_nan():
+    s = eval_summary(0, hits1=0)
+    assert s['hits1'] == 0.0
+    # ...but the empty account stays visible through count.
+    assert s['count'] == 0.0
+
+
+# ---------------------------------------------------------------------------
+# quality.json schema pin
+# ---------------------------------------------------------------------------
+
+def _fed_tracker():
+    t = QualityTracker()
+    t.observe_eval('dbp15k', eval_summary(100, loss=2.0, hits1=40,
+                                          hits10=70), step=10)
+    t.observe_eval('dbp15k', eval_summary(100, loss=1.0, hits1=55,
+                                          hits10=80), step=20)
+    for i, v in enumerate([1.0, 0.4, 0.1, 0.01]):
+        t.observe_consensus(i, v)
+    t.observe_query({'entropy': 1.2, 'margin': 0.3, 'correction': 0.05,
+                     'saturation': 0.1, 'saturated_frac': 0.0})
+    t.record_low_confidence()
+    t.set_audit_params(0.5, seed=7)
+    t.observe_audit('aa' * 16, 1.0, exact=True)
+    return t
+
+
+def test_payload_keyset_is_pinned():
+    """The exact keyset at every level of quality.json. Additions are
+    fine — but they must bump QUALITY_SCHEMA_VERSION and this pin
+    together, because obs.report / obs.diff / obs.timeline and the CI
+    artifact uploads all parse these fields by name."""
+    p = _fed_tracker().payload()
+    assert set(p) == {'schema', 'headline', 'scenarios', 'consensus',
+                      'serve'}
+    assert set(p['headline']) == {'scenario', 'step', 'metrics'}
+    assert set(p['scenarios']) == {'dbp15k'}
+    sc = p['scenarios']['dbp15k']
+    assert set(sc) == {'evals', 'count', 'step', 'metrics'}
+    assert set(sc['metrics']) == {'loss', 'hits1', 'hits10'}
+    for m in sc['metrics'].values():
+        assert set(m) == {'first', 'last', 'best'}
+    assert set(p['consensus']) == {'events', 'iterations',
+                                   'per_iteration', 'tol',
+                                   'converged_at', 'first_mean',
+                                   'final_mean'}
+    for slot in p['consensus']['per_iteration'].values():
+        assert set(slot) == {'count', 'mean', 'last'}
+    assert set(p['serve']) == {'queries', 'low_confidence',
+                               'saturated_queries', 'signals', 'audit'}
+    assert set(p['serve']['signals']) == set(QUALITY_SIGNALS)
+    for snap in p['serve']['signals'].values():
+        assert snap is None or set(snap) == {'count', 'mean', 'p50',
+                                             'p95'}
+    assert set(p['serve']['audit']) == {'sample_rate', 'seed',
+                                        'audited', 'exact',
+                                        'recall_mean', 'recall_min',
+                                        'trace_ids', 'truncated'}
+    json.dumps(p)  # the artifact must serialize as-is
+
+
+def test_first_last_best_are_metric_aware():
+    p = _fed_tracker().payload()
+    m = p['scenarios']['dbp15k']['metrics']
+    assert m['hits1'] == {'first': 0.4, 'last': 0.55, 'best': 0.55}
+    # loss improves DOWNWARD: best is the minimum.
+    assert m['loss'] == {'first': 2.0, 'last': 1.0, 'best': 1.0}
+    assert p['headline']['metrics']['hits1'] == 0.55
+    assert p['headline']['step'] == 20
+
+
+def test_consensus_convergence_account():
+    p = _fed_tracker().payload()
+    c = p['consensus']
+    assert c['events'] == 4 and c['iterations'] == 4
+    assert c['first_mean'] == 1.0 and c['final_mean'] == 0.01
+    # tol 0.05: iteration 3 (0.01 <= 0.05 * 1.0) is the first under it.
+    assert c['converged_at'] == 3
+
+
+def test_nonfinite_metrics_never_enter_the_account():
+    t = QualityTracker()
+    t.observe_eval('x', {'count': 10, 'hits1': float('nan'),
+                         'loss': float('inf'), 'mrr': 0.5})
+    m = t.payload()['scenarios']['x']['metrics']
+    assert set(m) == {'mrr'}
+
+
+# ---------------------------------------------------------------------------
+# shadow-audit sampling determinism
+# ---------------------------------------------------------------------------
+
+def test_audit_keep_is_deterministic_and_seeded():
+    ids = [f'{i:032x}' for i in range(400)]
+    kept = [t for t in ids if audit_keep(7, t, 0.25)]
+    # Byte-identical across calls: a pure function of (seed, id, rate).
+    assert kept == [t for t in ids if audit_keep(7, t, 0.25)]
+    # The rate actually thins (loose bounds; the hash is uniform).
+    assert 0 < len(kept) < len(ids)
+    # A different seed audits a DIFFERENT set — replicas can decorrelate.
+    assert kept != [t for t in ids if audit_keep(8, t, 0.25)]
+    # Edge rates short-circuit.
+    assert all(audit_keep(7, t, 1.0) for t in ids)
+    assert not any(audit_keep(7, t, 0.0) for t in ids)
+
+
+def test_audit_trace_ids_are_capped():
+    t = QualityTracker()
+    for i in range(AUDIT_TRACE_ID_CAP + 10):
+        t.observe_audit(f'{i:032x}', 1.0, exact=True)
+    audit = t.payload()['serve']['audit']
+    assert len(audit['trace_ids']) == AUDIT_TRACE_ID_CAP
+    assert audit['truncated'] == 10
+    assert audit['audited'] == AUDIT_TRACE_ID_CAP + 10
+    assert audit['recall_min'] == 1.0
+
+
+# ---------------------------------------------------------------------------
+# RunObserver integration: quality.json + /status + exposition
+# ---------------------------------------------------------------------------
+
+def test_flush_writes_quality_json(tmp_path):
+    from dgmc_tpu.obs.run import RunObserver
+    obs = RunObserver(str(tmp_path / 'obs'))
+    obs.quality_eval('willow', eval_summary(50, hits1=30), step=3)
+    obs.quality_eval('willow', hits1=0.7, step=4)  # kwargs form
+    obs.flush()
+    with open(tmp_path / 'obs' / 'quality.json') as f:
+        payload = json.load(f)
+    m = payload['scenarios']['willow']['metrics']['hits1']
+    assert m == {'first': 0.6, 'last': 0.7, 'best': 0.7}
+    assert payload['headline']['metrics'] == {'hits1': 0.7}
+    obs.close()
+
+
+def test_disabled_observer_quality_is_noop():
+    from dgmc_tpu.obs.run import RunObserver
+    obs = RunObserver(None)
+    assert obs.quality is None
+    obs.quality_eval('x', hits1=0.5)  # must not raise
+    obs.close()
+
+
+def test_status_carries_quality_and_sections(tmp_path):
+    from dgmc_tpu.obs.run import RunObserver
+    obs = RunObserver(str(tmp_path / 'obs'))
+    obs.add_status_section('qtrace', lambda: {'queries': 3})
+    obs.add_status_section('broken', lambda: 1 / 0)
+    st = obs.status()
+    # The timing account keeps its top-level keys (scrape compat)...
+    assert 'compile' in st and 'steps' in st
+    # ...and the quality block plus registered sections join it.
+    assert st['quality']['schema'] >= 1
+    assert st['qtrace'] == {'queries': 3}
+    assert 'error' in st['broken']  # degrade, don't 500 the scrape
+    obs.close()
+
+
+def test_metric_families_render_strict_exposition():
+    fams = _fed_tracker().metric_families()
+    parsed = parse_exposition(prometheus_exposition(fams))
+    hist = parsed['dgmc_query_quality']
+    assert hist['type'] == 'histogram'
+    signals = {lbl['signal'] for _, lbl, _ in hist['samples']}
+    assert signals == set(QUALITY_SIGNALS)
+    assert parsed['dgmc_quality_low_confidence_total']['samples'][0][2] \
+        == 1.0
+    assert parsed['dgmc_quality_audited_total']['samples'][0][2] == 1.0
+    assert parsed['dgmc_quality_audit_recall_min']['samples'][0][2] \
+        == 1.0
+
+
+# ---------------------------------------------------------------------------
+# obs.diff accuracy gates
+# ---------------------------------------------------------------------------
+
+def _write_quality(run_dir, hits1=None, scenario='dbp15k'):
+    t = QualityTracker()
+    if hits1 is not None:
+        t.observe_eval(scenario, {'count': 100, 'hits1': hits1}, step=1)
+    with open(os.path.join(run_dir, 'quality.json'), 'w') as f:
+        json.dump(t.payload(), f)
+
+
+def test_hits1_unconfigured_is_informational(tmp_path):
+    a = write_run(tmp_path, 'a')
+    b = write_run(tmp_path, 'b')
+    _write_quality(a, hits1=0.9)
+    _write_quality(b, hits1=0.1)  # an 89% collapse...
+    # ...passes without the gates configured: quality gating is opt-in
+    # per invocation, like --min-overlap.
+    assert diff_mod.main([a, b]) == 0
+
+
+def test_max_hits1_regression_gate_fires(tmp_path, capsys):
+    a = write_run(tmp_path, 'a')
+    b = write_run(tmp_path, 'b')
+    _write_quality(a, hits1=0.90)
+    _write_quality(b, hits1=0.80)  # -11.1% relative
+    assert diff_mod.main([a, b, '--max-hits1-regression', '0.05']) == 1
+    assert 'hits1' in capsys.readouterr().out
+    # The same pair clears a looser bound, and improvement passes.
+    assert diff_mod.main([a, b, '--max-hits1-regression', '0.2']) == 0
+    assert diff_mod.main([b, a, '--max-hits1-regression', '0.05']) == 0
+
+
+def test_min_hits1_absolute_floor(tmp_path, capsys):
+    a = write_run(tmp_path, 'a')
+    b = write_run(tmp_path, 'b')
+    _write_quality(a, hits1=0.90)
+    _write_quality(b, hits1=0.80)
+    assert diff_mod.main([a, b, '--min-hits1', '0.85']) == 1
+    assert 'floor' in capsys.readouterr().out
+    assert diff_mod.main([a, b, '--min-hits1', '0.5']) == 0
+    # The floor judges the CANDIDATE alone: even an improving run
+    # under it fails (the paper-faithfulness bar is absolute).
+    assert diff_mod.main([b, a, '--min-hits1', '0.95']) == 1
+
+
+def test_lost_quality_account_fails(tmp_path, capsys):
+    """A candidate that stopped emitting the quality account must FAIL
+    the diff — vanished numbers are the easiest regression to ship."""
+    a = write_run(tmp_path, 'a')
+    b = write_run(tmp_path, 'b')
+    _write_quality(a, hits1=0.9)
+    assert diff_mod.main([a, b]) == 1
+    assert 'missing from candidate' in capsys.readouterr().out
+    # Baseline never measured quality: skip, not fail.
+    assert diff_mod.main([b, a]) == 0

@@ -275,6 +275,92 @@ def test_stage_histograms_in_metrics(service):
     assert families['dgmc_qtrace_queries_total']['samples'][0][2] >= 1
 
 
+def test_answer_carries_confidence(service):
+    """Every 200 answer carries the per-query confidence block beside
+    stages_ms: the engine's in-graph proxies, JSON-native floats."""
+    from dgmc_tpu.serve.client import confidence_of
+    code, resp = post_match(service.port, _query(12)[0])
+    assert code == 200
+    quality = confidence_of(resp)
+    assert set(quality) == {'entropy', 'margin', 'correction',
+                            'saturation', 'saturated_frac'}
+    for name, v in quality.items():
+        assert isinstance(v, float), name
+        assert np.isfinite(v), name
+    assert quality['entropy'] >= 0
+    assert quality['margin'] >= 0
+    assert 0 <= quality['saturation'] <= 1
+    assert 0 <= quality['saturated_frac'] <= 1
+    # The private audit payload never leaks onto the wire.
+    assert '_audit' not in resp
+    # Errors have no confidence: the helper degrades to {}.
+    assert confidence_of({'error': 'bad-query'}) == {}
+
+
+def test_quality_metrics_and_status(service):
+    """The quality plane's live surfaces: dgmc_query_quality histograms
+    through the strict parser, and /status carrying the quality payload
+    plus the qtrace section beside the timing account."""
+    post_match(service.port, _query(13)[0])
+    _, text = get_json(service.port, '/metrics')
+    families = parse_exposition(text)
+    fam = families['dgmc_query_quality']
+    assert fam['type'] == 'histogram'
+    counts = {labels['signal']: value
+              for (name, labels, value) in fam['samples']
+              if name.endswith('_count')}
+    from dgmc_tpu.obs.quality import QUALITY_SIGNALS
+    assert set(counts) == set(QUALITY_SIGNALS)
+    assert all(v >= 1 for v in counts.values())
+    assert families['dgmc_quality_audited_total']['samples'][0][2] == 0
+
+    _, status = get_json(service.port, '/status')
+    serve_q = status['quality']['serve']
+    assert serve_q['queries'] >= 1
+    assert serve_q['audit']['audited'] == 0  # audit not enabled here
+    assert status['qtrace']['queries'] >= 1  # the registered section
+
+
+def test_shadow_audit_exact_tier(tmp_path):
+    """Tentpole (c): a service with the shadow audit on the host-RAM
+    offload tier. The audited set is the seeded-hash keep set exactly
+    (byte-identical, predictable from audit_keep), and every audited
+    query's served shortlist matches the exhaustive corpus scan —
+    recall 1.0, because the offload tier is bit-exact."""
+    import hashlib
+    from dgmc_tpu.obs.qtrace import format_traceparent
+    from dgmc_tpu.obs.quality import audit_keep
+    args = _args(tmp_path)
+    args.offload_corpus = True
+    args.audit_sample = 0.5
+    args.seed = 3
+    svc = ServeService(args).start()
+    try:
+        sent = []
+        for i in range(12):
+            tid = hashlib.sha256(f'audit-q{i}'.encode()).hexdigest()[:32]
+            tp = format_traceparent(tid, tid[:16])
+            code, resp = post_match(svc.port, _query(100 + i)[0],
+                                    traceparent=tp)
+            assert code == 200 and resp['trace_id'] == tid
+            sent.append(tid)
+        assert svc.auditor is not None
+        assert svc.auditor.drain(timeout_s=60.0)
+        expect = [t for t in sent if audit_keep(3, t, 0.5)]
+        assert expect, 'seed 3 must keep at least one of these ids'
+        audit = svc.obs.quality.payload()['serve']['audit']
+        assert audit['trace_ids'] == expect
+        assert audit['audited'] == len(expect)
+        assert audit['sample_rate'] == 0.5 and audit['seed'] == 3
+        assert audit['recall_min'] == 1.0
+        assert audit['recall_mean'] == 1.0
+        assert audit['exact'] == len(expect)
+        assert svc.auditor.dropped == 0 and svc.auditor.errors == 0
+    finally:
+        svc.stop()
+        svc.close()
+
+
 def test_padding_buckets_in_status(service):
     """The router records collations in the registry: a recorded serve
     run's /status (== timings.json) carries the padding-bucket rows the
