@@ -518,6 +518,16 @@ def _bench_sparse_leg(bf16, pairs=1):
     if pairs > 1:
         perf['pairs_per_step'] = pairs
         perf['step_ms_per_pair'] = round(step_ms / pairs, 1)
+    # Padding-waste account (obs.goodput): MEASURED from the validity
+    # masks, not assumed — these synthetic legs build exactly-sized
+    # graphs (all-true masks) so the honest ratio is 1.0, and a future
+    # bucketed bench that pads will show its real waste on this axis.
+    from dgmc_tpu.obs import goodput as goodput_mod
+    gr = goodput_mod.goodput_ratio(goodput_mod.pair_fills(
+        goodput_mod.mask_fills(s.node_mask, s.edge_mask),
+        goodput_mod.mask_fills(t.node_mask, t.edge_mask)))
+    if gr is not None:
+        perf['goodput_ratio'] = round(gr, 4)
     # Live allocator peak is PROCESS-LIFETIME: only the first (f32) leg
     # can attribute it; later legs would just echo the earlier maximum,
     # so they keep the per-executable static bound from memory_analysis.
@@ -606,6 +616,7 @@ def bench_sparse():
             'step_ms': round(step_ms, 1),
             'step_ms_per_pair': perf.get('step_ms_per_pair',
                                          round(step_ms / SP_PAIRS, 1)),
+            'goodput_ratio': perf.get('goodput_ratio'),
             'source': 'flagship'}
     for b in (p for p in (1, 2, 4, 8) if str(p) not in pairs_sweep):
         res = None
@@ -615,7 +626,8 @@ def bench_sparse():
                 res = {'step_ms': round(b_ms, 1),
                        'step_ms_per_pair': b_perf.get(
                            'step_ms_per_pair', round(b_ms / b, 1)),
-                       **{k: b_perf[k] for k in ('mfu', 'arith_intensity')
+                       **{k: b_perf[k] for k in
+                          ('mfu', 'arith_intensity', 'goodput_ratio')
                           if k in b_perf}}
         except Exception as e:   # SectionTimeout never escapes _section
             res = {'error': f'{type(e).__name__}: {e}'}
@@ -626,6 +638,15 @@ def bench_sparse():
     out = {'shape': f'{SP_N_S}x{SP_N_T} k={SP_K} steps={NUM_STEPS}',
            'topk_ms': topk_ms,
            'pairs_sweep': pairs_sweep}
+    # Batching-headroom estimate (obs.capacity): projected QPS per batch
+    # size from the sweep's measured per-pair step time — what seeds the
+    # serve rounds' capacity model.
+    from dgmc_tpu.obs.capacity import batching_headroom
+    per_pair = {b: leg['step_ms_per_pair'] for b, leg in pairs_sweep.items()
+                if isinstance(leg, dict)
+                and leg.get('step_ms_per_pair') is not None}
+    if per_pair:
+        out['batching_headroom'] = batching_headroom(per_pair)
     if step_ms is not None:
         # Flagship leg: the bf16 compute policy (quality-gated; see
         # module docstring) at SP_PAIRS pairs per step.

@@ -90,6 +90,25 @@ regression thresholds:
   ``--min-overlap``): training runs carry no qtrace account. When on,
   a serving candidate that LOST the per-stage account the baseline had
   fails — tail-latency attribution is itself a gated artifact.
+- **goodput ratio** — the padding-waste account's useful-over-executed
+  FLOPs ratio (``goodput.json``, see ``obs.goodput``) dropping below
+  the ``--min-goodput`` floor fails. Absolute floor with
+  ``--min-overlap`` semantics: a candidate that lost the goodput
+  account the baseline carried fails unconditionally (the batcher that
+  silently stopped accounting its padding must never read as a pass);
+  the floor itself only gates when configured.
+- **pad fraction** — the worst-bucket pad fraction (``goodput.json``)
+  growing by more than ``--max-pad-regression`` fails. An ABSOLUTE
+  increase bound, not a ratio: a 0.0 baseline (perfectly-filled
+  buckets) is a meaningful value and exactly the one worth defending,
+  and a ratio against it is undefined. Lost-from-candidate fails.
+- **utilization** — the serve path's Little's-law ρ
+  (``capacity.json``, see ``obs.capacity``: arrival rate × mean
+  service time) exceeding the ``--max-utilization`` ceiling fails —
+  a candidate running hotter than the ceiling has no headroom before
+  the queue grows without bound, whatever its latency quantiles say.
+  Absolute ceiling, off unless configured (training runs carry no
+  capacity account); lost-from-candidate fails.
 
 When a gated key is absent from one side, the row's note names WHICH
 run lacks it and lists the gated keys that run *does* carry, so a CI
@@ -139,6 +158,17 @@ DEFAULT_THRESHOLDS = {
     #: (min_overlap semantics — ROADMAP item 2's paper-parity pin).
     'min_hits1': None,
     'idle': 0.25,
+    #: Absolute goodput-ratio floor (goodput.json); None = gate off
+    #: unless asked, min_overlap semantics (lost account still fails).
+    'min_goodput': None,
+    #: Allowed ABSOLUTE increase of the worst-bucket pad fraction
+    #: (goodput.json); None = gate off unless asked. Absolute, not a
+    #: ratio: a zero-pad baseline is the one worth defending.
+    'pad_regression': None,
+    #: Absolute ceiling on the serve path's Little's-law utilization ρ
+    #: (capacity.json); None = gate off unless asked — training runs
+    #: carry no capacity account.
+    'max_utilization': None,
     #: Logged metrics whose FINAL values must be exactly equal between
     #: the runs (tuple of keys; empty = gate off). The
     #: streamed-vs-offloaded equivalence gate: two layouts of the same
@@ -152,7 +182,7 @@ GATED_KEYS = (
     'step_p50_s', 'step_p95_s', 'steps_per_sec', 'compile_events',
     'peak_memory_bytes', 'mfu', 'arith_intensity', 'overlap_fraction',
     'static_peak_bytes', 'measured_overlap_fraction', 'idle_fraction',
-    'hits1',
+    'hits1', 'goodput_ratio', 'pad_fraction', 'utilization',
 )
 
 
@@ -588,6 +618,75 @@ def diff_runs(a, b, thresholds=None, allow_kernel_fallback=False):
                     continue
                 gate(key, pa95, pb95, round(d, 4), sthr, d > sthr)
 
+    # -- goodput ratio (padding-waste account) ----------------------------
+    # min_overlap semantics: absolute floor (0.0 goodput — every FLOP
+    # spent on padding — is a meaningful value, and a ratio against it
+    # is not), lost-account fails unconditionally, the floor only
+    # gates when configured.
+    gp_a, gp_b = a.get('goodput_ratio'), b.get('goodput_ratio')
+    gfloor = thr.get('min_goodput')
+    if gp_a is not None and gp_b is None:
+        rows.append(_row('goodput_ratio', gp_a, gp_b, None, gfloor,
+                         'REGRESSION', _missing_note('candidate', b)))
+    elif gp_b is not None and gfloor is not None:
+        gate('goodput_ratio', gp_a, gp_b,
+             None if gp_a is None else round(gp_b - gp_a, 4), gfloor,
+             gp_b < gfloor,
+             'padding waste pushed useful FLOPs below the floor'
+             if gp_b < gfloor else '')
+    elif gp_a is not None or gp_b is not None:
+        rows.append(_row('goodput_ratio', gp_a, gp_b,
+                         None if None in (gp_a, gp_b)
+                         else round(gp_b - gp_a, 4), gfloor, 'info',
+                         'no --min-goodput floor configured'))
+
+    # -- pad fraction (worst bucket) --------------------------------------
+    # An ABSOLUTE increase bound: the gate fires on pad_b - pad_a >
+    # threshold. Not a ratio — a 0.0 baseline (perfectly-filled
+    # buckets) is exactly the baseline worth defending, and fractional
+    # change against it is undefined.
+    pf_a, pf_b = a.get('pad_fraction'), b.get('pad_fraction')
+    plim = thr.get('pad_regression')
+    if pf_a is not None and pf_b is None:
+        rows.append(_row('pad_fraction', pf_a, pf_b, None, plim,
+                         'REGRESSION', _missing_note('candidate', b)))
+    elif plim is not None and pf_a is None and pf_b is not None:
+        rows.append(_row('pad_fraction', pf_a, pf_b, None, plim,
+                         'skipped', _missing_note('baseline', a)))
+    elif plim is not None and pf_a is not None and pf_b is not None:
+        d = round(pf_b - pf_a, 4)
+        gate('pad_fraction', pf_a, pf_b, d, plim, d > plim,
+             'worst-bucket padding grew past the allowed increase'
+             if d > plim else '')
+    elif pf_a is not None or pf_b is not None:
+        rows.append(_row('pad_fraction', pf_a, pf_b,
+                         None if None in (pf_a, pf_b)
+                         else round(pf_b - pf_a, 4), plim, 'info',
+                         'no --max-pad-regression bound configured'))
+
+    # -- serve utilization (capacity model) -------------------------------
+    # Absolute ceiling on the candidate's Little's-law ρ: a serve run
+    # hotter than the ceiling has no headroom before the queue grows
+    # without bound, whatever its latency quantiles say. Off unless
+    # configured (training runs carry no capacity account);
+    # lost-from-candidate fails.
+    ut_a, ut_b = a.get('utilization'), b.get('utilization')
+    uceil = thr.get('max_utilization')
+    if ut_a is not None and ut_b is None:
+        rows.append(_row('utilization', ut_a, ut_b, None, uceil,
+                         'REGRESSION', _missing_note('candidate', b)))
+    elif ut_b is not None and uceil is not None:
+        gate('utilization', ut_a, ut_b,
+             None if ut_a is None else round(ut_b - ut_a, 4), uceil,
+             ut_b > uceil,
+             'serve path over the utilization ceiling (no headroom)'
+             if ut_b > uceil else '')
+    elif ut_a is not None or ut_b is not None:
+        rows.append(_row('utilization', ut_a, ut_b,
+                         None if None in (ut_a, ut_b)
+                         else round(ut_b - ut_a, 4), uceil, 'info',
+                         'no --max-utilization ceiling configured'))
+
     # -- probes -----------------------------------------------------------
     fn = b.get('first_nonfinite')
     if fn:
@@ -747,6 +846,30 @@ def main(argv=None):
                              'headline; the paper-parity pin — same '
                              'lost-account semantics as --min-overlap; '
                              'default: floor off)')
+    parser.add_argument('--min-goodput', type=float,
+                        default=DEFAULT_THRESHOLDS['min_goodput'],
+                        metavar='FRAC',
+                        help='absolute floor on the goodput ratio '
+                             '(useful/executed FLOPs, goodput.json; '
+                             'same lost-account semantics as '
+                             '--min-overlap; default: floor off)')
+    parser.add_argument('--max-pad-regression', type=float,
+                        default=DEFAULT_THRESHOLDS['pad_regression'],
+                        metavar='FRAC',
+                        help='allowed ABSOLUTE increase of the worst-'
+                             'bucket pad fraction (goodput.json; '
+                             'absolute, not a ratio — a zero-pad '
+                             'baseline gates directly; off unless set; '
+                             'a candidate that lost the account the '
+                             'baseline had fails unconditionally)')
+    parser.add_argument('--max-utilization', type=float,
+                        default=DEFAULT_THRESHOLDS['max_utilization'],
+                        metavar='RHO',
+                        help='absolute ceiling on the serve path\'s '
+                             'Little\'s-law utilization (capacity.json; '
+                             'off unless set — training runs carry no '
+                             'capacity account; lost-from-candidate '
+                             'fails)')
     parser.add_argument('--require-equal', type=str, default=None,
                         metavar='KEY[,KEY...]',
                         help='comma-separated logged-metric keys whose '
@@ -793,6 +916,9 @@ def main(argv=None):
             'hits1': args.max_hits1_regression,
             'min_hits1': args.min_hits1,
             'idle': args.max_idle_regression,
+            'min_goodput': args.min_goodput,
+            'pad_regression': args.max_pad_regression,
+            'max_utilization': args.max_utilization,
             'require_equal': tuple(
                 k.strip() for k in (args.require_equal or '').split(',')
                 if k.strip()),

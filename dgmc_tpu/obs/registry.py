@@ -153,6 +153,45 @@ def padding_bucket_table():
     return sorted(rows, key=lambda r: -r['count'])
 
 
+#: Real-size axes the collation layer accumulates per padding bucket:
+#: pre-padding node/edge totals for each pair side. A separate counter
+#: family, NOT extra ``padding_bucket`` labels — the full label set is a
+#: counter's identity, and the recompile lint's
+#: ``analysis/recompile.bucket_signature`` hashes the bucket rows, so
+#: the real-size account must ride beside the bucket counter, never
+#: fragment it.
+PADDING_REAL_AXES = ('nodes_s', 'nodes_t', 'edges_s', 'edges_t')
+
+
+def record_padding(batch, nodes, edges, real=None):
+    """Count one collation into its padding bucket, optionally with the
+    batch's REAL (pre-padding) per-axis totals — what
+    ``obs.goodput`` recomputes pad waste from in any recorded obs dir.
+
+    ``real`` maps :data:`PADDING_REAL_AXES` names to this collation's
+    summed real sizes (e.g. total source nodes across the batch).
+    """
+    labels = {'batch': batch, 'nodes': nodes, 'edges': edges}
+    REGISTRY.inc('padding_bucket', **labels)
+    for axis, value in (real or {}).items():
+        if axis in PADDING_REAL_AXES and value is not None:
+            REGISTRY.inc('padding_real', value=int(value), axis=axis,
+                         **labels)
+
+
+def padding_real_table():
+    """Accumulated real-size totals per padding bucket and axis: rows of
+    ``{'batch', 'nodes', 'edges', 'axis', 'count'}`` (``count`` is the
+    summed real sizes, a monotonic counter like every registry value —
+    delta-friendly for :meth:`RunObserver` baselines)."""
+    rows = [dict(rec['labels'], count=rec['value'])
+            for rec in REGISTRY.snapshot()['counters']
+            if rec['name'] == 'padding_real']
+    return sorted(rows, key=lambda r: (str(r.get('nodes')),
+                                       str(r.get('edges')),
+                                       r.get('axis', '')))
+
+
 # ---------------------------------------------------------------------------
 # Compile events (jax.monitoring)
 # ---------------------------------------------------------------------------

@@ -93,6 +93,8 @@ def load_run(path):
             'qtrace': _read_json(
                 os.path.join(path, 'qtrace_summary.json')),
             'quality': _read_json(os.path.join(path, 'quality.json')),
+            'goodput': _read_json(os.path.join(path, 'goodput.json')),
+            'capacity': _read_json(os.path.join(path, 'capacity.json')),
         }
         if run['timings'] is None and not run['metrics']:
             from dgmc_tpu.resilience.supervisor import (ATTEMPT_PREFIX,
@@ -133,7 +135,7 @@ def load_run(path):
             'memory': None, 'dispatch': None, 'efficiency': None,
             'aggregate': None, 'hang': None, 'recovery': None,
             'flight': None, 'attribution': None, 'qtrace': None,
-            'quality': None}
+            'quality': None, 'goodput': None, 'capacity': None}
 
 
 def peak_memory(memory):
@@ -317,6 +319,36 @@ def summarize(run):
             out['audit_recall_mean'] = audit.get('recall_mean')
             out['audit_recall_min'] = audit.get('recall_min')
             out['audit_exact'] = audit.get('exact')
+
+    goodput = run.get('goodput')
+    if goodput:
+        # The capacity/goodput plane (goodput.json): flat keys so
+        # obs.diff's --min-goodput / --max-pad-regression gates read the
+        # same artifact the observer recorded — a run that stopped
+        # writing the account loses the keys (lost-account-fails).
+        if goodput.get('goodput_ratio') is not None:
+            out['goodput_ratio'] = goodput['goodput_ratio']
+        if goodput.get('pad_fraction_max') is not None:
+            out['pad_fraction'] = goodput['pad_fraction_max']
+        if goodput.get('buckets'):
+            out['goodput_buckets'] = len(goodput['buckets'])
+        if goodput.get('composed_with_stage_flops') is not None:
+            out['goodput_composed'] = goodput['composed_with_stage_flops']
+
+    capacity = run.get('capacity')
+    if capacity:
+        # The serve-side capacity model (capacity.json): Little's-law
+        # utilization and the measured saturation ceiling, plus the
+        # lock split the qtrace admission span reconciles against.
+        for key in ('utilization', 'saturation_qps', 'arrival_qps',
+                    'inflight', 'mean_service_ms', 'projected_wait_ms'):
+            if capacity.get(key) is not None:
+                out[f'capacity_{key}' if key != 'utilization'
+                    else 'utilization'] = capacity[key]
+        for side in ('lock_wait_ms', 'lock_hold_ms'):
+            hist = capacity.get(side) or {}
+            if hist.get('p95_ms') is not None:
+                out[f'capacity_{side[:-3]}_p95_ms'] = hist['p95_ms']
 
     flight = run.get('flight')
     if flight:
@@ -625,6 +657,51 @@ def render(run):
                 f'  shadow audit     {s["audit_queries"]} audited, '
                 f'{s.get("audit_exact", 0)} exact, recall min '
                 f'{rmin if rmin is not None else "-"}')
+
+    goodput = run.get('goodput')
+    capacity = run.get('capacity')
+    if goodput or capacity:
+        lines.append('-- capacity / goodput plane --')
+        if s.get('goodput_ratio') is not None:
+            composed = ('FLOP-weighted' if s.get('goodput_composed')
+                        else 'mask-only')
+            lines.append(f'  goodput ratio    {s["goodput_ratio"]:.4f} '
+                         f'(useful/executed FLOPs, {composed})')
+        if s.get('pad_fraction') is not None:
+            lines.append(f'  pad fraction     {s["pad_fraction"]:.4f} '
+                         f'(worst bucket)')
+        for b in (goodput or {}).get('buckets', [])[:5]:
+            gr = b.get('goodput_ratio')
+            lines.append(
+                f'    batch={b.get("batch")} nodes={b.get("nodes")} '
+                f'edges={b.get("edges")} x{b.get("count")}  '
+                f'pad={b.get("pad_fraction", 0.0):.3f}'
+                + (f'  goodput={gr:.3f}' if gr is not None else ''))
+        if capacity:
+            if s.get('utilization') is not None:
+                lines.append(f'  utilization ρ    {s["utilization"]:.4f} '
+                             f'(Little\'s law: arrival x service)')
+            if s.get('capacity_saturation_qps') is not None:
+                lines.append(f'  saturation QPS   '
+                             f'{s["capacity_saturation_qps"]:.2f} '
+                             f'(1 / mean service time)')
+            if s.get('capacity_arrival_qps') is not None:
+                lines.append(f'  arrival QPS      '
+                             f'{s["capacity_arrival_qps"]:.2f}')
+            wait = s.get('capacity_lock_wait_p95_ms')
+            hold = s.get('capacity_lock_hold_p95_ms')
+            if wait is not None or hold is not None:
+                lines.append(f'  engine lock p95  '
+                             f'wait {wait if wait is not None else "-"}ms / '
+                             f'hold {hold if hold is not None else "-"}ms')
+            rec_adm = capacity.get('admission_reconciliation')
+            if rec_adm:
+                lines.append(
+                    f'  admission recon  qtrace '
+                    f'{rec_adm.get("qtrace_count")}x '
+                    f'p95={rec_adm.get("qtrace_p95_ms")}ms vs engine '
+                    f'{rec_adm.get("engine_count")}x '
+                    f'p95={rec_adm.get("engine_p95_ms")}ms')
 
     lines.append('-- metrics --')
     lines.append(f'  records          {s["metrics_records"]}')

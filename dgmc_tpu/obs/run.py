@@ -246,6 +246,8 @@ class RunObserver:
             # scoping CompileWatcher gives compile events).
             self._dispatch_base = self._count_index(dispatch_table())
             self._buckets_base = self._count_index(padding_bucket_table())
+            from dgmc_tpu.obs.registry import padding_real_table
+            self._real_base = self._count_index(padding_real_table())
             self._watcher = CompileWatcher(
                 on_event=self._on_compile_event).__enter__()
             self._dispatch_sink = self._on_dispatch
@@ -626,6 +628,14 @@ class RunObserver:
             json.dump(payload, f, indent=1)
         os.replace(tmp, path)
 
+    def write_artifact(self, name, payload):
+        """Write one extra JSON artifact into the obs dir (atomic, like
+        every built-in artifact). The subsystem hook behind e.g. the
+        serving worker's ``capacity.json`` — artifacts the observer does
+        not itself compute but that belong in the recorded run."""
+        if self.enabled:
+            self._write(name, payload)
+
     def probe_summary(self):
         """Per-probe aggregates ``{name: {count, mean, last, min, max}}``
         (+ ``first_nonfinite`` when a stage went non-finite)."""
@@ -944,14 +954,44 @@ class RunObserver:
                 for n, t0, d in self._sections[-8:]]
         return ctx
 
+    def _padding_rows(self):
+        """This run's padding-bucket rows with the real (pre-padding)
+        totals merged in (``real_nodes_s`` etc.) — the delta baselines
+        are applied per family FIRST, then joined, so the run-scoped
+        counts and the run-scoped real totals describe the same
+        collations."""
+        from dgmc_tpu.obs import goodput as goodput_mod
+        from dgmc_tpu.obs.registry import padding_real_table
+        return goodput_mod.merge_real_rows(
+            self._since(padding_bucket_table(), self._buckets_base),
+            self._since(padding_real_table(), self._real_base))
+
+    def goodput_payload(self):
+        """The ``goodput.json`` body for this run: pad waste + goodput
+        ratio from the merged padding rows, composed with the last
+        efficiency snapshot's per-stage FLOPs (``train_step``-first
+        headline convention) when the run recorded a cost account.
+        ``None`` when nothing recorded a real-size account."""
+        from dgmc_tpu.obs import goodput as goodput_mod
+        stages = None
+        programs = (self._last_efficiency or {}).get('programs') or {}
+        ts = programs.get('train_step') or {}
+        stages = ts.get('stages')
+        if not stages:
+            for p in programs.values():
+                if p.get('stages'):
+                    stages = p['stages']
+                    break
+        return goodput_mod.payload_from_rows(self._padding_rows(),
+                                             stages=stages)
+
     def timings(self):
         out = {
             'wall_s': round(time.time() - self._t_start, 3),
             'argv': sys.argv,
             'steps': self.timer.summary(),
             'compile': self._watcher.summary() if self._watcher else {},
-            'padding_buckets': self._since(padding_bucket_table(),
-                                           self._buckets_base),
+            'padding_buckets': self._padding_rows(),
         }
         if self._device_times:
             out['device_steps'] = self.device_step_summary()
@@ -995,6 +1035,13 @@ class RunObserver:
             # last said.
             self._last_efficiency = payload
             self._write('efficiency.json', payload)
+        # After the efficiency write so the goodput ratio composes with
+        # the freshest per-stage FLOP attribution. Absence stays absent:
+        # a run with no real-size padding account writes no goodput.json
+        # (the diff's lost-account rule needs that honesty).
+        goodput = self.goodput_payload()
+        if goodput is not None:
+            self._write('goodput.json', goodput)
         from dgmc_tpu.obs.trace import export_chrome_trace
         with self._probe_lock:
             # Snapshot: the deque may receive callback-thread appends

@@ -154,9 +154,17 @@ def parse_round(family, number, path):
         # and the shadow audit's worst-case shortlist recall.
         quality = d.get('quality') or {}
         audit = quality.get('audit') or {}
+        # r04+ rounds add the capacity/goodput account (obs.capacity /
+        # obs.goodput): serve-path goodput ratio and the Little's-law
+        # utilization ρ. Older rounds lack both blocks — the columns
+        # render '-'.
         row.update({
             'audit_recall': audit.get('recall_min'),
             'saturated_frac': quality.get('saturated_frac'),
+            'goodput': _first(
+                _get(d, 'goodput', 'serve', 'goodput_ratio'),
+                _get(d, 'goodput', 'goodput_ratio')),
+            'utilization': _get(d, 'capacity', 'utilization'),
             'latency_p50_ms': _first(lat.get('server_p50_ms'),
                                      lat.get('client_p50_ms')),
             'latency_p95_ms': _first(lat.get('server_p95_ms'),
@@ -226,7 +234,8 @@ def _render_serve(fam_rows, lines):
     lines.append(f'  {"round":>5} {"p50":>9} {"p95":>9} {"p99":>9} '
                  f'{"QPS":>7} {"clients":>7} {"warm rta":>9} '
                  f'{"restarts":>8} {"tail stage":>16} '
-                 f'{"hits@1":>7} {"audit":>7}  outcome')
+                 f'{"hits@1":>7} {"audit":>7} '
+                 f'{"goodput":>7} {"util":>6}  outcome')
     for r in fam_rows:
         p50 = r.get('latency_p50_ms')
         p95 = r.get('latency_p95_ms')
@@ -242,7 +251,9 @@ def _render_serve(fam_rows, lines):
             f'{_fmt(r.get("restarts"), "{:d}"):>8} '
             f'{r.get("dominant_stage") or "-":>16} '
             f'{_fmt(r.get("hits1"), "{:.4f}"):>7} '
-            f'{_fmt(r.get("audit_recall"), "{:.2f}"):>7}'
+            f'{_fmt(r.get("audit_recall"), "{:.2f}"):>7} '
+            f'{_fmt(r.get("goodput"), "{:.3f}"):>7} '
+            f'{_fmt(r.get("utilization"), "{:.3f}"):>6}'
             f'  {r.get("outcome", "?")}')
 
 

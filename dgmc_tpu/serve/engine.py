@@ -35,6 +35,10 @@ import time
 
 import numpy as np
 
+from dgmc_tpu.obs import goodput as goodput_mod
+from dgmc_tpu.obs.live import StreamingHistogram
+from dgmc_tpu.obs.qtrace import QTRACE_LATENCY_BOUNDS
+
 __all__ = ['MatchEngine']
 
 
@@ -105,6 +109,19 @@ class MatchEngine:
         self._exec = {}          # signature -> per-bucket record
         self.query_count = 0
         self.last_latency_s = None
+        # -- saturation telemetry (obs.capacity's inputs) ------------------
+        # In-flight gauge + the engine lock split into measured wait vs
+        # hold. The wait histogram measures the SAME region qtrace's
+        # `admission_queue_wait` span wraps (the lock acquire below) —
+        # one vocabulary, reconcilable distributions, no third dialect;
+        # unlike the span it covers EVERY query, traced or not. Bounds
+        # are qtrace's ×1.25 rungs so the two accounts quantize alike.
+        self._stats_lock = threading.Lock()
+        self.inflight = 0
+        self.lock_wait_hist = StreamingHistogram(QTRACE_LATENCY_BOUNDS)
+        self.lock_hold_hist = StreamingHistogram(QTRACE_LATENCY_BOUNDS)
+        self._t_first_query = None
+        self._t_last_query = None
 
     # -- executables -------------------------------------------------------
 
@@ -230,15 +247,17 @@ class MatchEngine:
                     c_tpl = np.zeros(
                         (1, bucket.nodes, k, self.index.embed_dim),
                         np.float32)
-                    compiled = jit_match.lower(
+                    lowered = jit_match.lower(
                         self._variables, tpl, self._t_graph, s_tpl,
-                        c_tpl, self._noise_key).compile()
+                        c_tpl, self._noise_key)
+                    compiled = lowered.compile()
                     embed_c = jit_embed.lower(
                         self._psi1_vars(), tpl).compile()
                 else:
-                    compiled = jit_match.lower(
+                    lowered = jit_match.lower(
                         self._variables, tpl, self._t_graph,
-                        self._h_t_dev, self._noise_key).compile()
+                        self._h_t_dev, self._noise_key)
+                    compiled = lowered.compile()
                     # The query path does not need ψ₁ standalone on the
                     # device tier, but the shadow audit's exhaustive
                     # re-scan does — compile it here in BOTH tiers so
@@ -249,7 +268,10 @@ class MatchEngine:
                     'exec': compiled,
                     'embed': embed_c,
                     'compile_s': round(time.perf_counter() - t0, 3),
-                    'queries': 0}
+                    'queries': 0,
+                    'pad_sum': 0.0,
+                    'goodput_sum': 0.0,
+                    'stages': self._stage_flops(lowered)}
             if self.offload:
                 # Drive the full offloaded pipeline once at the padded
                 # template shape: the host-driven merge step
@@ -295,6 +317,21 @@ class MatchEngine:
                                  if mem else {}))
         return report
 
+    @staticmethod
+    def _stage_flops(lowered):
+        """Per-stage FLOP attribution of one bucket's lowering
+        (``obs/cost.stage_table`` over the debug-info MLIR) — what the
+        per-query goodput ratio composes with. ``None`` when the
+        compiler IR is unavailable; the ratio then falls back to the
+        mask-only account, never guesses."""
+        try:
+            from dgmc_tpu.obs.cost import stage_table
+            asm = lowered.compiler_ir().operation.get_asm(
+                enable_debug_info=True)
+            return stage_table(asm) or None
+        except Exception:
+            return None
+
     @property
     def buckets_warm(self):
         return len(self._exec)
@@ -302,6 +339,46 @@ class MatchEngine:
     def bucket_stats(self):
         return {info['bucket']: info['queries']
                 for info in self._exec.values()}
+
+    def capacity_stats(self):
+        """The saturation/goodput account (``obs.capacity``'s live
+        input): in-flight count, lock wait/hold histogram snapshots,
+        the measured arrival window, and per-bucket pad-fraction /
+        goodput-ratio running means."""
+        with self._stats_lock:
+            wait = self.lock_wait_hist.snapshot()
+            hold = self.lock_hold_hist.snapshot()
+            inflight = self.inflight
+            t0, t1 = self._t_first_query, self._t_last_query
+            buckets = {}
+            pad_sum = good_sum = queries = 0
+            for info in self._exec.values():
+                b = info['bucket']
+                q = info['queries']
+                buckets[f'{b.nodes}x{b.edges}'] = {
+                    'queries': q,
+                    'pad_fraction': (round(info['pad_sum'] / q, 6)
+                                     if q else None),
+                    'goodput_ratio': (round(info['goodput_sum'] / q, 6)
+                                      if q else None),
+                }
+                pad_sum += info['pad_sum']
+                good_sum += info['goodput_sum']
+                queries += q
+        window_s = (t1 - t0) if (t0 is not None and t1 is not None
+                                 and t1 > t0) else None
+        return {
+            'inflight': inflight,
+            'queries': queries,
+            'window_s': round(window_s, 6) if window_s else None,
+            'lock_wait': wait,
+            'lock_hold': hold,
+            'pad_fraction': (round(pad_sum / queries, 6)
+                             if queries else None),
+            'goodput_ratio': (round(good_sum / queries, 6)
+                              if queries else None),
+            'buckets': buckets,
+        }
 
     # -- the query path ----------------------------------------------------
 
@@ -337,8 +414,24 @@ class MatchEngine:
                 raise UnknownExecutableError(bucket, sig)
         with span('pad_and_stage'):
             q = self.router.pad_query(graph, bucket)
+        # Per-query goodput: the routed bucket vs the query's real
+        # shape (the corpus side is fully real by construction),
+        # composed with the bucket lowering's per-stage FLOPs.
+        fills = goodput_mod.pair_fills(
+            {'nodes_real': n_real, 'nodes_padded': bucket.nodes,
+             'edges_real': graph.num_edges, 'edges_padded': bucket.edges},
+            {'nodes_real': self.router.corpus_nodes,
+             'nodes_padded': self.router.corpus_nodes,
+             'edges_real': self.router.corpus_edges,
+             'edges_padded': self.router.corpus_edges})
+        good = goodput_mod.goodput_ratio(fills, info.get('stages'))
+        with self._stats_lock:
+            self.inflight += 1
+        t_wait = time.perf_counter()
         with span('admission_queue_wait'):
             self._lock.acquire()
+        t_hold = time.perf_counter()
+        done = False
         try:
             obs = self._obs
             step = obs.step() if obs is not None else _null()
@@ -346,10 +439,26 @@ class MatchEngine:
             with step:
                 out = self._execute(info, q, span)
             self.last_latency_s = time.perf_counter() - t0
-            info['queries'] += 1
-            self.query_count += 1
+            done = True
         finally:
             self._lock.release()
+            t_done = time.perf_counter()
+            with self._stats_lock:
+                self.inflight -= 1
+                self.lock_wait_hist.observe(t_hold - t_wait)
+                self.lock_hold_hist.observe(t_done - t_hold)
+                if self._t_first_query is None:
+                    self._t_first_query = t_wait
+                self._t_last_query = t_done
+                if done:
+                    # Per-bucket running means count ANSWERED queries
+                    # only, so the pad/goodput account divides by the
+                    # same population `queries` does.
+                    info['queries'] += 1
+                    self.query_count += 1
+                    info['pad_sum'] += 1.0 - (n_real / bucket.nodes)
+                    if good is not None:
+                        info['goodput_sum'] += good
         with span('serialize'):
             return self._answer(bucket, n_real, out)
 

@@ -119,19 +119,33 @@ class QueryRouter:
         signature hash over this bucket's telemetry row."""
         return bucket_signature(self.bucket_row(bucket))
 
-    def record(self, bucket):
+    def record(self, bucket, real_nodes=None, real_edges=None):
         """Count one collation into ``bucket`` in the process-wide obs
         registry — the serve-side twin of ``pad_pair_batch``'s
         telemetry, so a recorded serve run's padding buckets feed the
-        same RCP202 compile-churn cross-check as a training run's."""
-        from dgmc_tpu.obs.registry import REGISTRY
+        same RCP202 compile-churn cross-check as a training run's.
+
+        ``real_nodes``/``real_edges`` are the query's PRE-padding sizes;
+        when given, the real-size totals land beside the bucket counter
+        (``registry.record_padding``) so per-bucket pad waste is
+        recomputable from the recorded obs dir (``obs.goodput``). The
+        target side is the corpus — fully real by construction.
+        """
+        from dgmc_tpu.obs.registry import record_padding
         row = self.bucket_row(bucket)
-        REGISTRY.inc('padding_bucket', **row)
+        real = None
+        if real_nodes is not None and real_edges is not None:
+            real = {'nodes_s': int(real_nodes),
+                    'edges_s': int(real_edges),
+                    'nodes_t': self.corpus_nodes,
+                    'edges_t': self.corpus_edges}
+        record_padding(real=real, **row)
 
     def pad_query(self, graph, bucket):
         """Collate one host :class:`~dgmc_tpu.utils.data.Graph` into
         ``bucket``'s padded ``GraphBatch`` (B=1), recording the
-        collation in the registry."""
+        collation (real pre-padding sizes included) in the registry."""
         from dgmc_tpu.utils.data import pad_graphs
-        self.record(bucket)
+        self.record(bucket, real_nodes=graph.num_nodes,
+                    real_edges=graph.num_edges)
         return pad_graphs([graph], bucket.nodes, bucket.edges)

@@ -278,6 +278,9 @@ class ServeService:
         # summary joins /status beside the observer's own quality block.
         if self.qtracer is not None:
             obs.add_status_section('qtrace', self.qtracer.summary)
+        # And "how much headroom": the live queueing model over the
+        # engine's saturation account (obs.capacity.live_summary).
+        obs.add_status_section('capacity', self._capacity_status)
         if obs.quality is not None:
             obs.add_metrics_provider(obs.quality.metric_families)
 
@@ -381,8 +384,10 @@ class ServeService:
 
     def _serve_metric_families(self):
         """Serve-plane metric families for the observer's ``/metrics``
-        exposition: per-class error counters plus the qtrace per-stage
-        histograms and retention counters."""
+        exposition: per-class error counters, the qtrace per-stage
+        histograms and retention counters, and the capacity/goodput
+        plane (in-flight gauge, lock wait/hold histograms, per-bucket
+        pad fraction, goodput ratio)."""
         with self._counts:
             errors = dict(self.query_errors)
         families = [(
@@ -392,7 +397,57 @@ class ServeService:
              for cls in ERROR_CLASSES])]
         if self.qtracer is not None:
             families.extend(self.qtracer.metric_families())
+        if self.engine is not None:
+            families.extend(self._capacity_metric_families())
         return families
+
+    def _capacity_metric_families(self):
+        """The saturation/goodput families. Families are always
+        present once the engine is up (a scraper sees the full set
+        from the first scrape); per-bucket pad-fraction samples appear
+        as buckets answer queries, and the goodput gauge appears with
+        the first measured ratio — absent measurements are absent, not
+        zero."""
+        from dgmc_tpu.obs.live import histogram_family
+        cap = self.engine.capacity_stats()
+        pad_samples = [
+            ('', {'bucket': name}, row['pad_fraction'])
+            for name, row in sorted((cap.get('buckets') or {}).items())
+            if row.get('pad_fraction') is not None]
+        good_samples = ([('', {}, cap['goodput_ratio'])]
+                        if cap.get('goodput_ratio') is not None else [])
+        return [
+            ('dgmc_inflight', 'gauge',
+             'Queries currently inside the engine (admitted, waiting '
+             'for or holding the execution lock).',
+             [('', {}, cap.get('inflight', 0))]),
+            ('dgmc_pad_fraction', 'gauge',
+             'Mean padded-away node fraction per routed bucket '
+             '(router bucket vs real query shape).', pad_samples),
+            ('dgmc_goodput_ratio', 'gauge',
+             'Useful FLOPs / executed FLOPs across answered queries '
+             '(obs.goodput, composed with per-bucket stage FLOPs).',
+             good_samples),
+            histogram_family(
+                'dgmc_lock_wait_seconds',
+                'Engine lock wait (the admission_queue_wait region, '
+                'every query — traced or not).', cap['lock_wait']),
+            histogram_family(
+                'dgmc_lock_hold_seconds',
+                'Engine lock hold (service time of the serialized '
+                'executor).', cap['lock_hold']),
+        ]
+
+    def _capacity_status(self):
+        """The `/status` ``capacity`` section: the live queueing model
+        (obs.capacity) over the engine's saturation account, with the
+        lock-wait distribution reconciled against qtrace's
+        ``admission_queue_wait`` stage."""
+        from dgmc_tpu.obs.capacity import live_summary
+        return live_summary(
+            self.engine.capacity_stats(),
+            qtrace_summary=(self.qtracer.summary()
+                            if self.qtracer is not None else None))
 
     # -- the /match route --------------------------------------------------
 
@@ -542,6 +597,7 @@ class ServeService:
                     self.obs.set_gauge('audited_queries',
                                        self.auditor.audited)
                 self.obs.flush()
+                self._flush_capacity()
                 if self.qtracer is not None:
                     self.qtracer.flush()
                 last_flush = time.time()
@@ -564,7 +620,18 @@ class ServeService:
             self.qtracer.flush()
         if self.obs is not None:
             self.obs.flush()
+            self._flush_capacity()
             self.obs.close()
+
+    def _flush_capacity(self):
+        """Persist the live capacity model as ``capacity.json`` so the
+        recorded obs dir carries the utilization/saturation account
+        (what ``obs.report`` summarizes and ``obs.diff``'s
+        ``--max-utilization`` gate reads) — not just the live
+        ``/status`` scrape."""
+        if self.engine is not None and self.obs is not None:
+            self.obs.write_artifact('capacity.json',
+                                    self._capacity_status())
 
 
 def main(argv=None):

@@ -254,11 +254,18 @@ def pad_pair_batch(pairs: List[GraphPair], num_nodes_s, num_edges_s,
     # Telemetry: every distinct padding bucket is a distinct XLA program
     # for whatever jitted step consumes the batch — recording the bucket
     # per collation makes recompile churn from unstable padding visible
-    # next to the compile-event counter (obs.report renders both).
-    from dgmc_tpu.obs.registry import REGISTRY
-    REGISTRY.inc('padding_bucket', batch=len(pairs),
-                 nodes=f'{num_nodes_s}x{num_nodes_t}',
-                 edges=f'{num_edges_s}x{num_edges_t}')
+    # next to the compile-event counter (obs.report renders both). The
+    # real (pre-padding) totals ride beside the bucket counter so pad
+    # waste / goodput (obs.goodput) is recomputable from any recorded
+    # obs dir, not just a live process.
+    from dgmc_tpu.obs.registry import record_padding
+    record_padding(batch=len(pairs),
+                   nodes=f'{num_nodes_s}x{num_nodes_t}',
+                   edges=f'{num_edges_s}x{num_edges_t}',
+                   real={'nodes_s': sum(p.s.num_nodes for p in pairs),
+                         'nodes_t': sum(p.t.num_nodes for p in pairs),
+                         'edges_s': sum(p.s.num_edges for p in pairs),
+                         'edges_t': sum(p.t.num_edges for p in pairs)})
     g_s = pad_graphs([p.s for p in pairs], num_nodes_s, num_edges_s,
                      native=native)
     g_t = pad_graphs([p.t for p in pairs], num_nodes_t, num_edges_t,

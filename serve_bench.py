@@ -57,6 +57,21 @@ audit: recall against the exhaustive reference must be exactly 1.0 —
 the engine's shortlist tiers are bit-exact, so anything less is a
 correctness bug, not noise — and the saturation fraction must be
 MEASURED (``None`` means the confidence plane never reported).
+
+Since r04 the record carries the capacity/goodput plane: a
+QPS-vs-concurrency ramp (1→2→4→8 fresh clients against the quiet
+restarted worker) with its measured knee (``obs.capacity.knee_of``),
+the queueing model scraped from the worker's ``/status`` capacity
+section (arrival rate, mean service time, Little's-law utilization ρ,
+saturation QPS, the engine-lock wait/hold split reconciled against
+qtrace's ``admission_queue_wait`` span), the per-bucket padding-waste
+and goodput account (``obs.goodput``) for both the served queries and
+a host-side collation ``pairs_sweep`` (B ∈ {1,2,4,8}), and the
+batching-headroom projection seeded from the committed bench
+``pairs_sweep``'s ``step_ms_per_pair`` (falling back to the serve
+path's own measured service time as a labeled single-point estimate).
+The driver gates on all of it being MEASURED: a missing ramp, ratio,
+reconciliation block, or ``/metrics`` capacity family fails the round.
 """
 
 import argparse
@@ -262,6 +277,115 @@ def measure_overhead(endpoint, payload, samples_per_arm=24):
             'samples_per_arm': samples_per_arm}
 
 
+def concurrency_ramp(endpoint, corpus_x, shapes, args,
+                     levels=(1, 2, 4, 8), queries_per_client=6):
+    """The QPS-vs-concurrency ramp (r04+): fresh client cohorts of
+    1→2→4→8 against the quiet restarted worker, each level its own
+    measured leg, and the curve's measured knee
+    (:func:`dgmc_tpu.obs.capacity.knee_of`) — the last concurrency
+    whose marginal QPS gain still cleared the floor. The serialized
+    executor makes the shape predictable (QPS should flatten once the
+    lock saturates); the ramp MEASURES where instead of assuming it."""
+    from dgmc_tpu.obs.capacity import knee_of
+    rows = []
+    for li, level in enumerate(levels):
+        jobs = [[] for _ in range(level)]
+        for c in range(level):
+            for q in range(queries_per_client):
+                n, e = shapes[(c + q) % len(shapes)]
+                shrink = (c + q) % 3
+                g, gt = sample_query(
+                    corpus_x, n - shrink, e - 2 * shrink,
+                    seed=args.seed + 90000 + 1000 * (li * 16 + c) + q)
+                jobs[c].append((query_payload(g), gt))
+        res, wall = run_clients(jobs, endpoint,
+                                trace_tag=f'ramp{level}')
+        flat_ok = [r for cr in res for r in cr if not r.get('failed')]
+        lats = sorted(r['latency_s'] for r in flat_ok)
+        rows.append({
+            'clients': level,
+            'queries': len(flat_ok),
+            'failed': sum(1 for cr in res for r in cr
+                          if r.get('failed')),
+            'qps': round(len(flat_ok) / max(wall, 1e-9), 2),
+            'p50_ms': (round(percentile(lats, 0.5) * 1e3, 3)
+                       if lats else None),
+            'p95_ms': (round(percentile(lats, 0.95) * 1e3, 3)
+                       if lats else None),
+        })
+        print(f'# ramp {level} client(s): {rows[-1]}', file=sys.stderr,
+              flush=True)
+    return {'levels': rows, 'knee': knee_of(rows)}
+
+
+def collation_goodput(shapes, dim, seed=0, batches=(1, 2, 4, 8)):
+    """Per-B goodput of the collation path alone: query-shaped graphs
+    (the same size distribution :func:`sample_query` draws) padded into
+    the largest serve bucket via ``pad_pair_batch`` on the host — no
+    device work, just the padding-waste account the batcher would
+    execute at each batch size (``obs.goodput``)."""
+    import numpy as np
+
+    from dgmc_tpu.obs import goodput as goodput_mod
+    from dgmc_tpu.utils.data import Graph, GraphPair, pad_pair_batch
+
+    rng = np.random.RandomState(seed)
+    n_max = max(s[0] for s in shapes)
+    e_max = max(s[1] for s in shapes)
+
+    def graph(n, e):
+        return Graph(
+            edge_index=rng.randint(0, n, (2, e)).astype(np.int32),
+            x=rng.randn(n, dim).astype(np.float32))
+
+    out = {}
+    for b in batches:
+        pairs = []
+        for i in range(b):
+            n, e = shapes[i % len(shapes)]
+            pairs.append(GraphPair(s=graph(n, e),
+                                   t=graph(n_max, e_max)))
+        batch = pad_pair_batch(pairs, num_nodes_s=n_max,
+                               num_edges_s=e_max)
+        gr = goodput_mod.goodput_ratio(goodput_mod.pair_fills(
+            goodput_mod.mask_fills(batch.s.node_mask, batch.s.edge_mask),
+            goodput_mod.mask_fills(batch.t.node_mask,
+                                   batch.t.edge_mask)))
+        out[str(b)] = round(gr, 4) if gr is not None else None
+    return out
+
+
+def batching_headroom_block(target_qps=None, mean_service_ms=None,
+                            bench_path='benchmarks/BENCH_r06.json'):
+    """The batching-headroom estimate, seeded from the committed bench
+    ``pairs_sweep``'s measured ``step_ms_per_pair`` when the round
+    carries one; falls back to the serve path's own measured mean
+    service time as the B=1 point (an honest single-point projection,
+    labeled as such) — never fabricates per-B numbers."""
+    from dgmc_tpu.obs.capacity import batching_headroom
+    sweep = {}
+    source = None
+    try:
+        with open(bench_path) as f:
+            d = json.load(f)
+        raw = (((d.get('result') or {}).get('sparse_dbp15k') or {})
+               .get('pairs_sweep')) or {}
+        sweep = {b: leg.get('step_ms_per_pair') for b, leg in raw.items()
+                 if isinstance(leg, dict)
+                 and leg.get('step_ms_per_pair')}
+        if sweep:
+            source = os.path.basename(bench_path)
+    except (OSError, ValueError):
+        pass
+    if not sweep and mean_service_ms:
+        sweep = {'1': mean_service_ms}
+        source = 'serve mean_service_ms (no committed pairs_sweep)'
+    hr = batching_headroom(sweep, target_qps=target_qps)
+    if hr is not None:
+        hr['seeded_from'] = source
+    return hr
+
+
 def qtrace_attribution(ok_rows):
     """The ``qtrace`` block from the clients' collected span accounts:
     end-to-end trace percentiles, per-stage p50/p95, the p95−p50 tail
@@ -409,7 +533,14 @@ def main(argv=None):
     for c in range(args.clients):
         for q in range(args.queries_per_client):
             n, e = shapes[(c + q) % len(shapes)]
-            g, gt = sample_query(corpus_x, n, e,
+            # Under-fill two of every three queries by a few nodes and
+            # edges: the router still lands them in the same bucket
+            # (smallest bucket ≥ shape), so the latency protocol is
+            # unchanged, but the padding-waste plane gets real nonzero
+            # pad fractions to account instead of the degenerate
+            # exact-fill case.
+            shrink = (c + q) % 3
+            g, gt = sample_query(corpus_x, n - shrink, e - 2 * shrink,
                                  seed=args.seed + 1000 * c + q)
             jobs[c].append((query_payload(g), gt))
     probe_payload = jobs[0][0][0]
@@ -486,6 +617,11 @@ def main(argv=None):
         print(f'# tracing overhead: {overhead}', file=sys.stderr,
               flush=True)
 
+        # Concurrency ramp (r04+): runs BEFORE the final /status scrape
+        # so the capacity section's arrival/service account includes
+        # the ramp's queries.
+        ramp = concurrency_ramp(endpoint, corpus_x, shapes, args)
+
         status = get_json(endpoint.port, '/status')[1]
         health_code, health_final = get_json(endpoint.port, '/healthz')
         metrics_text = get_json(endpoint.port, '/metrics')[1]
@@ -528,6 +664,12 @@ def main(argv=None):
     quality_block['hits1'] = (round(hits / total_gt, 4)
                               if total_gt else None)
     steps = (status.get('steps') or {})
+    cap_live = (status.get('capacity') or {}) if isinstance(status, dict) \
+        else {}
+    knee = ramp.get('knee') or {}
+    headroom = batching_headroom_block(
+        target_qps=knee.get('qps'),
+        mean_service_ms=cap_live.get('mean_service_ms'))
     compiles_load = ((c_after_1 - c_warm)
                      if None not in (c_after_1, c_warm) else None)
     compiles_load_2 = ((c_after_2 - c_warm2)
@@ -579,6 +721,34 @@ def main(argv=None):
         'hits_at_1': round(hits / total_gt, 4) if total_gt else None,
         'quality': quality_block,
         'qtrace': qtrace_block,
+        # The capacity/goodput plane (r04+): the measured
+        # QPS-vs-concurrency ramp with its knee, the queueing model
+        # scraped from the drained worker's /status capacity section,
+        # and the padding-waste account for both the served queries and
+        # the host-side collation sweep.
+        'ramp': ramp,
+        'capacity': {
+            'arrival_qps': cap_live.get('arrival_qps'),
+            'mean_service_ms': cap_live.get('mean_service_ms'),
+            'saturation_qps': cap_live.get('saturation_qps'),
+            'utilization': cap_live.get('utilization'),
+            'projected_wait_ms': cap_live.get('projected_wait_ms'),
+            'lock_wait_ms': cap_live.get('lock_wait_ms'),
+            'lock_hold_ms': cap_live.get('lock_hold_ms'),
+            'admission_reconciliation': cap_live.get(
+                'admission_reconciliation'),
+            'knee': ramp.get('knee'),
+            'batching_headroom': headroom,
+        },
+        'goodput': {
+            'serve': {
+                'goodput_ratio': cap_live.get('goodput_ratio'),
+                'pad_fraction': cap_live.get('pad_fraction'),
+                'buckets': cap_live.get('buckets'),
+            },
+            'pairs_sweep': collation_goodput(shapes, args.corpus_dim,
+                                             seed=args.seed),
+        },
         'restart': {
             'cold_first_answer_s': cold_s,
             'warm_first_answer_s': warm_s,
@@ -656,6 +826,27 @@ def main(argv=None):
         elif frac >= 0.05:
             problems.append(f'tracing overhead {frac:.1%} >= 5% '
                             f'on p50')
+    if not ramp.get('levels') or ramp.get('knee') is None:
+        problems.append('concurrency ramp unmeasured (no QPS-vs-'
+                        'concurrency curve)')
+    elif any(r.get('failed') for r in ramp['levels']):
+        problems.append('ramp queries failed')
+    if record['goodput']['serve'].get('goodput_ratio') is None:
+        problems.append('serve goodput unmeasured (the capacity plane '
+                        'never reported a ratio)')
+    if any(v is None
+           for v in record['goodput']['pairs_sweep'].values()):
+        problems.append('collation pairs_sweep goodput unmeasured')
+    if record['capacity'].get('admission_reconciliation') is None:
+        problems.append('lock-wait vs qtrace admission_queue_wait '
+                        'reconciliation unmeasured')
+    for fam in ('dgmc_inflight', 'dgmc_pad_fraction',
+                'dgmc_goodput_ratio', 'dgmc_lock_wait_seconds',
+                'dgmc_lock_hold_seconds'):
+        if not isinstance(metrics_text, str) \
+                or f'# TYPE {fam} ' not in metrics_text:
+            problems.append(f'metric family {fam} missing from '
+                            f'/metrics')
     if quality_block['saturated_frac'] is None:
         problems.append('confidence plane unmeasured (no answer '
                         'carried a quality block)')

@@ -1,0 +1,193 @@
+"""Capacity model (obs.capacity): golden queueing math (saturation QPS,
+Little's-law utilization, M/M/1 wait), the ramp knee, bench-seeded
+batching headroom, histogram reduction, the live `/status` summary with
+its qtrace reconciliation, and the artifact-side CLI."""
+
+import json
+import math
+import os
+
+import pytest
+
+from dgmc_tpu.obs import capacity
+
+
+def test_saturation_qps_is_inverse_mean_service():
+    assert capacity.saturation_qps(0.05) == pytest.approx(20.0)
+    assert capacity.saturation_qps(0) is None
+    assert capacity.saturation_qps(None) is None
+
+
+def test_utilization_littles_law_and_overload():
+    assert capacity.utilization(10.0, 0.05) == pytest.approx(0.5)
+    # ρ > 1 is the saturation signal, not an error.
+    assert capacity.utilization(30.0, 0.05) == pytest.approx(1.5)
+    assert capacity.utilization(None, 0.05) is None
+    assert capacity.utilization(10.0, 0) is None
+
+
+def test_mm1_wait_golden_and_unstable():
+    # ρ = 0.5 → wait = 0.5/0.5 × 50 ms = 50 ms.
+    assert capacity.mm1_wait_s(10.0, 0.05) == pytest.approx(0.05)
+    # ρ = 0.8 → 0.8/0.2 × 50 ms = 200 ms.
+    assert capacity.mm1_wait_s(16.0, 0.05) == pytest.approx(0.2)
+    # At or past saturation an unstable queue has no stationary wait.
+    assert capacity.mm1_wait_s(20.0, 0.05) is None
+    assert capacity.mm1_wait_s(25.0, 0.05) is None
+
+
+def test_hist_mean_and_quantile_upper_bound_convention():
+    snap = {'count': 10, 'sum': 0.5,
+            'buckets': [(0.01, 2), (0.05, 8), (0.1, 10),
+                        (math.inf, 10)]}
+    assert capacity.hist_mean_s(snap) == pytest.approx(0.05)
+    # rank 5 lands in the ≤0.05 bucket (cum 8 ≥ 5).
+    assert capacity.hist_quantile_s(snap, 0.50) == 0.05
+    assert capacity.hist_quantile_s(snap, 0.95) == 0.1
+    # A quantile landing in the +inf overflow bucket reports the last
+    # finite bound, never infinity.
+    overflow = {'count': 4, 'sum': 1.0,
+                'buckets': [(0.1, 1), (math.inf, 4)]}
+    assert capacity.hist_quantile_s(overflow, 0.99) == 0.1
+    assert capacity.hist_mean_s({'count': 0, 'sum': 0.0}) is None
+    assert capacity.hist_quantile_s(None, 0.5) is None
+
+
+def test_knee_of_finds_last_scaling_level():
+    ramp = [{'clients': 1, 'qps': 10.0}, {'clients': 2, 'qps': 19.0},
+            {'clients': 4, 'qps': 20.0}, {'clients': 8, 'qps': 21.0}]
+    knee = capacity.knee_of(ramp)
+    # 1→2 nearly doubled (keeps scaling); 2→4 gained only ~5% < 10%.
+    assert knee == {'clients': 2, 'qps': 19.0, 'saturated': True,
+                    'min_gain': 0.10}
+
+
+def test_knee_of_unsaturated_ramp_and_order_independence():
+    # Still doubling at the top level: the knee lies beyond the range.
+    ramp = [{'clients': 4, 'qps': 40.0}, {'clients': 1, 'qps': 10.0},
+            {'clients': 2, 'qps': 20.0}]
+    knee = capacity.knee_of(ramp)
+    assert knee['clients'] == 4
+    assert knee['saturated'] is False
+    assert capacity.knee_of([]) is None
+
+
+def test_batching_headroom_projection_and_recommendation():
+    # str keys (JSON round-trip) must be accepted.
+    hr = capacity.batching_headroom({'1': 100.0, '2': 60.0, '4': 40.0},
+                                    target_qps=15.0)
+    assert hr['projected_qps_per_batch'] == {'1': 10.0, '2': 16.667,
+                                             '4': 25.0}
+    assert hr['best_batch'] == 4
+    assert hr['best_qps'] == 25.0
+    # Smallest batch that clears the target.
+    assert hr['recommended_batch'] == 2
+    # Out-of-reach target: None, honesty over hope.
+    assert capacity.batching_headroom(
+        {'1': 100.0}, target_qps=99.0)['recommended_batch'] is None
+    assert capacity.batching_headroom({}) is None
+    assert capacity.batching_headroom({'1': 0.0}) is None
+
+
+def _cap_stats():
+    hold = {'count': 10, 'sum': 0.5,
+            'buckets': [(0.05, 8), (0.1, 10), (math.inf, 10)]}
+    wait = {'count': 10, 'sum': 1.0,
+            'buckets': [(0.1, 5), (0.2, 10), (math.inf, 10)]}
+    return {'inflight': 1, 'queries': 11, 'window_s': 2.0,
+            'lock_hold': hold, 'lock_wait': wait,
+            'pad_fraction': 0.125, 'goodput_ratio': 0.875,
+            'buckets': {'8x16': {'queries': 11}}}
+
+
+def test_live_summary_golden_queueing_model():
+    out = capacity.live_summary(_cap_stats())
+    # arrival = (11 − 1) queries / 2 s window.
+    assert out['arrival_qps'] == 5.0
+    # E[service] from the lock-HOLD histogram: 0.5 s / 10 = 50 ms.
+    assert out['mean_service_ms'] == 50.0
+    assert out['saturation_qps'] == 20.0
+    # ρ = 5 × 0.05; projected wait = 0.25/0.75 × 50 ms.
+    assert out['utilization'] == 0.25
+    assert out['projected_wait_ms'] == pytest.approx(16.6667)
+    assert out['lock_hold_ms']['p50_ms'] == 50.0
+    assert out['lock_wait_ms']['p95_ms'] == 200.0
+    assert out['pad_fraction'] == 0.125
+    assert out['goodput_ratio'] == 0.875
+    # No qtrace summary → no reconciliation block (absence is honest).
+    assert 'admission_reconciliation' not in out
+
+
+def test_live_summary_reconciles_lock_wait_against_qtrace():
+    qtrace = {'stages': {'admission_queue_wait':
+                         {'count': 7, 'p95_ms': 180.0}}}
+    out = capacity.live_summary(_cap_stats(), qtrace)
+    rec = out['admission_reconciliation']
+    assert rec['qtrace_count'] == 7
+    assert rec['qtrace_p95_ms'] == 180.0
+    # Engine histogram counts ALL queries, not just traced ones.
+    assert rec['engine_count'] == 10
+    assert rec['engine_p95_ms'] == 200.0
+
+
+def _round_json(tmp_path):
+    record = {
+        'ramp': {'levels': [{'clients': 1, 'qps': 10.0,
+                             'p50_ms': 90.0, 'p95_ms': 100.0},
+                            {'clients': 2, 'qps': 10.5,
+                             'p50_ms': 170.0, 'p95_ms': 200.0}]},
+        'capacity': {'saturation_qps': 12.0, 'utilization': 0.9},
+        'goodput': {'serve': {'goodput_ratio': 0.97}},
+        'result': {'sparse_dbp15k': {'pairs_sweep': {
+            '1': {'step_ms_per_pair': 100.0},
+            '4': {'step_ms_per_pair': 40.0}}}},
+    }
+    path = os.path.join(tmp_path, 'SERVE_r99.json')
+    with open(path, 'w') as f:
+        json.dump(record, f)
+    return path
+
+
+def test_analyze_paths_round_json(tmp_path):
+    tmp_path = str(tmp_path)
+    report = capacity.analyze_paths([_round_json(tmp_path)],
+                                    target_qps=20.0)
+    assert report['ramp']['knee']['clients'] == 1
+    assert report['ramp']['knee']['saturated'] is True
+    assert report['serve_capacity']['saturation_qps'] == 12.0
+    hr = report['batching_headroom']
+    assert hr['projected_qps_per_batch'] == {'1': 10.0, '4': 25.0}
+    assert hr['recommended_batch'] == 4
+    text = capacity.render(report)
+    assert 'knee: 1 clients @ 10.0 QPS' in text
+    assert 'batching headroom' in text
+
+
+def test_analyze_paths_obs_dir(tmp_path):
+    tmp_path = str(tmp_path)
+    with open(os.path.join(tmp_path, 'qtrace_summary.json'), 'w') as f:
+        json.dump({'end_to_end': {'count': 4, 'sum_ms': 200.0}}, f)
+    with open(os.path.join(tmp_path, 'goodput.json'), 'w') as f:
+        json.dump({'goodput_ratio': 0.9, 'pad_fraction_max': 0.2}, f)
+    report = capacity.analyze_paths([tmp_path])
+    # mean 50 ms over 4 queries → ceiling 20 QPS.
+    assert report['service_time']['mean_ms'] == 50.0
+    assert report['service_time']['saturation_qps'] == 20.0
+    assert report['goodput']['goodput_ratio'] == 0.9
+    assert 'saturation QPS   20.0' in capacity.render(report)
+
+
+def test_main_cli(tmp_path, capsys):
+    tmp_path = str(tmp_path)
+    assert capacity.main([os.path.join(tmp_path, 'missing.json')]) == 2
+    path = _round_json(tmp_path)
+    assert capacity.main([path, '--json']) == 0
+    report = json.loads(capsys.readouterr().out)
+    assert report['ramp']['knee']['clients'] == 1
+    assert capacity.main([path]) == 0
+    assert '== capacity model ==' in capsys.readouterr().out
+
+
+def test_capacity_module_is_jax_free():
+    import dgmc_tpu.obs.capacity as mod
+    assert 'import jax' not in open(mod.__file__).read()

@@ -503,3 +503,110 @@ def test_stage_p95_lost_account_is_regression(tmp_path, capsys):
     _write_qtrace(d, {'device_execute': (10.0, 20.0)})
     assert diff_mod.main([c, d,
                           '--max-stage-p95-regression', '0.5']) == 0
+
+
+def _write_plane(run_dir, goodput=None, cap=None):
+    """Drop the capacity/goodput plane's artifacts into a run dir."""
+    if goodput is not None:
+        with open(os.path.join(run_dir, 'goodput.json'), 'w') as f:
+            json.dump(goodput, f)
+    if cap is not None:
+        with open(os.path.join(run_dir, 'capacity.json'), 'w') as f:
+            json.dump(cap, f)
+
+
+def test_goodput_floor_gate(tmp_path, capsys):
+    a = write_run(tmp_path, 'a')
+    b = write_run(tmp_path, 'b')
+    _write_plane(a, goodput={'goodput_ratio': 0.9})
+    _write_plane(b, goodput={'goodput_ratio': 0.6})
+    # Off by default: a 0.9 -> 0.6 drop is an info row, not a failure.
+    assert diff_mod.main([a, b]) == 0
+    assert 'no --min-goodput floor configured' in capsys.readouterr().out
+    assert diff_mod.main([a, b, '--min-goodput', '0.8']) == 1
+    assert 'below the floor' in capsys.readouterr().out
+    assert diff_mod.main([a, b, '--min-goodput', '0.5']) == 0
+
+
+def test_goodput_lost_account_fails(tmp_path, capsys):
+    """A candidate that stopped recording the padding-waste account the
+    baseline had fails UNCONDITIONALLY (min_overlap semantics) — a
+    vanished account must never read as a pass."""
+    a = write_run(tmp_path, 'a')
+    b = write_run(tmp_path, 'b')
+    _write_plane(a, goodput={'goodput_ratio': 0.9})
+    assert diff_mod.main([a, b]) == 1
+    assert 'missing from candidate' in capsys.readouterr().out
+    # The reverse (baseline never measured goodput) gates the candidate
+    # against the floor alone.
+    assert diff_mod.main([b, a, '--min-goodput', '0.5']) == 0
+    assert diff_mod.main([b, a, '--min-goodput', '0.95']) == 1
+
+
+def test_pad_fraction_absolute_increase_gate(tmp_path, capsys):
+    a = write_run(tmp_path, 'a')
+    b = write_run(tmp_path, 'b')
+    _write_plane(a, goodput={'goodput_ratio': 0.9,
+                             'pad_fraction_max': 0.1})
+    _write_plane(b, goodput={'goodput_ratio': 0.9,
+                             'pad_fraction_max': 0.35})
+    # +0.25 absolute: over a 0.2 allowance, within a 0.3 one.
+    assert diff_mod.main([a, b]) == 0  # off by default
+    assert diff_mod.main([a, b, '--max-pad-regression', '0.2']) == 1
+    assert 'padding grew past the allowed increase' \
+        in capsys.readouterr().out
+    assert diff_mod.main([a, b, '--max-pad-regression', '0.3']) == 0
+
+
+def test_pad_fraction_zero_baseline_gates_directly(tmp_path):
+    """Absolute (not ratio) semantics: a perfectly-filled 0.0 baseline
+    is a meaningful value and any growth past the allowance fires."""
+    a = write_run(tmp_path, 'a')
+    b = write_run(tmp_path, 'b')
+    _write_plane(a, goodput={'goodput_ratio': 1.0,
+                             'pad_fraction_max': 0.0})
+    _write_plane(b, goodput={'goodput_ratio': 0.95,
+                             'pad_fraction_max': 0.05})
+    assert diff_mod.main([a, b, '--max-pad-regression', '0.01']) == 1
+    assert diff_mod.main([a, b, '--max-pad-regression', '0.1']) == 0
+
+
+def test_pad_fraction_lost_and_baseline_missing(tmp_path, capsys):
+    a = write_run(tmp_path, 'a')
+    b = write_run(tmp_path, 'b')
+    _write_plane(a, goodput={'goodput_ratio': 0.9,
+                             'pad_fraction_max': 0.1})
+    _write_plane(b, goodput={'goodput_ratio': 0.9})
+    # Candidate lost the pad account the baseline had: unconditional.
+    assert diff_mod.main([a, b]) == 1
+    assert 'missing from candidate' in capsys.readouterr().out
+    # Baseline without the account: skipped (first measured round has
+    # nothing to compare against), not failed.
+    assert diff_mod.main([b, a, '--max-pad-regression', '0.05']) == 0
+    assert 'skipped' in capsys.readouterr().out
+
+
+def test_utilization_ceiling_gate(tmp_path, capsys):
+    a = write_run(tmp_path, 'a')
+    b = write_run(tmp_path, 'b')
+    _write_plane(a, cap={'utilization': 0.5})
+    _write_plane(b, cap={'utilization': 0.95})
+    # Off by default — training runs carry no capacity account.
+    assert diff_mod.main([a, b]) == 0
+    assert 'no --max-utilization ceiling configured' \
+        in capsys.readouterr().out
+    assert diff_mod.main([a, b, '--max-utilization', '0.9']) == 1
+    assert 'over the utilization ceiling' in capsys.readouterr().out
+    assert diff_mod.main([a, b, '--max-utilization', '0.99']) == 0
+
+
+def test_utilization_lost_account_fails(tmp_path, capsys):
+    a = write_run(tmp_path, 'a')
+    b = write_run(tmp_path, 'b')
+    _write_plane(a, cap={'utilization': 0.5})
+    assert diff_mod.main([a, b]) == 1
+    assert 'missing from candidate' in capsys.readouterr().out
+    # Ceiling configured but baseline never served: candidate still
+    # gates against the absolute ceiling.
+    assert diff_mod.main([b, a, '--max-utilization', '0.4']) == 1
+    assert diff_mod.main([b, a, '--max-utilization', '0.9']) == 0

@@ -1,0 +1,165 @@
+"""Padding-waste / goodput accounting (obs.goodput): golden-math fill
+fractions, the FLOP-composed ratio, the recorded-row recomputation path
+(padding_bucket + padding_real → goodput.json), and the lost-account
+honesty rule (no real sizes recorded → no payload, never a guess)."""
+
+import numpy as np
+import pytest
+
+from dgmc_tpu.obs import goodput
+
+
+def test_fill_fraction_clamps_and_rejects():
+    assert goodput.fill_fraction(3, 4) == 0.75
+    assert goodput.fill_fraction(8, 4) == 1.0     # clamped, never >1
+    assert goodput.fill_fraction(-1, 4) == 0.0
+    assert goodput.fill_fraction(3, 0) is None    # undefined, not inf
+    assert goodput.fill_fraction(None, 4) is None
+    assert goodput.fill_fraction('x', 4) is None
+
+
+def test_mask_fills_counts_validity_masks():
+    node_mask = np.zeros((2, 8), bool)
+    node_mask[0, :3] = True
+    node_mask[1, :5] = True
+    edge_mask = np.zeros((2, 10), bool)
+    edge_mask[:, :4] = True
+    acct = goodput.mask_fills(node_mask, edge_mask)
+    assert acct == {'nodes_real': 8, 'nodes_padded': 16,
+                    'edges_real': 8, 'edges_padded': 20}
+
+
+def test_pair_fills_corr_is_product_of_side_fills():
+    s = {'nodes_real': 4, 'nodes_padded': 8,
+         'edges_real': 5, 'edges_padded': 10}
+    t = {'nodes_real': 8, 'nodes_padded': 8,
+         'edges_real': 10, 'edges_padded': 10}
+    fills = goodput.pair_fills(s, t)
+    assert fills['nodes'] == pytest.approx(12 / 16)
+    assert fills['edges'] == pytest.approx(15 / 20)
+    # corr = node fill of SOURCE × node fill of TARGET (the [N_s, N_t]
+    # correspondence matrix scales multiplicatively), NOT the combined
+    # node fill.
+    assert fills['corr'] == pytest.approx(0.5 * 1.0)
+
+
+def test_goodput_ratio_flop_weighted_golden():
+    fills = {'nodes': 0.5, 'edges': 0.4, 'corr': 0.25}
+    stages = {
+        'psi1': {'flops': 100},           # edges axis → 0.4
+        'initial_corr': {'flops': 300},   # corr axis → 0.25
+        'optimizer': {'flops': 50},       # 'none' axis → always useful
+    }
+    # useful = 100·0.4 + 300·0.25 + 50·1.0 = 165; executed = 450.
+    assert goodput.goodput_ratio(fills, stages) \
+        == pytest.approx(165 / 450)
+
+
+def test_goodput_ratio_fallback_is_min_fill():
+    fills = {'nodes': 0.5, 'edges': 0.4, 'corr': 0.25}
+    # No stage table: the conservative bound is the emptiest axis.
+    assert goodput.goodput_ratio(fills) == 0.25
+    assert goodput.goodput_ratio(fills, stages={}) == 0.25
+    # A stage with no flops/bytes contributes nothing → fallback.
+    assert goodput.goodput_ratio(fills, {'psi1': {'ops': 3}}) == 0.25
+
+
+def test_goodput_ratio_unknown_stage_defaults_to_nodes_axis():
+    fills = {'nodes': 0.5, 'edges': 0.9, 'corr': 0.8}
+    assert goodput.goodput_ratio(fills, {'mystery': {'flops': 10}}) \
+        == pytest.approx(0.5)
+
+
+def test_row_fills_golden():
+    row = {'batch': 2, 'nodes': '8x16', 'edges': '10x20', 'count': 3,
+           'real_nodes_s': 24, 'real_nodes_t': 48,
+           'real_edges_s': 30, 'real_edges_t': 60}
+    fills = goodput.row_fills(row)
+    # 6 collations: source nodes 24/48, target nodes 48/96 → both 0.5.
+    assert fills['nodes'] == pytest.approx(72 / 144)
+    assert fills['edges'] == pytest.approx(90 / 180)
+    assert fills['corr'] == pytest.approx(0.5 * 0.5)
+
+
+def test_row_fills_absent_real_account_is_none():
+    # A row that predates the padding_real counter must yield None —
+    # absence is honest, never guessed as full.
+    assert goodput.row_fills({'batch': 1, 'nodes': '8x8',
+                              'edges': '16x16', 'count': 2}) is None
+    assert goodput.row_fills({'batch': 1, 'nodes': 'bogus',
+                              'edges': '16x16', 'count': 2,
+                              'real_nodes_s': 1, 'real_nodes_t': 1,
+                              'real_edges_s': 1,
+                              'real_edges_t': 1}) is None
+
+
+def test_merge_real_rows_joins_by_bucket_identity():
+    buckets = [{'batch': 1, 'nodes': '8x8', 'edges': '16x16',
+                'count': 2},
+               {'batch': 2, 'nodes': '4x4', 'edges': '8x8', 'count': 1}]
+    reals = [{'batch': 1, 'nodes': '8x8', 'edges': '16x16',
+              'axis': 'nodes_s', 'count': 10},
+             {'batch': 1, 'nodes': '8x8', 'edges': '16x16',
+              'axis': 'edges_t', 'count': 20}]
+    merged = goodput.merge_real_rows(buckets, reals)
+    assert merged[0]['real_nodes_s'] == 10
+    assert merged[0]['real_edges_t'] == 20
+    # The unmatched bucket passes through untouched (no real_* keys).
+    assert not any(k.startswith('real_') for k in merged[1])
+
+
+def test_payload_from_rows_aggregate_and_max_pad():
+    rows = [
+        # Full bucket: fill 1.0 everywhere.
+        {'batch': 1, 'nodes': '8x8', 'edges': '4x4', 'count': 1,
+         'real_nodes_s': 8, 'real_nodes_t': 8,
+         'real_edges_s': 4, 'real_edges_t': 4},
+        # Half-full bucket.
+        {'batch': 1, 'nodes': '8x8', 'edges': '4x4', 'count': 1,
+         'real_nodes_s': 4, 'real_nodes_t': 4,
+         'real_edges_s': 2, 'real_edges_t': 2},
+    ]
+    payload = goodput.payload_from_rows(rows)
+    assert payload['composed_with_stage_flops'] is False
+    assert len(payload['buckets']) == 2
+    assert payload['buckets'][0]['goodput_ratio'] == 1.0
+    assert payload['buckets'][0]['pad_fraction'] == 0.0
+    # Half-full: node fill 0.5, corr 0.25 → fallback ratio 0.25.
+    assert payload['buckets'][1]['goodput_ratio'] == 0.25
+    assert payload['buckets'][1]['pad_fraction'] == 0.5
+    assert payload['pad_fraction_max'] == 0.5
+    # Equal node weight per row → plain mean of the two ratios.
+    assert payload['goodput_ratio'] == pytest.approx((1.0 + 0.25) / 2)
+
+
+def test_payload_without_any_real_account_is_none():
+    rows = [{'batch': 1, 'nodes': '8x8', 'edges': '16x16', 'count': 5}]
+    # The diff gate's lost-account rule needs absence to STAY absent.
+    assert goodput.payload_from_rows(rows) is None
+    assert goodput.payload_from_rows([]) is None
+
+
+def test_registry_roundtrip_recomputes_goodput(monkeypatch):
+    """The satellite contract: record_padding's real= totals make pad
+    waste recomputable from the recorded tables alone."""
+    from dgmc_tpu.obs import registry
+    monkeypatch.setattr(registry, 'REGISTRY', registry.Registry())
+    registry.record_padding(batch=2, nodes='8x8', edges='4x4',
+                            real={'nodes_s': 8, 'nodes_t': 16,
+                                  'edges_s': 4, 'edges_t': 8})
+    merged = goodput.merge_real_rows(registry.padding_bucket_table(),
+                                     registry.padding_real_table())
+    payload = goodput.payload_from_rows(merged)
+    b = payload['buckets'][0]
+    # One collation of batch 2: 16 padded source nodes, 8 real.
+    assert b['node_fill'] == pytest.approx(24 / 32)
+    assert b['corr_fill'] == pytest.approx(0.5 * 1.0)
+
+
+def test_goodput_module_is_jax_free():
+    import importlib
+    import sys
+    mod = importlib.import_module('dgmc_tpu.obs.goodput')
+    src = open(mod.__file__).read()
+    assert 'import jax' not in src
+    assert sys.modules['dgmc_tpu.obs.goodput'] is mod

@@ -219,6 +219,37 @@ def test_serve_qtrace_columns(tmp_path):
     assert 'admission_queue_wait' not in line1
 
 
+def test_serve_goodput_and_utilization_columns(tmp_path):
+    """r04+ rounds carry the capacity/goodput plane: the serve goodput
+    ratio and Little's-law utilization become columns; a pre-capacity
+    round renders '-' in both, not a crash."""
+    _write(tmp_path, 'SERVE_r01.json', {
+        'round': 1, 'supervision': {'outcome': 'completed',
+                                    'restarts': 1},
+        'latency': {'server_p50_ms': 111.8, 'server_p95_ms': 134.8},
+        'qps': 28.6, 'clients': 4})
+    _write(tmp_path, 'SERVE_r04.json', {
+        'round': 4, 'supervision': {'outcome': 'completed',
+                                    'restarts': 1},
+        'latency': {'server_p50_ms': 100.0, 'server_p95_ms': 150.0},
+        'qps': 19.7, 'clients': 4,
+        'goodput': {'serve': {'goodput_ratio': 0.987}},
+        'capacity': {'utilization': 0.876}})
+    r1, r4 = collect_rounds([str(tmp_path)])
+    assert r1['goodput'] is None
+    assert r1['utilization'] is None
+    assert r4['goodput'] == 0.987
+    assert r4['utilization'] == 0.876
+    table = render([r1, r4])
+    assert 'goodput' in table and 'util' in table
+    (line1,) = [ln for ln in table.splitlines()
+                if ln.strip().startswith('1 ')]
+    (line4,) = [ln for ln in table.splitlines()
+                if ln.strip().startswith('4 ')]
+    assert '0.987' in line4 and '0.876' in line4
+    assert '0.987' not in line1
+
+
 def test_serve_falls_back_to_client_latency(tmp_path):
     _write(tmp_path, 'SERVE_r02.json', {
         'round': 2, 'supervision': {'outcome': 'completed',

@@ -373,6 +373,70 @@ def test_padding_buckets_in_status(service):
     assert serve_rows and serve_rows[0]['count'] >= 1
 
 
+def test_capacity_metric_families_strict_parse(service):
+    """The capacity plane's /metrics surface: the in-flight gauge, the
+    per-bucket pad-fraction gauge, the goodput ratio, and the engine
+    lock split into wait vs hold histograms — all through the strict
+    exposition parser."""
+    for seed in (11, 12):
+        assert post_match(service.port, _query(seed)[0])[0] == 200
+    code, text = get_json(service.port, '/metrics')
+    assert code == 200
+    families = parse_exposition(text)
+    assert families['dgmc_inflight']['type'] == 'gauge'
+    # Scraped between requests: nothing is mid-execute.
+    assert families['dgmc_inflight']['samples'][0][2] == 0
+    pads = {labels.get('bucket'): v for (_n, labels, v)
+            in families['dgmc_pad_fraction']['samples']}
+    # 6x12 queries into the 8x16 bucket: real, nonzero padding.
+    assert '8x16' in pads
+    assert 0.0 < pads['8x16'] < 1.0
+    ratio = families['dgmc_goodput_ratio']['samples'][0][2]
+    assert 0.0 < ratio < 1.0
+    for fam in ('dgmc_lock_wait_seconds', 'dgmc_lock_hold_seconds'):
+        assert families[fam]['type'] == 'histogram'
+        counts = [v for (name, _l, v) in families[fam]['samples']
+                  if name.endswith('_count')]
+        assert counts and counts[0] >= 2
+
+
+def test_status_capacity_section_and_artifact(service):
+    """/status carries the live queueing model (Little's-law ρ from
+    measured arrival × service time, lock wait/hold quantiles, the
+    qtrace reconciliation) and ``_flush_capacity`` persists the same
+    object as ``capacity.json`` where ``obs.report`` summarizes it."""
+    from dgmc_tpu.obs.report import load_run, summarize
+    for seed in (13, 14):
+        assert post_match(service.port, _query(seed)[0])[0] == 200
+    code, status = get_json(service.port, '/status')
+    assert code == 200
+    cap = status['capacity']
+    assert cap['queries'] >= 2
+    assert cap['mean_service_ms'] > 0
+    assert cap['saturation_qps'] == pytest.approx(
+        1000.0 / cap['mean_service_ms'], rel=1e-3)
+    # Little's law: ρ = λ × E[service].
+    assert cap['utilization'] == pytest.approx(
+        cap['arrival_qps'] * cap['mean_service_ms'] / 1e3, abs=5e-3)
+    for side in ('lock_wait_ms', 'lock_hold_ms'):
+        hist = cap[side]
+        assert hist['count'] >= 2
+        assert hist['p50_ms'] <= hist['p95_ms'] <= hist['p99_ms']
+    rec = cap['admission_reconciliation']
+    assert rec['engine_count'] >= rec['qtrace_count'] >= 1
+    assert 0.0 < cap['pad_fraction'] < 1.0
+    # The artifact side: flush, reload, summarize.
+    service._flush_capacity()
+    run = load_run(service.obs.dir)
+    assert run['capacity']['queries'] == cap['queries']
+    s = summarize(run)
+    assert s['utilization'] == run['capacity']['utilization']
+    assert s['capacity_lock_wait_p95_ms'] \
+        == run['capacity']['lock_wait_ms']['p95_ms']
+    assert s['capacity_lock_hold_p95_ms'] \
+        == run['capacity']['lock_hold_ms']['p95_ms']
+
+
 @pytest.mark.slow
 def test_warm_restart_hits_cache(tmp_path):
     """A second worker over the same checkpoint dir skips the ψ₁ corpus
