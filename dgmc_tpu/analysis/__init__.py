@@ -38,6 +38,15 @@ a run is launched, in two tiers:
   per device (per-specimen budgets — the static face of the
   million-entity memory claims — and the AD-residual-blowup class of
   loop-carried full-axis buffers).
+- **concurrency tier** (:mod:`~dgmc_tpu.analysis.con_rules`, on the
+  thread-entry/lock model :mod:`~dgmc_tpu.analysis.concurrency`):
+  ``ast`` lints over the serving source — which class attributes are
+  touched from thread entry points (Thread/Timer targets,
+  ``do_GET``/``do_POST`` handlers, signal/atexit hooks) and which
+  locks guard them — for unlocked read-modify-writes (the PR-15
+  serve-counter race class), lock-order inversions, non-atomic
+  artifact writes, unsafe signal-handler work, and unbounded shared
+  container growth.
 
 A recompile-hazard pass (:mod:`~dgmc_tpu.analysis.recompile`) hashes
 abstract step signatures across padding buckets and cross-checks them
@@ -56,7 +65,12 @@ from dgmc_tpu.analysis.findings import (Finding, Severity, load_baseline,
 from dgmc_tpu.analysis.jaxpr_rules import (analyze_closed_jaxpr,
                                            analyze_donation,
                                            callback_equations)
-from dgmc_tpu.analysis.source_rules import lint_source_tree, lint_source_file
+from dgmc_tpu.analysis.source_rules import (lint_source_tree,
+                                            lint_source_file,
+                                            lint_source_paths)
+from dgmc_tpu.analysis.con_rules import (lint_concurrency_tree,
+                                         lint_concurrency_file,
+                                         lint_concurrency_paths)
 from dgmc_tpu.analysis.recompile import analyze_buckets, bucket_signature
 from dgmc_tpu.analysis.registry import (SpecimenCache, default_specimens,
                                         run_trace_tier)
@@ -78,6 +92,10 @@ __all__ = [
     'callback_equations',
     'lint_source_tree',
     'lint_source_file',
+    'lint_source_paths',
+    'lint_concurrency_tree',
+    'lint_concurrency_file',
+    'lint_concurrency_paths',
     'analyze_buckets',
     'bucket_signature',
     'SpecimenCache',

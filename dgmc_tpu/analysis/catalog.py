@@ -22,6 +22,7 @@ TIERS = {
     'SHD': 'sharded HLO (post-GSPMD partitioned programs)',
     'SCH': 'schedule (list-schedule overlap over partitioned HLO)',
     'MEM': 'liveness (static peak-live bytes over partitioned HLO)',
+    'CON': 'concurrency (thread-entry/lock model over serving source)',
 }
 
 
@@ -298,6 +299,77 @@ RULES: Dict[str, RuleDoc] = {d.rule: d for d in [
        'Make the producing search AD-opaque (custom_jvp + '
        'stop_gradient, as ops/topk.py does) or rematerialize in the '
        'backward pass instead of carrying full-axis residuals.'),
+    # --- concurrency tier ------------------------------------------------
+    _r('CON501', 'error',
+       'shared attribute read-modify-written from a thread with no lock',
+       'A class attribute is read-modify-written (`+=` / `self.x = '
+       'self.x + ...`) from a method reachable from a thread entry '
+       'point (Thread/Timer target, do_GET/do_POST handler, '
+       'signal/atexit hook) while no write site of that attribute in '
+       'the class holds a lock. Plain rebinding is exempt: a single '
+       'STORE_ATTR is atomic under the GIL.',
+       'Python `+=` on an attribute is read-op-write, not atomic: '
+       'concurrent handler threads interleave between the read and the '
+       'store and increments vanish silently — the PR-15 serve-counter '
+       'bug (queries_served undercounted under load) as a rule class.',
+       'Guard every write of the attribute with the class lock '
+       '(`with self._lock: self.n += 1` — StreamingHistogram.observe '
+       'in obs/live.py is the in-repo model), or make the state '
+       'thread-local and merge on read.'),
+    _r('CON502', 'error',
+       'nested lock acquisition order inconsistent across call paths',
+       'Two locks of one class are acquired nested in both orders — '
+       'A then B on one path, B then A on another — lexically or one '
+       '`self.<m>()` call level deep.',
+       'Opposite acquisition orders deadlock by construction: the '
+       'first time two threads interleave between the outer and inner '
+       'acquire, each holds what the other needs, forever. The serve '
+       'engine already carries two locks and the continuous batcher '
+       'adds a queue lock — order discipline has to be mechanical.',
+       'Pick one canonical order for every pair of locks and '
+       'restructure the second path to match (or release the first '
+       'lock before taking the second, as engine.match does between '
+       'its admission and stats sections).'),
+    _r('CON503', 'warning',
+       'consumed artifact written in place (no tmp+rename)',
+       "open(path, 'w') on an artifact path in a function that never "
+       'calls os.replace/os.rename and whose path expression does not '
+       'name a temp file.',
+       'The write is torn twice over: a concurrent reader (supervisor, '
+       'scraper, restarted worker) can open the file mid-write, and a '
+       'crash leaves a truncated artifact that poisons the next run. '
+       'Every obs artifact writer in this repo uses tmp+os.replace for '
+       'exactly this reason.',
+       'Write through utils/io.write_json_atomic, or an explicit '
+       "f'{path}.tmp.{pid}' + os.replace; append mode is exempt."),
+    _r('CON504', 'error',
+       'unsafe work inside a signal handler',
+       'A registered signal.signal handler acquires a lock, performs '
+       'buffered IO (open/print/logging), or builds allocation-heavy '
+       'formatted output (json.dumps, str.format, traceback.format_*) '
+       'directly in its body.',
+       'The handler runs with the interrupted thread stopped at an '
+       'arbitrary bytecode: any lock it takes may already be held '
+       '(instant deadlock), and buffered IO can re-enter stream '
+       'internals mid-update. The watchdog signal path is lock-free '
+       'by contract for exactly this reason.',
+       'Set a flag/Event and do the work on a thread, or restrict the '
+       'handler to pre-cached state and lock-free writes (the '
+       'watchdog `_on_signal` -> `dump(use_locks=False)` model).'),
+    _r('CON505', 'warning',
+       'shared container grows without bound from a serving thread',
+       'A list/dict/set/deque attribute built in __init__ grows '
+       '(.append/.add/keyed store) from a thread-entry method while '
+       'the class shows no cap: no deque(maxlen=...), no len() check, '
+       'no eviction or rotation.',
+       'A long-lived serving process accretes per-query state forever '
+       'until the OOM killer arrives — hours or days after the deploy, '
+       'far from the cause. The bounded-ring discipline (FlightRecorder '
+       'deque(maxlen), qtrace capacity with drop accounting) exists '
+       'for this.',
+       'Use collections.deque(maxlen=...) for rings, or an explicit '
+       'capacity check with drop/evict accounting on every growth '
+       'path.'),
 ]}
 
 #: ``{rule: one-line title}`` — the ``--list-rules`` table (kept under

@@ -19,12 +19,23 @@ specimens — needs enough devices; CI forces 8 virtual CPU devices so
 the tier runs on every push), ``--skip-sched`` (SCH/MEM schedule &
 liveness rules over the same partitioned HLO: modeled collective
 overlap, serialized async pairs, double-buffer opportunities, static
-peak-live-byte budgets, AD-residual blowup). The recompile pass needs
-a recorded run's buckets: it runs only when ``--obs-dir`` is given —
-padding buckets are a runtime artifact, there is nothing to analyze
-statically without one. The trace, sharded, and schedule tiers share
-one build/trace/lower/compile per specimen
+peak-live-byte budgets, AD-residual blowup), ``--skip-concurrency``
+(CON thread-entry/lock rules over the serving source). The recompile
+pass needs a recorded run's buckets: it runs only when ``--obs-dir``
+is given — padding buckets are a runtime artifact, there is nothing
+to analyze statically without one. The trace, sharded, and schedule
+tiers share one build/trace/lower/compile per specimen
 (:class:`~dgmc_tpu.analysis.registry.SpecimenCache`).
+
+The source and concurrency tiers scan the package PLUS the repo-root
+bench drivers (``bench.py``, ``serve_bench.py``) and ``benchmarks/``
+when they sit next to the package — they gained jit-wrapping and
+threading logic and must be linted like the package. ``--source-root``
+overrides the whole root set with one tree.
+
+Output: human text (default), ``--json`` (machine-readable, stable),
+or ``--format github`` (GitHub Actions ``::error file=...``
+annotations for NEW findings — inline PR surfacing from the CI gate).
 
 Exit status: 0 clean under the ``--fail-on`` policy, 1 otherwise, 2 on
 usage errors. ``--fail-on`` policies: ``new`` (default — findings not in
@@ -53,7 +64,14 @@ def build_parser():
                     'rules, source ast lints, recompile-hazard checks, '
                     'and sharded-HLO communication rules.')
     p.add_argument('--json', action='store_true',
-                   help='emit the machine-readable report on stdout')
+                   help='emit the machine-readable report on stdout '
+                        '(alias for --format json; byte-stable)')
+    p.add_argument('--format', choices=('text', 'json', 'github'),
+                   default=None,
+                   help='report format: text (default), json (same '
+                        'bytes as --json), or github (GitHub Actions '
+                        '::error/::warning annotations for NEW '
+                        'findings + a summary line)')
     p.add_argument('--baseline', default=None,
                    help='baseline-suppression file (default: nearest '
                         f'{findings_mod.DEFAULT_BASELINE_NAME} walking '
@@ -93,9 +111,14 @@ def build_parser():
                    help='skip the sharded-HLO (SHD) tier')
     p.add_argument('--skip-sched', action='store_true',
                    help='skip the schedule & liveness (SCH/MEM) tier')
+    p.add_argument('--skip-concurrency', action='store_true',
+                   help='skip the concurrency (CON) tier')
     p.add_argument('--source-root', default=None,
-                   help='source tree to lint (default: the installed '
-                        'dgmc_tpu package)')
+                   help='source tree to lint with the SRC and CON '
+                        'tiers (default: the installed dgmc_tpu '
+                        'package plus the repo-root bench drivers — '
+                        'bench.py, serve_bench.py, benchmarks/ — when '
+                        'present beside it)')
     p.add_argument('--obs-dir', default=None,
                    help='recorded obs run dir: cross-check its padding '
                         'buckets + compile telemetry (RCP202)')
@@ -129,13 +152,15 @@ def collect_findings(args, progress):
     out = []
     skipped = []
     if tier_on('SRC'):
-        from dgmc_tpu.analysis.source_rules import lint_source_tree
-        root = args.source_root
-        if root is None:
-            import dgmc_tpu
-            root = os.path.dirname(os.path.abspath(dgmc_tpu.__file__))
-        progress(f'source tier: {root}')
-        out.extend(lint_source_tree(root))
+        from dgmc_tpu.analysis.source_rules import lint_source_paths
+        roots = _source_roots(args)
+        progress(f'source tier: {", ".join(roots)}')
+        out.extend(lint_source_paths(roots))
+    if tier_on('CON'):
+        from dgmc_tpu.analysis.con_rules import lint_concurrency_paths
+        roots = _source_roots(args)
+        progress(f'concurrency tier: {", ".join(roots)}')
+        out.extend(lint_concurrency_paths(roots))
     if tier_on('RCP'):
         # _rules_analyzed drops RCP without --obs-dir: padding buckets
         # are a runtime artifact, there is nothing to analyze
@@ -170,6 +195,29 @@ def collect_findings(args, progress):
     return out, skipped
 
 
+#: Repo-root bench drivers / dirs linted alongside the package when
+#: they exist beside it (PRs 15-18 gave them jit-wrapping and threading
+#: logic; a package-only scan leaves them invisible to SRC/CON rules).
+_DRIVER_ROOTS = ('bench.py', 'serve_bench.py', 'benchmarks')
+
+
+def _source_roots(args):
+    """The SRC/CON scan roots: ``--source-root`` verbatim when given,
+    else the installed package plus whichever repo-root bench drivers
+    exist beside it."""
+    if args.source_root is not None:
+        return [args.source_root]
+    import dgmc_tpu
+    pkg = os.path.dirname(os.path.abspath(dgmc_tpu.__file__))
+    roots = [pkg]
+    repo = os.path.dirname(pkg)
+    for name in _DRIVER_ROOTS:
+        cand = os.path.join(repo, name)
+        if os.path.exists(cand):
+            roots.append(cand)
+    return roots
+
+
 def _rules_analyzed(args):
     """The rule-id set this run can produce, given tier skips and
     select/ignore filters — everything OUTSIDE it is preserved on
@@ -185,6 +233,8 @@ def _rules_analyzed(args):
         rules -= {r for r in rules if r.startswith('SHD')}
     if args.skip_sched:
         rules -= {r for r in rules if r.startswith(('SCH', 'MEM'))}
+    if args.skip_concurrency:
+        rules -= {r for r in rules if r.startswith('CON')}
     if args.select:
         rules &= _parse_rules(args.select)
     if args.ignore:
@@ -213,8 +263,8 @@ def _parse_rules(spec):
     return {r.strip() for r in spec.split(',') if r.strip()}
 
 
-def render_text(report, stream=sys.stdout):
-    w = stream.write
+def render_text(report, stream=None):
+    w = (stream or sys.stdout).write
     for f in report['findings']:
         mark = '' if f['fingerprint'] not in report['_suppressed'] else \
             ' [baselined]'
@@ -222,6 +272,60 @@ def render_text(report, stream=sys.stdout):
         w(f"        {f['message']}\n")
         if f.get('detail'):
             w(f"        ({f['detail']})\n")
+    s = report['summary']
+    w(f"dgmc-lint: {s['total']} finding(s) — {s['new']} new, "
+      f"{s['suppressed']} baselined "
+      f"(errors {s['errors']}, warnings {s['warnings']}, "
+      f"infos {s['infos']})\n")
+
+
+_GH_LEVEL = {'error': 'error', 'warning': 'warning', 'info': 'notice'}
+
+
+def _gh_escape(s):
+    """GitHub workflow-command escaping (%, CR, LF; commas/colons too
+    in property values, per the runner's parser)."""
+    return (str(s).replace('%', '%25').replace('\r', '%0D')
+            .replace('\n', '%0A'))
+
+
+def _gh_escape_prop(s):
+    return _gh_escape(s).replace(':', '%3A').replace(',', '%2C')
+
+
+def _where_file_line(where):
+    """``(file, line)`` parsed out of a finding's where string —
+    handles both ``path/file.py:12`` and ``specimen:path/file.py:12``;
+    (None, None) for non-file locations (e.g. the recompile pass's
+    ``obs``)."""
+    parts = where.split(':')
+    for i, part in enumerate(parts):
+        if part.endswith('.py'):
+            line = None
+            if i + 1 < len(parts) and parts[i + 1].isdigit():
+                line = parts[i + 1]
+            return part, line
+    return None, None
+
+
+def render_github(report, stream=None):
+    """GitHub Actions annotations for the NEW findings (baselined ones
+    are reviewed debt — annotating them on every PR would be noise),
+    plus the same summary line the text renderer ends with."""
+    new = set(report['new'])
+    w = (stream or sys.stdout).write
+    for f in report['findings']:
+        if f['fingerprint'] not in new:
+            continue
+        level = _GH_LEVEL.get(f['severity'], 'warning')
+        file, line = _where_file_line(f['where'])
+        props = [f'title={_gh_escape_prop("dgmc-lint " + f["rule"])}']
+        if file:
+            props.insert(0, f'file={_gh_escape_prop(file)}')
+            if line:
+                props.insert(1, f'line={line}')
+        w(f'::{level} {",".join(props)}::'
+          f'{_gh_escape(f["rule"] + ": " + f["message"])}\n')
     s = report['summary']
     w(f"dgmc-lint: {s['total']} finding(s) — {s['new']} new, "
       f"{s['suppressed']} baselined "
@@ -250,7 +354,12 @@ def main(argv=None):
               file=sys.stderr)
         return 2
 
-    quiet = args.json
+    if args.json and args.format not in (None, 'json'):
+        print(f'dgmc-lint: --json conflicts with '
+              f'--format {args.format}', file=sys.stderr)
+        return 2
+    fmt = args.format or ('json' if args.json else 'text')
+    quiet = fmt == 'json'
 
     def progress(msg):
         if not quiet:
@@ -377,8 +486,10 @@ def main(argv=None):
             'infos': sum(f.severity == Severity.INFO for f in reported),
         },
     }
-    if args.json:
+    if fmt == 'json':
         print(json.dumps(report, indent=1, sort_keys=True))
+    elif fmt == 'github':
+        render_github(report)
     else:
         report['_suppressed'] = {f.fingerprint for f in suppressed}
         render_text(report)

@@ -327,3 +327,20 @@ def lint_source_tree(root: str,
             continue
         out.extend(lint_source_file(path, rel=rel))
     return out
+
+
+def lint_source_paths(paths: Sequence[str]) -> List[Finding]:
+    """Source rules over a mix of files and directories — the
+    multi-root scan the CLI drives (the package plus the repo-root
+    bench drivers and ``benchmarks/``, which gained jit-wrapping and
+    threading logic but were invisible to a single-root scan). A bare
+    file reports under its basename (repo-relative for repo-root
+    drivers); a directory reports as :func:`lint_source_tree` does."""
+    out = []
+    for p in paths:
+        p = os.path.abspath(p)
+        if os.path.isdir(p):
+            out.extend(lint_source_tree(p))
+        else:
+            out.extend(lint_source_file(p, rel=os.path.basename(p)))
+    return out

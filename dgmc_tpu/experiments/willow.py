@@ -32,6 +32,7 @@ from dgmc_tpu.train import (Checkpointer, MetricLogger, create_train_state,
 from dgmc_tpu.utils import (ConcatDataset, PairDataset, PairLoader,
                             ValidPairDataset, graph_limits)
 from dgmc_tpu.utils.data import GraphPair, pad_pair_batch
+from dgmc_tpu.utils.io import write_json_atomic
 
 NUM_KP = 10  # every WILLOW item has exactly 10 keypoints
 
@@ -304,8 +305,10 @@ def main(argv=None):
     for i in range(len(done_accs) + 1, args.runs + 1):
         done_accs.append(run(i))
         if runs_path:
-            with open(runs_path, 'w') as f:
-                json.dump([list(map(float, a)) for a in done_accs], f)
+            # Atomic: runs.json is the resume ledger — a crash mid-dump
+            # must leave the previous runs readable, not a torn file.
+            write_json_atomic(runs_path,
+                              [list(map(float, a)) for a in done_accs])
     all_accs = np.array(done_accs)
     mean, std = all_accs.mean(axis=0), all_accs.std(axis=0, ddof=1)
     print('-' * 14 * 5)

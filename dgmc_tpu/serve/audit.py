@@ -99,7 +99,8 @@ class ShadowAuditor:
             try:
                 self._audit_one(*item)
             except Exception as e:    # noqa: BLE001 — audit never kills serving
-                self.errors += 1
+                with self._cond:
+                    self.errors += 1
                 print(f'shadow-audit: {type(e).__name__}: {e}',
                       file=sys.stderr, flush=True)
             finally:
@@ -122,7 +123,11 @@ class ShadowAuditor:
                 & set(int(t) for t in reference[i])) / k
             for i in range(n_real)]
         recall = sum(recalls) / max(len(recalls), 1)
-        self.audited += 1
+        # Under _cond like dropped/errors: the counters are read from
+        # serving/main threads (gauges, close-time accounting) while
+        # this thread increments — an unlocked += loses counts (CON501).
+        with self._cond:
+            self.audited += 1
         self.tracker.observe_audit(trace_id, recall,
                                    exact=recall >= 1.0)
 

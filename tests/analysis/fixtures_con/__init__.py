@@ -1,0 +1,8 @@
+"""Golden fixtures for the concurrency (CON) lint tier.
+
+One module per rule, each detected by EXACTLY that rule (and by no
+source-tier rule), plus ``clean_controls.py`` which exercises every
+hazardous shape done right and must lint silent. The modules are data
+for ``tests/analysis/test_con_rules.py`` — nothing imports them for
+their behavior.
+"""

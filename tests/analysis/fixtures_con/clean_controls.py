@@ -1,0 +1,68 @@
+"""Clean controls for the CON tier: every hazardous shape done RIGHT —
+locked counters, consistent lock order, tmp+rename artifact writes, a
+flag-only signal handler, capped containers. Must lint silent under
+every CON rule (and every SRC rule)."""
+
+import collections
+import json
+import os
+import signal
+import threading
+
+SHUTDOWN = threading.Event()
+
+
+def _on_term(signum, frame):
+    SHUTDOWN.set()                       # flag-only handler: safe
+
+
+def install():
+    signal.signal(signal.SIGTERM, _on_term)
+
+
+def save_manifest_atomic(path, entries):
+    scratch = f'{path}.tmp.{os.getpid()}'
+    with open(scratch, 'w') as f:
+        json.dump({'entries': entries}, f)
+    os.replace(scratch, path)
+
+
+class Server:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._stats_lock = threading.Lock()
+        self.served = 0
+        self.recent = collections.deque(maxlen=256)
+        self.by_client = {}
+        self.capacity = 1024
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def _loop(self):
+        while not self._stop.is_set():
+            item = self._next()
+            with self._stats_lock:       # guarded RMW: CON501-clean
+                self.served += 1
+            self.recent.append(item)     # maxlen ring: CON505-clean
+            if len(self.by_client) < self.capacity:
+                self.by_client[item] = item   # len-capped: CON505-clean
+            else:
+                self.by_client.pop(next(iter(self.by_client)))
+
+    def _next(self):
+        return object()
+
+    def snapshot(self):
+        with self._lock:                 # one order everywhere:
+            with self._stats_lock:       # CON502-clean
+                return self.served, len(self.recent)
+
+    def reset(self):
+        with self._lock:
+            with self._stats_lock:       # same order again
+                self.served = 0
+
+    def close(self):
+        self._stop.set()
+        self._thread.join()

@@ -1,0 +1,25 @@
+"""CON501 golden fixture: the PR-15 counter bug in miniature — a
+counter read-modify-written from a daemon thread with no lock anywhere
+in the class."""
+
+import threading
+import time
+
+
+class Poller:
+    def __init__(self):
+        self.polls = 0
+        self.last_status = None
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def _loop(self):
+        while not self._stop.is_set():
+            self.polls += 1              # CON501: unlocked += off-thread
+            self.last_status = 'ok'      # plain rebind: exempt (atomic)
+            time.sleep(0.01)
+
+    def close(self):
+        self._stop.set()
+        self._thread.join()

@@ -1,0 +1,23 @@
+"""CON502 golden fixture: two locks taken nested in opposite orders on
+two call paths of one class — deadlock by construction."""
+
+import threading
+
+
+class Transfer:
+    def __init__(self):
+        self._accounts = threading.Lock()
+        self._journal = threading.Lock()
+        self.balance = {}
+        self.entries = 0
+
+    def debit(self, key, amount):
+        with self._accounts:
+            with self._journal:              # order: accounts -> journal
+                self.balance[key] = self.balance.get(key, 0) - amount
+                self.entries += 1
+
+    def reconcile(self):
+        with self._journal:
+            with self._accounts:             # CON502: journal -> accounts
+                return dict(self.balance), self.entries

@@ -1,0 +1,14 @@
+"""CON503 golden fixture: a consumed artifact written in place via
+bare ``open(path, 'w')`` — no tmp suffix, no ``os.replace``."""
+
+import json
+
+
+def save_manifest(path, entries):
+    with open(path, 'w') as f:               # CON503: in-place write
+        json.dump({'entries': entries}, f)
+
+
+def append_log(path, line):
+    with open(path, 'a') as f:               # append: exempt
+        f.write(line + '\n')

@@ -1,0 +1,18 @@
+"""CON504 golden fixture: a signal handler that takes a lock and does
+buffered IO — both unsafe with the main thread interrupted at an
+arbitrary point."""
+
+import signal
+import threading
+
+STATE_LOCK = threading.Lock()
+STATE = {'requests': 0}
+
+
+def _on_term(signum, frame):
+    with STATE_LOCK:                         # CON504: lock in handler
+        print('terminating:', STATE)         # CON504: buffered IO
+
+
+def install():
+    signal.signal(signal.SIGTERM, _on_term)

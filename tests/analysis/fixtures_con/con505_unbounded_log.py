@@ -1,0 +1,26 @@
+"""CON505 golden fixture: a shared list appended from a serving thread
+with no cap, ring, or eviction anywhere in the class."""
+
+import threading
+
+
+class RequestLog:
+    def __init__(self):
+        self.history = []
+        self.by_client = {}
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._drain, daemon=True)
+        self._thread.start()
+
+    def _drain(self):
+        while not self._stop.is_set():
+            item = self._next()
+            self.history.append(item)        # CON505: unbounded growth
+            self.by_client[item] = item      # CON505: unbounded dict
+
+    def _next(self):
+        return object()
+
+    def close(self):
+        self._stop.set()
+        self._thread.join()

@@ -1,0 +1,87 @@
+"""Catalog completeness: drift between the rule registry, the CLI
+surfaces, and the docs reference page is impossible.
+
+Every rule id any analysis module can EMIT must (a) have a catalog
+entry with a tier and non-empty what/why/fix, (b) appear in
+``--list-rules``, (c) render through ``--explain``, and (d) appear on
+docs/source/modules/lint-rules.rst under its tier section. Conversely
+the catalog must not carry rules nothing can emit."""
+
+import os
+import re
+
+from dgmc_tpu.analysis.catalog import (RULE_CATALOG, RULES, TIERS,
+                                       explain_rule)
+from dgmc_tpu.analysis.lint import main
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+ANALYSIS_DIR = os.path.join(REPO, 'dgmc_tpu', 'analysis')
+RST = os.path.join(REPO, 'docs', 'source', 'modules', 'lint-rules.rst')
+
+_RULE_ID = re.compile(r"'([A-Z]{3}\d{3})'")
+
+
+def _emitted_rule_ids():
+    """Rule-id string literals across every analysis module except the
+    catalog itself (which registers, not emits)."""
+    out = set()
+    for fn in sorted(os.listdir(ANALYSIS_DIR)):
+        if not fn.endswith('.py') or fn == 'catalog.py':
+            continue
+        with open(os.path.join(ANALYSIS_DIR, fn)) as f:
+            out |= set(_RULE_ID.findall(f.read()))
+    return out
+
+
+def test_every_emitted_rule_is_cataloged_and_vice_versa():
+    emitted = _emitted_rule_ids()
+    assert emitted, 'rule-literal scan found nothing — regex rotted?'
+    missing = emitted - set(RULES)
+    assert not missing, f'emitted but not cataloged: {sorted(missing)}'
+    dead = set(RULES) - emitted
+    assert not dead, f'cataloged but nothing emits them: {sorted(dead)}'
+
+
+def test_every_rule_prefix_has_a_tier():
+    for rule, doc in RULES.items():
+        assert rule[:3] in TIERS, f'{rule}: prefix not in TIERS'
+        assert doc.tier == TIERS[rule[:3]]
+        for field in ('title', 'what', 'why', 'fix', 'severity'):
+            assert getattr(doc, field).strip(), f'{rule}.{field} empty'
+        assert doc.severity in ('error', 'warning', 'info')
+    assert set(RULE_CATALOG) == set(RULES)
+    # Every tier with registered rules; CON is the 6th and newest.
+    assert {r[:3] for r in RULES} == set(TIERS)
+
+
+def test_list_rules_covers_every_rule(capsys):
+    assert main(['--list-rules']) == 0
+    out = capsys.readouterr().out
+    for rule in RULES:
+        assert rule in out, f'{rule} missing from --list-rules'
+
+
+def test_explain_renders_every_rule(capsys):
+    for rule in RULES:
+        text = explain_rule(rule)
+        for section in ('What:', 'Why:', 'Fix:', 'severity:', 'tier:'):
+            assert section in text, f'{rule}: {section} missing'
+    # And through the CLI, all at once.
+    assert main(['--explain', ','.join(sorted(RULES))]) == 0
+    out = capsys.readouterr().out
+    for rule in RULES:
+        assert rule in out
+
+
+def test_reference_page_covers_every_rule_under_its_tier():
+    with open(RST) as f:
+        rst = f.read()
+    for rule, doc in RULES.items():
+        assert f'``{rule}``' in rst, f'{rule} missing from lint-rules.rst'
+        assert doc.title in rst, (
+            f'{rule}: catalog title not on lint-rules.rst — '
+            f'regenerate the page to match catalog.py')
+    for prefix in TIERS:
+        assert re.search(rf'^{prefix} — ', rst, re.M), (
+            f'tier section {prefix} missing from lint-rules.rst')

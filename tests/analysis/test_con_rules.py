@@ -1,0 +1,380 @@
+"""Concurrency tier: golden fixtures (one per CON rule), clean
+controls, in-repo positive/negative models, and the model's precision
+decisions (RMW-only CON501, linear acquire/release CON502 tracking,
+tmp+rename CON503 exemptions)."""
+
+import ast
+import os
+import textwrap
+
+import pytest
+
+from dgmc_tpu.analysis.concurrency import build_module_model
+from dgmc_tpu.analysis.con_rules import (lint_concurrency_file,
+                                         lint_concurrency_paths,
+                                         lint_concurrency_tree)
+from dgmc_tpu.analysis.findings import Severity
+from dgmc_tpu.analysis.source_rules import lint_source_file
+
+FIXTURES = os.path.join(os.path.dirname(__file__), 'fixtures_con')
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _fixture(name):
+    return os.path.join(FIXTURES, name)
+
+
+def _lint_src(tmp_path, code):
+    p = tmp_path / 'mod.py'
+    p.write_text(textwrap.dedent(code))
+    return lint_concurrency_file(str(p), rel='mod.py')
+
+
+# ---------------------------------------------------------------------------
+# Golden fixtures: each module trips EXACTLY its rule, and no SRC rule.
+# ---------------------------------------------------------------------------
+
+GOLDEN = [
+    ('con501_unlocked_counter.py', 'CON501', Severity.ERROR),
+    ('con502_lock_inversion.py', 'CON502', Severity.ERROR),
+    ('con503_bare_write.py', 'CON503', Severity.WARNING),
+    ('con504_signal_lock.py', 'CON504', Severity.ERROR),
+    ('con505_unbounded_log.py', 'CON505', Severity.WARNING),
+]
+
+
+@pytest.mark.parametrize('name,rule,severity', GOLDEN,
+                         ids=[g[1] for g in GOLDEN])
+def test_golden_fixture_trips_exactly_its_rule(name, rule, severity):
+    found = lint_concurrency_file(_fixture(name))
+    assert found, f'{name} produced no findings'
+    assert {f.rule for f in found} == {rule}
+    assert all(f.severity == severity for f in found)
+    # Every finding carries the v2 context snippet (line-independent
+    # fingerprints) and a location inside the fixture.
+    for f in found:
+        assert f.context
+        assert name in f.where
+    # The fixture is clean under the source tier: detected by exactly
+    # this rule across ALL tiers that scan source.
+    assert lint_source_file(_fixture(name)) == []
+
+
+def test_clean_controls_are_silent():
+    for name in ('clean_controls.py', '__init__.py'):
+        assert lint_concurrency_file(_fixture(name)) == []
+        assert lint_source_file(_fixture(name)) == []
+
+
+def test_tree_and_paths_drivers_cover_the_fixture_dir():
+    by_tree = lint_concurrency_tree(FIXTURES)
+    assert {f.rule for f in by_tree} == {'CON501', 'CON502', 'CON503',
+                                         'CON504', 'CON505'}
+    # The multi-root driver accepts bare files and reports basenames
+    # (how repo-root bench drivers are addressed).
+    one = lint_concurrency_paths([_fixture('con501_unlocked_counter.py')])
+    assert len(one) == 1
+    assert one[0].where.startswith('con501_unlocked_counter.py:')
+
+
+# ---------------------------------------------------------------------------
+# In-repo models: the code the rules were calibrated against.
+# ---------------------------------------------------------------------------
+
+def _repo_findings(relpath):
+    return lint_concurrency_file(os.path.join(REPO, relpath),
+                                 rel=relpath)
+
+
+def test_streaming_histogram_is_the_con501_clean_control():
+    """obs/live.py locks observe() and snapshot() — the in-repo
+    positive model CON501 must stay silent on (satellite: its
+    thread-safety is pinned by the hammer test in tests/obs)."""
+    rules = {f.rule for f in _repo_findings('dgmc_tpu/obs/live.py')}
+    assert 'CON501' not in rules
+    assert 'CON505' not in rules
+
+
+def test_watchdog_signal_path_is_the_con504_clean_control():
+    """obs/watchdog.py's _on_signal is lock-free by contract (cached
+    context, dump(use_locks=False)) — the positive model CON504 must
+    not flag."""
+    rules = {f.rule for f in _repo_findings('dgmc_tpu/obs/watchdog.py')}
+    assert 'CON504' not in rules
+    assert 'CON503' not in rules  # dump() writes tmp+os.replace
+
+
+def test_engine_sequential_locks_are_not_an_inversion():
+    """serve/engine.py takes _stats_lock, releases, acquires _lock,
+    releases in a finally, then takes _stats_lock again — sequential,
+    never nested. The linear acquire/release tracking must not read it
+    as a CON502 pair."""
+    rules = {f.rule for f in _repo_findings('dgmc_tpu/serve/engine.py')}
+    assert 'CON502' not in rules
+
+
+def test_shadow_auditor_counters_lint_clean_after_fix():
+    """Regression pin for the genuine finding this tier was built on:
+    ShadowAuditor.audited/errors are now incremented under _cond (like
+    dropped always was) — CON501 silent on serve/audit.py."""
+    assert _repo_findings('dgmc_tpu/serve/audit.py') == []
+
+
+def test_atomic_writer_is_the_con503_clean_control():
+    assert not any(f.rule == 'CON503'
+                   for f in _repo_findings('dgmc_tpu/utils/io.py'))
+
+
+# ---------------------------------------------------------------------------
+# Model precision decisions.
+# ---------------------------------------------------------------------------
+
+def test_con501_requires_rmw_not_plain_rebind(tmp_path):
+    """Plain attribute rebinding from a thread is exempt (STORE_ATTR is
+    atomic under the GIL; the watchdog's cache refreshes rely on it) —
+    only read-modify-write forms fire."""
+    found = _lint_src(tmp_path, '''
+        import threading
+
+        class C:
+            def __init__(self):
+                self.cache = None
+                self.n = 0
+                threading.Thread(target=self._loop).start()
+
+            def _loop(self):
+                self.cache = 'fresh'       # rebind: exempt
+                self.n = self.n + 1        # RMW spelled as Assign: fires
+    ''')
+    assert [f.rule for f in found] == ['CON501']
+    assert 'self.n' in found[0].message
+
+
+def test_con501_any_locked_write_site_silences(tmp_path):
+    """One guarded write means the class HAS a locking story for the
+    attribute; mixed-discipline is out of scope for an error gate."""
+    found = _lint_src(tmp_path, '''
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.n = 0
+                threading.Thread(target=self._loop).start()
+
+            def _loop(self):
+                self.n += 1
+
+            def bump(self):
+                with self._lock:
+                    self.n += 1
+    ''')
+    assert not any(f.rule == 'CON501' for f in found)
+
+
+def test_con501_reaches_through_self_calls_and_timers(tmp_path):
+    """The entry closure follows self.<m>() from the entry method, and
+    Timer callbacks are entries too."""
+    found = _lint_src(tmp_path, '''
+        import threading
+
+        class C:
+            def __init__(self):
+                self.fired = 0
+                threading.Timer(1.0, self._tick).start()
+
+            def _tick(self):
+                self._bump()
+
+            def _bump(self):
+                self.fired += 1
+    ''')
+    assert [f.rule for f in found] == ['CON501']
+    assert '_bump' in found[0].message
+
+
+def test_con501_http_handler_methods_are_entries(tmp_path):
+    found = _lint_src(tmp_path, '''
+        class Handler:
+            hits = None
+
+            def __init__(self):
+                self.hits = 0
+
+            def do_GET(self):
+                self.hits += 1
+    ''')
+    assert [f.rule for f in found] == ['CON501']
+
+
+def test_con502_one_call_level_deep(tmp_path):
+    """An inversion split across a self-call is still found: holder of
+    B calls a method that takes A, while another path nests A then B."""
+    found = _lint_src(tmp_path, '''
+        import threading
+
+        class C:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+
+            def forward(self):
+                with self._a:
+                    with self._b:
+                        pass
+
+            def backward(self):
+                with self._b:
+                    self._take_a()
+
+            def _take_a(self):
+                with self._a:
+                    pass
+    ''')
+    assert [f.rule for f in found] == ['CON502']
+
+
+def test_con502_sequential_acquire_release_is_clean(tmp_path):
+    """The engine.match idiom: acquire, release in a finally, THEN take
+    the other lock — linear statement-order tracking sees no nesting."""
+    found = _lint_src(tmp_path, '''
+        import threading
+
+        class C:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+
+            def one(self):
+                self._a.acquire()
+                try:
+                    pass
+                finally:
+                    self._a.release()
+                with self._b:
+                    pass
+
+            def two(self):
+                with self._b:
+                    pass
+                with self._a:
+                    pass
+    ''')
+    assert found == []
+
+
+def test_con503_tmp_rename_and_append_are_exempt(tmp_path):
+    found = _lint_src(tmp_path, '''
+        import json
+        import os
+
+        def atomic(path, payload):
+            scratch = path + '.tmp'
+            with open(scratch, 'w') as f:
+                json.dump(payload, f)
+            os.replace(scratch, path)
+
+        def appender(path, line):
+            with open(path, 'a') as f:
+                f.write(line)
+
+        def torn(path, payload):
+            with open(path, 'w') as f:
+                json.dump(payload, f)
+    ''')
+    assert [f.rule for f in found] == ['CON503']
+    assert ':15' in found[0].where or 'torn' in found[0].message
+
+
+def test_con504_flags_direct_body_only(tmp_path):
+    """Only the handler's own body is judged — work it delegates to a
+    method (the watchdog's dump(use_locks=False)) is that method's
+    business. Lambdas registered inline are judged too."""
+    found = _lint_src(tmp_path, '''
+        import signal
+        import threading
+
+        LOCK = threading.Lock()
+
+        def handler(signum, frame):
+            helper()                    # delegation: not judged here
+
+        def helper():
+            with LOCK:
+                print('deep')           # not in the handler body
+
+        signal.signal(signal.SIGTERM, handler)
+        signal.signal(signal.SIGINT, lambda s, f: print('bye'))
+    ''')
+    assert [f.rule for f in found] == ['CON504']
+    assert '<lambda>' in found[0].message
+
+
+def test_con505_deque_maxlen_and_len_check_are_exempt(tmp_path):
+    found = _lint_src(tmp_path, '''
+        import collections
+        import threading
+
+        class C:
+            def __init__(self):
+                self.ring = collections.deque(maxlen=64)
+                self.capped = {}
+                self.leak = []
+                threading.Thread(target=self._loop).start()
+
+            def _loop(self):
+                self.ring.append(1)
+                if len(self.capped) < 100:
+                    self.capped['k'] = 1
+                self.leak.append(1)
+    ''')
+    assert [f.rule for f in found] == ['CON505']
+    assert 'self.leak' in found[0].message
+
+
+def test_unparseable_file_is_the_source_tiers_problem(tmp_path):
+    p = tmp_path / 'broken.py'
+    p.write_text('def f(:\n')
+    assert lint_concurrency_file(str(p)) == []
+    assert [f.rule for f in lint_source_file(str(p))] == ['SRC100']
+
+
+def test_refuses_bytecode(tmp_path):
+    pyc = tmp_path / '__pycache__'
+    pyc.mkdir()
+    target = pyc / 'mod.cpython-311.pyc'
+    target.write_bytes(b'\x00')
+    with pytest.raises(ValueError, match='bytecode'):
+        lint_concurrency_file(str(target))
+
+
+def test_module_model_shape():
+    """The model itself: entries, closure, lock attrs, and lock-order
+    edges are what the rules believe they are."""
+    tree = ast.parse(textwrap.dedent('''
+        import threading
+
+        class S:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._cond = threading.Condition()
+                self.jobs = []
+                threading.Thread(target=self._run, daemon=True).start()
+
+            def _run(self):
+                self._step()
+
+            def _step(self):
+                with self._lock:
+                    with self._cond:
+                        self.jobs.append(1)
+    '''))
+    model = build_module_model(tree)
+    (cls,) = model.classes
+    assert cls.lock_attrs == {'_lock', '_cond'}
+    assert set(cls.entry_closure) == {'_run', '_step'}
+    assert cls.entry_closure['_step'][1] == '_run'
+    assert ('_lock', '_cond') in cls.lock_edges
+    assert ('_cond', '_lock') not in cls.lock_edges
+    assert cls.container_attrs == {'jobs': False}

@@ -142,8 +142,16 @@ def test_committed_baseline_is_v2_with_contexts():
     path = os.path.join(repo, 'lint-baseline.json')
     data = json.loads(open(path).read())
     assert data['version'] == 2
-    assert len(data['findings']) == 21, \
-        'the migration must carry the same 21 reviewed findings'
+    by_rule = {}
+    for e in data['findings']:
+        by_rule[e['rule']] = by_rule.get(e['rule'], 0) + 1
+    assert by_rule == {
+        'TRC005': 21,       # the PR 11 migration's reviewed scatters
+        'CON501': 1,        # watchdog dump_count: signal path stays
+                            # lock-free by design
+        'CON503': 15,       # bench-driver in-place artifact writes
+        'SRC103': 2,        # psi2_micro's deliberate jit-per-variant
+    }, 'the reviewed-debt ledger changed composition — re-triage'
     assert all(e.get('context') for e in data['findings'])
 
 

@@ -277,6 +277,132 @@ def test_prune_and_write_are_mutually_exclusive(tmp_path, capsys):
     assert rc == 2
 
 
+@pytest.fixture
+def race_tree(tmp_path):
+    """A tiny source tree with a known CON501 finding (and no SRC
+    findings)."""
+    root = tmp_path / 'racepkg'
+    root.mkdir()
+    (root / 'racy.py').write_text(
+        'import threading\n\n\n'
+        'class C:\n'
+        '    def __init__(self):\n'
+        '        self.n = 0\n'
+        '        threading.Thread(target=self._loop).start()\n\n'
+        '    def _loop(self):\n'
+        '        self.n += 1\n')
+    return str(root)
+
+
+def test_concurrency_tier_through_the_cli(race_tree, tmp_path, capsys):
+    args = ['--json', '--skip-trace', '--skip-recompile',
+            '--skip-sharded', '--skip-sched', '--source-root', race_tree,
+            '--baseline', str(tmp_path / 'bl.json')]
+    rc, out = _run(args + ['--fail-on', 'new'], capsys)
+    assert rc == 1
+    report = json.loads(out)
+    assert {f['rule'] for f in report['findings']} == {'CON501'}
+    (finding,) = report['findings']
+    assert finding['severity'] == 'error'
+    assert finding['where'].startswith('racepkg/racy.py:')
+    # --skip-concurrency drops the tier (and the finding with it).
+    rc, out = _run(args + ['--skip-concurrency', '--fail-on', 'new'],
+                   capsys)
+    assert rc == 0
+    assert json.loads(out)['findings'] == []
+    # Tier-aware --select: selecting only CON rules skips the source
+    # tier entirely; selecting only SRC rules skips the CON tier.
+    rc = main(args[1:] + ['--select', 'CON501', '--fail-on', 'none'])
+    err = capsys.readouterr().err
+    assert rc == 0
+    assert 'concurrency tier' in err and 'source tier' not in err
+    rc = main(args[1:] + ['--select', 'SRC101', '--fail-on', 'none'])
+    err = capsys.readouterr().err
+    assert 'source tier' in err and 'concurrency tier' not in err
+
+
+def test_skip_concurrency_preserves_baselined_con_entries(
+        race_tree, tmp_path, capsys):
+    """A --skip-concurrency --write-baseline must not drop reviewed CON
+    suppressions (_rules_analyzed is the preservation boundary)."""
+    baseline = str(tmp_path / 'bl.json')
+    args = ['--skip-trace', '--skip-recompile', '--skip-sharded',
+            '--skip-sched', '--source-root', race_tree,
+            '--baseline', baseline]
+    rc, _ = _run(args + ['--write-baseline'], capsys)
+    assert rc == 0
+    fps = {e['fingerprint'] for e in json.loads(
+        (tmp_path / 'bl.json').read_text())['findings']}
+    assert fps, 'CON finding was not recorded'
+    rc, _ = _run(args + ['--skip-concurrency', '--write-baseline'],
+                 capsys)
+    assert rc == 0
+    kept = {e['fingerprint'] for e in json.loads(
+        (tmp_path / 'bl.json').read_text())['findings']}
+    assert fps <= kept, 'skip-concurrency rewrite dropped CON entries'
+
+
+def test_github_format_annotations(race_tree, bad_tree, tmp_path,
+                                   capsys):
+    """--format github: one ::error/::warning annotation per NEW
+    finding with file= and line= properties; baselined findings are
+    not annotated; --json output stays byte-identical to before."""
+    baseline = str(tmp_path / 'bl.json')
+    args = ['--skip-trace', '--skip-recompile', '--skip-sharded',
+            '--skip-sched', '--source-root', race_tree,
+            '--baseline', baseline]
+    rc, out = _run(args + ['--format', 'github', '--fail-on', 'new'],
+                   capsys)
+    assert rc == 1
+    lines = out.splitlines()
+    ann = [ln for ln in lines if ln.startswith('::')]
+    assert len(ann) == 1
+    assert ann[0].startswith('::error file=racepkg/racy.py,line=')
+    assert 'title=dgmc-lint CON501' in ann[0]
+    assert '::CON501: ' in ann[0]
+    assert lines[-1].startswith('dgmc-lint: 1 finding(s) — 1 new')
+    # Baselined findings produce NO annotations (reviewed debt is not
+    # re-announced on every PR) but still count in the summary line.
+    rc, _ = _run(args + ['--write-baseline'], capsys)
+    assert rc == 0
+    rc, out = _run(args + ['--format', 'github', '--fail-on', 'new'],
+                   capsys)
+    assert rc == 0
+    assert not [ln for ln in out.splitlines() if ln.startswith('::')]
+    assert '1 finding(s) — 0 new, 1 baselined' in out
+    # --json unchanged by the new mode; --json + --format github is a
+    # usage error rather than a silent pick.
+    rc, out = _run(['--json'] + args + ['--fail-on', 'none'], capsys)
+    assert rc == 0
+    json.loads(out)
+    rc, _ = _run(['--json'] + args + ['--format', 'github'], capsys)
+    assert rc == 2
+
+
+def test_github_format_escapes_newlines_and_commas(tmp_path, capsys):
+    """Workflow-command escaping: %, CR, LF in messages; a finding in a
+    file whose path contains a comma must not break the property
+    parser."""
+    from io import StringIO
+    from dgmc_tpu.analysis.lint import render_github
+    report = {
+        'new': ['abc'],
+        'findings': [{
+            'rule': 'CON501', 'severity': 'error', 'fingerprint': 'abc',
+            'where': 'pkg/o,dd.py:3',
+            'message': 'line one\nline two % done',
+        }],
+        'summary': {'total': 1, 'new': 1, 'suppressed': 0,
+                    'errors': 1, 'warnings': 0, 'infos': 0},
+    }
+    buf = StringIO()
+    render_github(report, stream=buf)
+    out = buf.getvalue()
+    assert '::error file=pkg/o%2Cdd.py,line=3' in out
+    assert 'line one%0Aline two %25 done' in out
+    assert '\nline two' not in out
+
+
 def test_obs_dir_recompile_crosscheck(tmp_path, capsys):
     obs = tmp_path / 'obs'
     obs.mkdir()

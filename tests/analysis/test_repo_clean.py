@@ -7,9 +7,10 @@ import os
 import jax
 
 from dgmc_tpu.analysis import (SpecimenCache, callback_equations,
-                               load_baseline, lint_source_tree,
-                               run_sched_tier, run_sharded_tier,
-                               run_trace_tier, split_by_baseline)
+                               lint_concurrency_paths, load_baseline,
+                               lint_source_paths, run_sched_tier,
+                               run_sharded_tier, run_trace_tier,
+                               split_by_baseline)
 from dgmc_tpu.analysis.jaxpr_rules import TraceContext, analyze_closed_jaxpr
 from dgmc_tpu.analysis.registry import default_specimens, probes_forced_off
 
@@ -20,14 +21,20 @@ BASELINE = os.path.join(REPO, 'lint-baseline.json')
 
 def test_repo_lint_matches_committed_baseline():
     """No finding outside the reviewed ledger — the exact check CI runs
-    (``dgmc-lint --fail-on new``), trace, sharded, AND schedule/liveness
-    tiers on one shared specimen cache."""
+    (``dgmc-lint --fail-on new``): source AND concurrency tiers over
+    the CLI's full root set (package + repo-root bench drivers +
+    benchmarks/), plus trace, sharded, and schedule/liveness tiers on
+    one shared specimen cache."""
+    from dgmc_tpu.analysis.lint import _source_roots, build_parser
     baseline = load_baseline(BASELINE)
     assert baseline, f'missing committed baseline at {BASELINE}'
-    import dgmc_tpu
-    pkg = os.path.dirname(os.path.abspath(dgmc_tpu.__file__))
+    roots = _source_roots(build_parser().parse_args([]))
+    assert any(r.endswith('dgmc_tpu') for r in roots)
+    assert any(r.endswith('serve_bench.py') for r in roots), (
+        'bench drivers missing from the default scan roots')
     cache = SpecimenCache()
-    findings = (lint_source_tree(pkg) + run_trace_tier(cache=cache)
+    findings = (lint_source_paths(roots) + lint_concurrency_paths(roots)
+                + run_trace_tier(cache=cache)
                 + run_sharded_tier(cache=cache)
                 + run_sched_tier(cache=cache))
     new, suppressed = split_by_baseline(findings, baseline)

@@ -136,6 +136,62 @@ def test_histogram_bucket_counts_are_exact():
     assert snap['sum'] == pytest.approx(sum(values))
 
 
+def test_histogram_thread_safety_hammer():
+    """The serving contract: observe() is called from concurrent
+    handler threads while snapshot()/quantile() scrape — counts must be
+    EXACT (a lost increment means an unlocked read-modify-write) and
+    every mid-hammer snapshot internally consistent (+Inf bucket ==
+    count; cumulative counts monotone). The CON501 lint pins the lock
+    statically; this pins it dynamically."""
+    import threading
+    h = StreamingHistogram((0.1, 1.0, 10.0))
+    n_threads, per_thread = 8, 2000
+    start = threading.Barrier(n_threads + 1)
+    inconsistent = []
+
+    def writer(seed):
+        start.wait()
+        for i in range(per_thread):
+            h.observe((seed + i) % 20)
+
+    def scraper():
+        start.wait()
+        while h.count < n_threads * per_thread:
+            snap = h.snapshot()
+            cums = [c for _, c in snap['buckets']]
+            if snap['buckets'][-1][1] != snap['count'] \
+                    or cums != sorted(cums):
+                inconsistent.append(snap)
+                return
+
+    threads = [threading.Thread(target=writer, args=(s,))
+               for s in range(n_threads)]
+    scr = threading.Thread(target=scraper)
+    for t in threads + [scr]:
+        t.start()
+    for t in threads + [scr]:
+        t.join(timeout=60)
+    assert not inconsistent, f'torn snapshot: {inconsistent[0]}'
+    assert h.count == n_threads * per_thread        # no lost increments
+    snap = h.snapshot()
+    assert snap['buckets'][-1][1] == n_threads * per_thread
+    expect_sum = sum((s + i) % 20 for s in range(n_threads)
+                     for i in range(per_thread))
+    assert snap['sum'] == pytest.approx(expect_sum)
+
+
+def test_histogram_is_the_con501_clean_control():
+    """The concurrency lint tier stays SILENT on obs/live.py: the
+    locked observe()/snapshot() above is the in-repo positive model
+    CON501 cites in its fix text."""
+    import dgmc_tpu.obs.live as live_mod
+    from dgmc_tpu.analysis.con_rules import lint_concurrency_file
+    findings = lint_concurrency_file(live_mod.__file__,
+                                     rel='dgmc_tpu/obs/live.py')
+    assert not any(f.rule in ('CON501', 'CON505') for f in findings), \
+        [f.to_json() for f in findings]
+
+
 def test_histogram_rejects_bad_bounds():
     with pytest.raises(ValueError):
         StreamingHistogram(())

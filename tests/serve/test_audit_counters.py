@@ -1,0 +1,100 @@
+"""ShadowAuditor counter discipline — the genuine CON501 finding this
+PR's concurrency lint tier surfaced, pinned as a regression test.
+
+``audited``/``errors`` were incremented off-thread with no lock while
+``dropped`` was guarded by ``_cond``; today a single audit thread made
+the ``+=`` non-lossy in practice, but the counters are read from
+serving/main threads (service gauges, close-time accounting) and the
+moment a second audit worker lands (ROADMAP replica fleet) the unlocked
+read-modify-write loses counts. All three counters now move under
+``_cond``; the lint gate (CON501 at error severity over serve/) keeps
+it that way."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from dgmc_tpu.serve.audit import ShadowAuditor
+
+
+class _Router:
+    def route(self, num_nodes, num_edges):
+        return 'bucket'
+
+    def signature(self, bucket):
+        return 'bucket'
+
+    def pad_query(self, graph, bucket):
+        return graph
+
+
+class _Engine:
+    """The minimal surface _audit_one touches; exhaustive_topk raises
+    on marked queries to drive the errors counter."""
+
+    def __init__(self):
+        self.router = _Router()
+        self._exec = {'bucket': object()}
+
+    def exhaustive_topk(self, graph, info):
+        if graph.poison:
+            raise RuntimeError('audit boom')
+        return np.array([[[0, 1]]])      # [1, n_real=1, k=2]
+
+
+class _Graph:
+    num_nodes = 1
+    num_edges = 1
+
+    def __init__(self, poison):
+        self.poison = poison
+
+
+class _Tracker:
+    def __init__(self):
+        self.calls = []
+        self._lock = threading.Lock()
+
+    def observe_audit(self, trace_id, recall, exact):
+        with self._lock:
+            self.calls.append((trace_id, recall, exact))
+
+
+@pytest.mark.parametrize('n_ok,n_bad', [(40, 0), (25, 15)])
+def test_audited_and_errors_counts_are_exact(n_ok, n_bad):
+    tracker = _Tracker()
+    auditor = ShadowAuditor(_Engine(), tracker, sample_rate=1.0,
+                            seed=0, capacity=1024)
+    try:
+        info = {'shortlist_idx': [[0, 1]]}
+        submitted = 0
+        for i in range(n_ok + n_bad):
+            ok = auditor.maybe_submit(f'q{i:03d}', _Graph(i >= n_ok),
+                                      info)
+            submitted += bool(ok)
+        assert submitted == n_ok + n_bad     # sample_rate 1.0 keeps all
+        assert auditor.drain(timeout_s=60.0)
+        # Exact accounting: every submission lands in exactly one
+        # counter, none lost to an unlocked increment.
+        with auditor._cond:
+            audited, errors, dropped = (auditor.audited, auditor.errors,
+                                        auditor.dropped)
+        assert audited == n_ok
+        assert errors == n_bad
+        assert dropped == 0
+        assert len(tracker.calls) == n_ok
+        assert all(recall == 1.0 and exact
+                   for _, recall, exact in tracker.calls)
+    finally:
+        auditor.close()
+
+
+def test_counter_writes_are_lock_guarded_statically():
+    """serve/audit.py lints completely clean under the concurrency
+    tier — the static face of this regression test."""
+    import dgmc_tpu.serve.audit as audit_mod
+    from dgmc_tpu.analysis.con_rules import lint_concurrency_file
+    findings = lint_concurrency_file(audit_mod.__file__,
+                                     rel='dgmc_tpu/serve/audit.py')
+    assert findings == [], [f.to_json() for f in findings]
