@@ -491,6 +491,11 @@ def main(argv=None):
                       watchdog_deadline_s=args.watchdog_deadline,
                       fence_deadline_s=args.fence_deadline,
                       host_channel=channel, obs_port=args.obs_port)
+    # SLO/anomaly planes: step latency and the quality headlines are
+    # judged live (--slo) and watched for silent drift (always-on —
+    # the detectors are O(1) and only the excursions cost anything).
+    obs.attach_anomaly()
+    obs.attach_slo(getattr(args, 'slo', None))
     # collective-stall@N fires INSIDE the fence guard, where a wedged
     # collective would actually block.
     obs.fence_hook = plan.before_fence

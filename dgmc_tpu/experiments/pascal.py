@@ -188,6 +188,10 @@ def main(argv=None):
                       watchdog_deadline_s=args.watchdog_deadline,
                       fence_deadline_s=args.fence_deadline,
                       obs_port=args.obs_port)
+    # SLO/anomaly planes (obs/slo.py, obs/anomaly.py): judge the run
+    # against --slo if given, watch step latency for silent drift.
+    obs.attach_anomaly()
+    obs.attach_slo(getattr(args, 'slo', None))
     # Cost/MFU attribution (one extra trace, no extra XLA compile);
     # under data parallelism this is the sharded step, so the lowered
     # account covers the collective-carrying program.

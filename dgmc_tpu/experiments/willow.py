@@ -155,6 +155,10 @@ def main(argv=None):
                       watchdog_deadline_s=args.watchdog_deadline,
                       fence_deadline_s=args.fence_deadline,
                       obs_port=args.obs_port)
+    # SLO/anomaly planes (obs/slo.py, obs/anomaly.py): judge the run
+    # against --slo if given, watch step latency for silent drift.
+    obs.attach_anomaly()
+    obs.attach_slo(getattr(args, 'slo', None))
     # Cost/MFU attribution in <obs-dir>/efficiency.json (one extra
     # trace, no extra XLA compile — obs/cost.py).
     obs.record_cost('train_step', step, state, batch0,

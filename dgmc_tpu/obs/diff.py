@@ -115,6 +115,19 @@ run lacks it and lists the gated keys that run *does* carry, so a CI
 failure is diagnosable from the log alone (is the artifact missing, or
 just this account?).
 
+``--calibration <calibration.json>`` (see :mod:`dgmc_tpu.obs.calibrate`)
+rescales the RELATIVE thresholds above to ``z * rel_sigma`` of each
+metric's fitted run-to-run noise floor (``--calibration-z``, default 3):
+the gate fires on a shift three noise floors deep instead of a
+hand-picked fraction. Pinned fallbacks: metrics the calibration file
+does not cover (or covers with too few samples) keep their fixed
+thresholds unchanged, absolute floors/ceilings (``--min-*``,
+``--max-utilization``, compile/restart counts) are never rescaled, and
+every lost-account rule applies exactly as before — calibration
+adjusts gate WIDTH, never gate existence. Each rescaled gate is
+reported as a ``calibrated:`` info row naming the noise floor it was
+judged by.
+
 Exit codes: 0 = no regression, 1 = regression, 2 = usage/missing input.
 Like the report CLI, this module has **no jax import** — it must gate CI
 from artifacts alone.
@@ -878,6 +891,18 @@ def main(argv=None):
                              'layout-equivalence gate: e.g. '
                              '--require-equal loss,hits1); a key '
                              'either run failed to log fails')
+    parser.add_argument('--calibration', type=str, default=None,
+                        metavar='FILE',
+                        help='calibration.json (dgmc_tpu.obs.calibrate): '
+                             'rescale the relative regression thresholds '
+                             'to z * rel_sigma of each metric\'s fitted '
+                             'noise floor; uncalibrated metrics keep '
+                             'their fixed thresholds, absolute floors '
+                             'and lost-account rules are untouched')
+    parser.add_argument('--calibration-z', type=float, default=3.0,
+                        metavar='Z',
+                        help='significance multiple for calibrated gates '
+                             '(default %(default)s noise floors)')
     parser.add_argument('--allow-kernel-fallback', action='store_true',
                         help='downgrade pallas->fallback dispatch changes '
                              'from regression to note')
@@ -897,9 +922,7 @@ def main(argv=None):
         print(f'diff: {args.candidate} holds no telemetry', file=sys.stderr)
         return 2
 
-    rows, regressions = diff_runs(
-        a, b,
-        thresholds={
+    thresholds = {
             'step_p50': args.max_step_p50_regression,
             'step_p95': args.max_step_p95_regression,
             'throughput': args.max_throughput_regression,
@@ -922,13 +945,38 @@ def main(argv=None):
             'require_equal': tuple(
                 k.strip() for k in (args.require_equal or '').split(',')
                 if k.strip()),
-        },
+        }
+
+    calibration_notes = []
+    if args.calibration:
+        from dgmc_tpu.obs.calibrate import (apply_calibration,
+                                            load_calibration)
+        try:
+            cal = load_calibration(args.calibration)
+        except ValueError as e:
+            print(f'diff: {e}', file=sys.stderr)
+            return 2
+        thresholds, calibration_notes = apply_calibration(
+            thresholds, cal, z=args.calibration_z)
+
+    rows, regressions = diff_runs(
+        a, b, thresholds=thresholds,
         allow_kernel_fallback=args.allow_kernel_fallback)
+    for n in calibration_notes:
+        # One info row per rescaled gate: a calibrated verdict must
+        # say what it was judged by, in the same table it judged.
+        rows.append(_row(
+            f'calibrated:{n["gate"]}', n['fixed'],
+            round(n['calibrated'], 4), None, round(n['calibrated'], 4),
+            'info',
+            f'{n["metric"]}: z={n["z"]:g} x rel_sigma='
+            f'{n["rel_sigma"]:.4f} over n={n["n"]} samples'))
 
     if args.json:
         print(json.dumps({'baseline': args.baseline,
                           'candidate': args.candidate,
                           'rows': rows,
+                          'calibration': calibration_notes or None,
                           'regressions': len(regressions),
                           'ok': not regressions}, indent=1))
     else:

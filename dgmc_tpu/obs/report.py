@@ -95,6 +95,9 @@ def load_run(path):
             'quality': _read_json(os.path.join(path, 'quality.json')),
             'goodput': _read_json(os.path.join(path, 'goodput.json')),
             'capacity': _read_json(os.path.join(path, 'capacity.json')),
+            'slo': _read_json(os.path.join(path, 'slo.json')),
+            'anomalies': _read_json(os.path.join(path,
+                                                 'anomalies.json')),
         }
         if run['timings'] is None and not run['metrics']:
             from dgmc_tpu.resilience.supervisor import (ATTEMPT_PREFIX,
@@ -135,7 +138,8 @@ def load_run(path):
             'memory': None, 'dispatch': None, 'efficiency': None,
             'aggregate': None, 'hang': None, 'recovery': None,
             'flight': None, 'attribution': None, 'qtrace': None,
-            'quality': None, 'goodput': None, 'capacity': None}
+            'quality': None, 'goodput': None, 'capacity': None,
+            'slo': None, 'anomalies': None}
 
 
 def peak_memory(memory):
@@ -349,6 +353,45 @@ def summarize(run):
             hist = capacity.get(side) or {}
             if hist.get('p95_ms') is not None:
                 out[f'capacity_{side[:-3]}_p95_ms'] = hist['p95_ms']
+
+    slo = run.get('slo')
+    if slo:
+        # The SLO plane (slo.json): the judged account — worst budget
+        # consumption across objectives, any alerting burn windows and
+        # the breach counts. Headline-sized; the full per-window burn
+        # detail stays in the artifact.
+        objectives = slo.get('objectives') or {}
+        consumed = {name: o.get('budget_consumed')
+                    for name, o in objectives.items()
+                    if o.get('budget_consumed') is not None}
+        out['slo'] = {
+            'name': slo.get('slo'),
+            'budget_consumed': consumed,
+            'worst_budget_consumed': (round(max(consumed.values()), 6)
+                                      if consumed else None),
+            'alerting': sorted(
+                f'{name}:{wname}'
+                for name, o in objectives.items()
+                for wname, b in (o.get('burn') or {}).items()
+                if b.get('alerting')),
+            'breaches': (slo.get('breaches') or {}).get('counts') or {},
+        }
+
+    anomalies = run.get('anomalies')
+    if anomalies:
+        # The anomaly watch (anomalies.json): totals plus only the
+        # signals that actually fired — a quiet run summarizes quiet.
+        sig = anomalies.get('signals') or {}
+        out['anomaly'] = {
+            'events': len(anomalies.get('events') or []),
+            'truncated': anomalies.get('truncated', 0),
+            'spikes': sum(s.get('spikes', 0) for s in sig.values()),
+            'shifts': sum(s.get('shifts', 0) for s in sig.values()),
+            'fired': {name: {'spikes': s.get('spikes', 0),
+                             'shifts': s.get('shifts', 0)}
+                      for name, s in sorted(sig.items())
+                      if s.get('spikes') or s.get('shifts')},
+        }
 
     flight = run.get('flight')
     if flight:
@@ -702,6 +745,37 @@ def render(run):
                     f'p95={rec_adm.get("qtrace_p95_ms")}ms vs engine '
                     f'{rec_adm.get("engine_count")}x '
                     f'p95={rec_adm.get("engine_p95_ms")}ms')
+
+    if s.get('slo') or s.get('anomaly'):
+        lines.append('-- slo / anomaly plane --')
+        slo_s = s.get('slo')
+        if slo_s:
+            worst = slo_s.get('worst_budget_consumed')
+            lines.append(
+                f'  slo {slo_s.get("name", "?"):<12} worst budget '
+                f'consumed '
+                f'{f"{worst:.4f}" if worst is not None else "-"}'
+                + (f'  ALERTING: {", ".join(slo_s["alerting"])}'
+                   if slo_s.get('alerting') else ''))
+            for name, c in sorted(
+                    (slo_s.get('budget_consumed') or {}).items()):
+                lines.append(f'    {name:<16} budget {c:.4f}')
+            if slo_s.get('breaches'):
+                rendered = '  '.join(
+                    f'{k}={v}' for k, v in
+                    sorted(slo_s['breaches'].items()))
+                lines.append(f'  breaches         {rendered}')
+        an = s.get('anomaly')
+        if an:
+            lines.append(
+                f'  anomalies        {an["events"]} in ring '
+                f'({an["truncated"]} truncated), '
+                f'{an["spikes"]} spikes / {an["shifts"]} shifts'
+                + ('' if not an.get('fired') else '  ['
+                   + ', '.join(
+                       f'{name}: {f["spikes"]}s/{f["shifts"]}c'
+                       for name, f in sorted(an['fired'].items()))
+                   + ']'))
 
     lines.append('-- metrics --')
     lines.append(f'  records          {s["metrics_records"]}')

@@ -113,6 +113,16 @@ def add_obs_flag(parser):
              'live timings.json summary). 0 picks a free port; the '
              'chosen port is advertised in heartbeat.json so the '
              'supervisor and obs.aggregate can discover it')
+    parser.add_argument(
+        '--slo', dest='slo', type=str, default=None, metavar='FILE',
+        help='judge the run against a declarative SLO spec (JSON: '
+             'availability/latency objectives, optional hits@1 and '
+             'goodput floors — see dgmc_tpu/obs/slo.py): error-budget '
+             'consumption and multi-window burn rates are computed '
+             'live, exported as dgmc_slo_* in /metrics and the slo '
+             'section of /status, flushed to <obs-dir>/slo.json, and '
+             'a budget exhaustion or fast-burn breach dumps the '
+             'flight recorder; requires --obs-dir')
     return parser
 
 
@@ -198,6 +208,14 @@ class RunObserver:
         #: loss per scenario, consensus convergence, serve confidence),
         #: flushed as quality.json beside the latency artifacts.
         self.quality = None
+        #: SLO/anomaly planes (attach_slo / attach_anomaly): the
+        #: error-budget tracker judging this run and the streaming
+        #: detector watch arming the flight recorder. Both optional —
+        #: absence stays absent in the artifacts.
+        self.slo = None
+        self.anomaly = None
+        self._anomaly_compiles_seen = 0
+        self._anomaly_skips_seen = 0
         self._last_efficiency = None
         self._last_activity = time.time()
         self._dispatch_sink = None
@@ -372,6 +390,13 @@ class RunObserver:
                 # O(1)-memory latency account for /metrics — the
                 # serving-scale counterpart of the timer's full list.
                 self._live_hist.observe(dur)
+            if self.anomaly is not None:
+                self.anomaly.observe('step_latency_s', dur)
+            if self.slo is not None:
+                # A completed step is an available event; its duration
+                # feeds any end-to-end latency objective. Serve paths
+                # record their own per-query events instead.
+                self.slo.record(True, latency_s=dur)
             self._last_activity = time.time()
             # Probe records are attributed to this counter; with async
             # dispatch the attribution is approximate within the dispatch
@@ -704,6 +729,59 @@ class RunObserver:
             return None
         return self.flight.dump(reason, extra=extra)
 
+    def attach_slo(self, spec_or_path):
+        """Arm the SLO plane (:mod:`dgmc_tpu.obs.slo`): accepts a spec
+        file path (the ``--slo`` flag's value), a raw spec dict, or a
+        built :class:`~dgmc_tpu.obs.slo.SloSpec`. The tracker joins
+        ``/metrics`` (``dgmc_slo_*``), ``/status`` (``slo`` section),
+        is flushed to ``slo.json`` by every :meth:`flush`, and dumps
+        the flight recorder on budget exhaustion / burn alerts / floor
+        breaches. ``None`` input or a disabled observer is a no-op —
+        the experiment CLIs pass ``args.slo`` through unconditionally.
+        Raises ``ValueError`` on a malformed spec (a CLI given a bad
+        SLO must fail at startup, not judge nothing)."""
+        if spec_or_path is None or not self.enabled:
+            return None
+        from dgmc_tpu.obs.slo import SloSpec, SloTracker, load_slo_spec
+        if isinstance(spec_or_path, SloSpec):
+            spec = spec_or_path
+        elif isinstance(spec_or_path, dict):
+            spec = SloSpec(spec_or_path)
+        else:
+            spec = load_slo_spec(spec_or_path)
+        self.slo = SloTracker(spec, on_breach=self._on_slo_breach)
+        self.add_metrics_provider(self.slo.metric_families)
+        self.add_status_section('slo', self.slo.status)
+        return self.slo
+
+    def _on_slo_breach(self, kind, detail):
+        """SLO breach hook: capture the trailing context the moment
+        the budget dies (rate-limited by the tracker)."""
+        self.flight_dump(f'slo:{kind}', extra=detail)
+
+    def attach_anomaly(self, capacity=256):
+        """Arm the streaming anomaly watch
+        (:mod:`dgmc_tpu.obs.anomaly`): :meth:`step` feeds
+        ``step_latency_s``, :meth:`flush` feeds per-flush compile-event
+        deltas and writes ``anomalies.json``; subsystems feed their own
+        signals through ``observer.anomaly.observe``. A detected spike
+        or sustained shift dumps the flight recorder (rate-limited per
+        signal) — the trailing context of a silent degradation is
+        captured before anyone asks."""
+        if not self.enabled:
+            return None
+        from dgmc_tpu.obs.anomaly import AnomalyWatch
+        self.anomaly = AnomalyWatch(capacity=capacity,
+                                    on_anomaly=self._on_anomaly)
+        self.add_metrics_provider(self.anomaly.metric_families)
+        self.add_status_section('anomaly', self.anomaly.counters)
+        return self.anomaly
+
+    def _on_anomaly(self, event):
+        """Anomaly hook: one flight dump per excursion (the watch
+        rate-limits per signal)."""
+        self.flight_dump(f'anomaly:{event["signal"]}', extra=event)
+
     def _recovery_summary(self):
         """Condensed supervisor state for ``/healthz``: a supervised
         child's obs dir is ``<root>/attempt_<k>[/host_<i>]`` and
@@ -1020,8 +1098,10 @@ class RunObserver:
         if not self.enabled:
             return
         self._write('timings.json', self.timings())
+        quality_payload = None
         if self.quality is not None:
-            self._write('quality.json', self.quality.payload())
+            quality_payload = self.quality.payload()
+            self._write('quality.json', quality_payload)
         self._write('memory.json', {'snapshots': self._snapshots})
         self._write('dispatch.json', {'counts': self._since(
             dispatch_table(), self._dispatch_base)})
@@ -1042,6 +1122,37 @@ class RunObserver:
         goodput = self.goodput_payload()
         if goodput is not None:
             self._write('goodput.json', goodput)
+        if self.anomaly is not None:
+            # Per-flush compile-event delta: 0 once warm, so a mid-run
+            # recompile burst (padding-bucket churn) standardizes into
+            # an obvious spike against the quiet history.
+            events = self._watcher.count() if self._watcher else 0
+            self.anomaly.observe(
+                'compile_events', events - self._anomaly_compiles_seen)
+            self._anomaly_compiles_seen = events
+            # Guard skips (the rollback guard's published gauge, when
+            # the CLI publishes one): per-flush delta — a burst of
+            # skipped steps is a numerics event worth a flight dump.
+            skips = self._live_gauges.get('guard_skip_count')
+            if isinstance(skips, (int, float)):
+                self.anomaly.observe(
+                    'guard_skips', skips - self._anomaly_skips_seen)
+                self._anomaly_skips_seen = skips
+        if self.slo is not None:
+            # Floor gauges track the freshest plane headlines; a plane
+            # that stopped reporting CLEARS its gauge (absence stays
+            # absent — a floor cannot pass on a stale value). Flushing
+            # is also a judgment pass: the snapshot runs check(), so
+            # breach hooks fire at flush cadence even when nothing
+            # scrapes /metrics.
+            headline = ((quality_payload or {}).get('headline')
+                        or {}).get('metrics') or {}
+            self.slo.update_gauges(
+                hits1=headline.get('hits1'),
+                goodput=(goodput or {}).get('goodput_ratio'))
+            self._write('slo.json', self.slo.snapshot())
+        if self.anomaly is not None:
+            self._write('anomalies.json', self.anomaly.snapshot())
         from dgmc_tpu.obs.trace import export_chrome_trace
         with self._probe_lock:
             # Snapshot: the deque may receive callback-thread appends

@@ -38,7 +38,8 @@ import sys
 
 from dgmc_tpu.obs.observe import fmt_seconds
 
-__all__ = ['collect_rounds', 'parse_round', 'render', 'main']
+__all__ = ['collect_rounds', 'parse_round', 'render', 'trend',
+           'render_trend', 'main']
 
 _ROUND_FILE = re.compile(r'^(BENCH|MULTICHIP|SCALE|SERVE)_r(\d+)\.json$')
 #: Family render order (matches the chronology: single-chip first).
@@ -296,6 +297,72 @@ def render(rows):
     return '\n'.join(lines)
 
 
+#: Headline series the --trend changepoint scan walks per family.
+_TREND_METRICS = {
+    'BENCH': ('pairs_per_sec', 'step_p50_ms', 'mfu', 'overlap',
+              'hits1'),
+    'MULTICHIP': ('pairs_per_sec', 'step_p50_ms', 'mfu', 'overlap',
+                  'skew'),
+    'SCALE': ('pairs_per_sec', 'step_p50_ms', 'mfu'),
+    'SERVE': ('latency_p50_ms', 'latency_p95_ms', 'qps', 'hits1',
+              'goodput', 'utilization', 'warm_restart_s'),
+}
+
+
+def trend(rows):
+    """CUSUM changepoints over each family's committed headline series
+    (:func:`dgmc_tpu.obs.anomaly.changepoints` — the offline form of
+    the live watch). Returns ``[{'family', 'metric', 'rounds',
+    'changepoints': [{'round', 'direction', 'value'}]}, ...]`` for
+    every series with enough measured rounds to have a baseline; the
+    changepoint index maps back to the ROUND NUMBER so "p95 shifted up
+    at r04" reads straight off the table."""
+    from dgmc_tpu.obs.anomaly import changepoints
+    out = []
+    for family in _FAMILIES:
+        fam_rows = [r for r in rows if r['family'] == family]
+        if not fam_rows:
+            continue
+        for metric in _TREND_METRICS.get(family, ()):
+            series = [r.get(metric) for r in fam_rows]
+            measured = sum(1 for v in series if v is not None)
+            if measured < 4:
+                continue  # 3 baseline rounds + 1 to judge, minimum
+            cps = changepoints(series)
+            out.append({
+                'family': family,
+                'metric': metric,
+                'rounds': measured,
+                'changepoints': [
+                    {'round': fam_rows[cp['index']]['round'],
+                     'direction': cp['direction'],
+                     'value': cp['value']}
+                    for cp in cps],
+            })
+    return out
+
+
+def render_trend(trends):
+    lines = ['== trend changepoints (CUSUM over committed rounds) ==']
+    if not trends:
+        lines.append('  (no series with enough measured rounds — need '
+                     '4+ per family/metric)')
+        return '\n'.join(lines)
+    shifted = [t for t in trends if t['changepoints']]
+    for t in shifted:
+        marks = ', '.join(
+            f'r{cp["round"]:02d} {cp["direction"]} '
+            f'(to {_fmt(cp["value"])})'
+            for cp in t['changepoints'])
+        lines.append(f'  {t["family"]:<9} {t["metric"]:<16} {marks}')
+    stable = [t for t in trends if not t['changepoints']]
+    if stable:
+        lines.append(
+            '  stable: ' + ', '.join(
+                f'{t["family"]}.{t["metric"]}' for t in stable))
+    return '\n'.join(lines)
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser(
         prog='python -m dgmc_tpu.obs.timeline',
@@ -308,14 +375,23 @@ def main(argv=None):
                              'directory')
     parser.add_argument('--json', action='store_true',
                         help='print the machine-readable rows')
+    parser.add_argument('--trend', action='store_true',
+                        help='append the CUSUM changepoint view: which '
+                             'round each headline series shifted at '
+                             '(obs.anomaly.changepoints over the '
+                             'committed trajectory)')
     args = parser.parse_args(argv)
 
     paths = args.paths or ['benchmarks', '.']
     rows = collect_rounds(paths)
     if args.json:
-        print(json.dumps(rows, indent=1))
+        payload = ({'rows': rows, 'trend': trend(rows)}
+                   if args.trend else rows)
+        print(json.dumps(payload, indent=1))
     else:
         print(render(rows))
+        if args.trend:
+            print(render_trend(trend(rows)))
     if not rows:
         print(f'timeline: no round records under {paths}',
               file=sys.stderr)

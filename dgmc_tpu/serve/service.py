@@ -204,6 +204,9 @@ class ServeService:
         self._counts = threading.Lock()
         self._stop = threading.Event()
         self.low_confidence = 0
+        # Flush-loop-private QPS bookmark (only serve_forever touches
+        # it; queries_served itself stays under _counts).
+        self._last_flush_queries = 0
         self.auditor = None
         self.qtracer = None
         if getattr(args, 'obs_dir', None):
@@ -233,6 +236,14 @@ class ServeService:
                                obs_port=args.obs_port,
                                routes={'/match': self.handle_match})
         self.obs.add_metrics_provider(self._serve_metric_families)
+        # SLO/anomaly planes: --slo judges every query against the
+        # declared objectives (error budget + burn rates in /metrics,
+        # /status and slo.json); the anomaly watch is always on —
+        # query latency, QPS, compile events and quality margins feed
+        # streaming detectors that arm the flight recorder. A
+        # malformed --slo file fails startup here, loudly.
+        self.obs.attach_anomaly()
+        self.obs.attach_slo(getattr(args, 'slo', None))
         self.port = self.obs.live_port
         obs = self.obs
 
@@ -472,7 +483,11 @@ class ServeService:
             tracer = None
         trace = tracer.start(headers.get('traceparent')) \
             if tracer is not None else None
+        t0 = time.perf_counter()
         code, payload = self._match_inner(method, body, trace)
+        self._record_slo(code, time.perf_counter() - t0,
+                         trace.stage_ms()
+                         if trace is not None and code == 200 else None)
         if trace is None:
             return code, payload
         record = tracer.finish(
@@ -486,6 +501,19 @@ class ServeService:
         tracer.maybe_flush()
         return code, payload, {
             'traceparent': trace.response_traceparent()}
+
+    def _record_slo(self, code, latency_s, stages_ms):
+        """Feed one query outcome to the SLO/anomaly planes. Client
+        faults (400/405) are not service unavailability — the service
+        answered correctly; 5xx and the warming/not-warm 503s are."""
+        obs = self.obs
+        if obs is None:
+            return
+        if obs.slo is not None:
+            obs.slo.record(code < 500 and code != 503,
+                           latency_s=latency_s, stages_ms=stages_ms)
+        if obs.anomaly is not None:
+            obs.anomaly.observe('query_latency_s', latency_s)
 
     def _match_inner(self, method, body, trace):
         if method != 'POST':
@@ -551,6 +579,11 @@ class ServeService:
             tracker.observe_query(quality)
         min_margin = getattr(self.args, 'min_margin', 0.0) or 0.0
         margin = quality.get('margin')
+        if margin is not None and self.obs.anomaly is not None:
+            # Accuracy drift watch: a sustained confidence-margin slide
+            # (CUSUM) arms the flight recorder even when no single
+            # answer crosses the --min-margin floor.
+            self.obs.anomaly.observe('quality_margin', margin)
         if min_margin > 0 and margin is not None \
                 and margin < min_margin:
             with self._counts:
@@ -596,6 +629,17 @@ class ServeService:
                 if self.auditor is not None:
                     self.obs.set_gauge('audited_queries',
                                        self.auditor.audited)
+                if self.obs.anomaly is not None:
+                    # Demand-shape watch: served-QPS per flush window.
+                    # A traffic cliff (deploy gone wrong upstream) or
+                    # surge shifts this series and arms the recorder.
+                    with self._counts:
+                        served = self.queries_served
+                    elapsed = max(time.time() - last_flush, 1e-9)
+                    self.obs.anomaly.observe(
+                        'qps',
+                        (served - self._last_flush_queries) / elapsed)
+                    self._last_flush_queries = served
                 self.obs.flush()
                 self._flush_capacity()
                 if self.qtracer is not None:

@@ -72,6 +72,17 @@ batching-headroom projection seeded from the committed bench
 path's own measured service time as a labeled single-point estimate).
 The driver gates on all of it being MEASURED: a missing ramp, ratio,
 reconciliation block, or ``/metrics`` capacity family fails the round.
+
+Since r05 the record carries the SLO/error-budget plane: the bench
+writes the default serve SLO spec (``obs.slo.DEFAULT_SERVE_SPEC``)
+into the workdir and passes it to the worker via ``--slo``, then
+scrapes the drained worker's ``slo.json`` (per-objective error-budget
+consumption, fast/slow multi-window burn rates, breach counters) and
+``anomalies.json`` (the streaming EWMA/CUSUM watch: per-signal
+spike/shift counters and the bounded event ring). The driver gates on
+the SLO account being MEASURED (an availability objective with no
+budget number is a failed round) and on the anomaly ring being
+BOUNDED (events ≤ capacity, truncation accounted).
 """
 
 import argparse
@@ -87,6 +98,7 @@ import time
 
 from dgmc_tpu.obs.observe import percentile
 from dgmc_tpu.obs.qtrace import format_traceparent
+from dgmc_tpu.obs.slo import DEFAULT_SERVE_SPEC
 from dgmc_tpu.serve.client import (confidence_of, discover_endpoint,
                                    get_json, post_match, query_payload,
                                    sample_query)
@@ -465,13 +477,13 @@ def quality_account(ok_rows, serve_quality):
     }
 
 
-def read_worker_quality(obs_root):
-    """The worker's drained ``quality.json`` ``serve`` block. Read from
-    disk AFTER teardown (freshest attempt wins — the post-chaos
-    worker's account): the graceful close drains the shadow-audit
-    queue before the final flush, so the on-disk audit numbers are
-    complete, unlike a live ``/status`` scrape racing the audit
-    thread."""
+def read_worker_artifact(obs_root, name):
+    """The worker's freshest on-disk copy of artifact ``name``
+    (freshest attempt wins — the post-chaos worker's account). Reading
+    from disk AFTER teardown means the graceful close's final flush
+    has landed, so the numbers are complete, unlike a live ``/status``
+    scrape racing the flush thread. Returns the parsed dict or
+    ``None``."""
     dirs = [obs_root]
     try:
         dirs += [os.path.join(obs_root, d)
@@ -481,7 +493,7 @@ def read_worker_quality(obs_root):
         pass
     best = None
     for d in dirs:
-        path = os.path.join(d, 'quality.json')
+        path = os.path.join(d, name)
         try:
             mtime = os.path.getmtime(path)
             with open(path) as f:
@@ -491,8 +503,80 @@ def read_worker_quality(obs_root):
         if best is None or mtime > best[0]:
             best = (mtime, payload)
     if best is None or not isinstance(best[1], dict):
+        return None
+    return best[1]
+
+
+def read_worker_quality(obs_root):
+    """The worker's drained ``quality.json`` ``serve`` block. See
+    :func:`read_worker_artifact`: the graceful close drains the
+    shadow-audit queue before the final flush, so the on-disk audit
+    numbers are complete."""
+    payload = read_worker_artifact(obs_root, 'quality.json')
+    if payload is None:
         return {}
-    return best[1].get('serve') or {}
+    return payload.get('serve') or {}
+
+
+def slo_account(slo_payload):
+    """The round's ``slo`` block from the worker's drained
+    ``slo.json``: per-objective budget consumption, the worst
+    fast-window burn rate, which burn pairs were alerting, and the
+    breach counters. ``None`` when the worker never wrote the account
+    (the unmeasured-SLO gate)."""
+    if not slo_payload:
+        return None
+    objectives = {}
+    worst_fast_burn = None
+    alerting = []
+    for name, obj in sorted((slo_payload.get('objectives')
+                             or {}).items()):
+        if not isinstance(obj, dict):
+            continue
+        burn = {}
+        for wname, b in sorted((obj.get('burn') or {}).items()):
+            if not isinstance(b, dict):
+                continue
+            burn[wname] = {'long': b.get('long'),
+                           'short': b.get('short'),
+                           'threshold': b.get('threshold'),
+                           'alerting': bool(b.get('alerting'))}
+            if b.get('alerting'):
+                alerting.append(f'{name}:{wname}')
+            if wname == 'fast' and b.get('long') is not None:
+                worst_fast_burn = max(worst_fast_burn or 0.0,
+                                      b['long'])
+        objectives[name] = {
+            'objective': obj.get('objective'),
+            'bad_fraction': obj.get('window_bad_fraction'),
+            'budget_consumed': obj.get('budget_consumed'),
+            'events': obj.get('events'),
+            'burn': burn,
+        }
+    breaches = slo_payload.get('breaches') or {}
+    return {
+        'spec': slo_payload.get('slo'),
+        'objectives': objectives,
+        'worst_fast_burn': worst_fast_burn,
+        'alerting': sorted(alerting),
+        'breach_counts': breaches.get('counts') or {},
+        'floors': slo_payload.get('floors'),
+    }
+
+
+def anomaly_account(anomaly_payload):
+    """The round's ``anomaly`` block from the worker's drained
+    ``anomalies.json``: per-signal sample/spike/shift counters and the
+    boundedness evidence (events vs capacity, truncation counter).
+    ``None`` when the worker never wrote the account."""
+    if not anomaly_payload:
+        return None
+    return {
+        'capacity': anomaly_payload.get('capacity'),
+        'events': len(anomaly_payload.get('events') or []),
+        'truncated': anomaly_payload.get('truncated'),
+        'signals': anomaly_payload.get('signals') or {},
+    }
 
 
 def main(argv=None):
@@ -502,6 +586,14 @@ def main(argv=None):
     os.makedirs(work, exist_ok=True)
     obs_root = os.path.join(work, 'obs')
     ckpt_dir = os.path.join(work, 'ckpt')
+
+    # The SLO spec the worker runs under (r05+): the bench pins the
+    # default serve spec to disk so the round record's account is
+    # reproducible from the committed defaults, and the worker tracks
+    # budget/burn against exactly this file.
+    slo_spec_path = os.path.join(work, 'slo_spec.json')
+    with open(slo_spec_path, 'w') as f:
+        json.dump(DEFAULT_SERVE_SPEC, f, indent=1)
 
     serve_cmd = [
         sys.executable, '-m', 'dgmc_tpu.serve', '--supervise',
@@ -515,6 +607,7 @@ def main(argv=None):
         '--num_layers', str(args.num_layers),
         '--num_steps', str(args.num_steps), '--k', str(args.k),
         '--obs-dir', obs_root, '--obs-port', '0',
+        '--slo', slo_spec_path,
         '--watchdog-deadline', '120',
         '--audit-sample', str(args.audit_sample),
         '--min-margin', str(args.min_margin),
@@ -656,6 +749,9 @@ def main(argv=None):
     if qtrace_block is not None:
         qtrace_block['overhead'] = overhead
     quality_block = quality_account(ok, read_worker_quality(obs_root))
+    slo_block = slo_account(read_worker_artifact(obs_root, 'slo.json'))
+    anomaly_block = anomaly_account(
+        read_worker_artifact(obs_root, 'anomalies.json'))
     lats = sorted(r['latency_s'] for r in ok)
     server_ms = sorted(r['server_ms'] for r in ok
                        if r.get('server_ms') is not None)
@@ -749,6 +845,13 @@ def main(argv=None):
             'pairs_sweep': collation_goodput(shapes, args.corpus_dim,
                                              seed=args.seed),
         },
+        # The SLO/error-budget and anomaly planes (r05+): scraped from
+        # the drained worker's slo.json / anomalies.json — the
+        # error-budget account the worker kept live against the spec
+        # the bench pinned, and the streaming watch's spike/shift
+        # counters with the bounded-ring evidence.
+        'slo': slo_block,
+        'anomaly': anomaly_block,
         'restart': {
             'cold_first_answer_s': cold_s,
             'warm_first_answer_s': warm_s,
@@ -840,9 +943,37 @@ def main(argv=None):
     if record['capacity'].get('admission_reconciliation') is None:
         problems.append('lock-wait vs qtrace admission_queue_wait '
                         'reconciliation unmeasured')
+    if slo_block is None or not slo_block.get('objectives'):
+        problems.append('slo account unmeasured (the worker wrote no '
+                        'slo.json despite --slo)')
+    else:
+        avail = slo_block['objectives'].get('availability') or {}
+        if avail.get('budget_consumed') is None:
+            problems.append('slo availability budget never measured '
+                            '(no events reached the tracker)')
+        if not avail.get('events'):
+            problems.append('slo availability objective saw zero '
+                            'events during the load phases')
+    if anomaly_block is None:
+        problems.append('anomaly account unmeasured (the worker wrote '
+                        'no anomalies.json)')
+    else:
+        cap = anomaly_block.get('capacity') or 0
+        if anomaly_block['events'] > cap:
+            problems.append(f"anomaly ring unbounded: "
+                            f"{anomaly_block['events']} events > "
+                            f"capacity {cap}")
+        if anomaly_block.get('truncated') is None:
+            problems.append('anomaly ring truncation counter missing')
+        watched = (anomaly_block.get('signals') or {})
+        if not watched.get('query_latency_s', {}).get('samples'):
+            problems.append('anomaly watch never saw query_latency_s '
+                            '(the per-query feed is dead)')
     for fam in ('dgmc_inflight', 'dgmc_pad_fraction',
                 'dgmc_goodput_ratio', 'dgmc_lock_wait_seconds',
-                'dgmc_lock_hold_seconds'):
+                'dgmc_lock_hold_seconds',
+                'dgmc_slo_error_budget_consumed', 'dgmc_slo_burn_rate',
+                'dgmc_anomaly_spikes_total'):
         if not isinstance(metrics_text, str) \
                 or f'# TYPE {fam} ' not in metrics_text:
             problems.append(f'metric family {fam} missing from '

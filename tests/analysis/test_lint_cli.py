@@ -50,6 +50,11 @@ def test_rule_reference_page_enumerates_every_rule():
         assert f'``{rule}``' in rst, f'{rule} missing from lint-rules.rst'
 
 
+# The baseline-lifecycle tests below each run the full multi-tier
+# analysis 2-3 times over the specimen tree (~25-55s apiece on CPU);
+# they are tier-2 (-m slow). The fast CLI tests keep every code path
+# (filtering, severity, usage errors, concurrency tier) in tier-1.
+@pytest.mark.slow
 def test_json_report_and_fail_on_new(bad_tree, tmp_path, capsys):
     baseline = str(tmp_path / 'bl.json')
     args = ['--json', '--skip-trace', '--skip-recompile',
@@ -67,6 +72,7 @@ def test_json_report_and_fail_on_new(bad_tree, tmp_path, capsys):
         assert f['severity'] in ('error', 'warning', 'info')
 
 
+@pytest.mark.slow
 def test_baseline_roundtrip_suppresses(bad_tree, tmp_path, capsys):
     baseline = str(tmp_path / 'bl.json')
     args = ['--json', '--skip-trace', '--skip-recompile',
@@ -99,6 +105,10 @@ def test_fail_on_error_ignores_warnings(bad_tree, tmp_path, capsys):
     assert rc == 1
 
 
+# Severity filtering runs the multi-tier analysis twice (~23s);
+# tier-1 keeps the select/ignore path, which exercises the same
+# finding-filter plumbing in one pass.
+@pytest.mark.slow
 def test_min_severity_filter(bad_tree, tmp_path, capsys):
     baseline = str(tmp_path / 'bl.json')
     args = ['--json', '--skip-trace', '--skip-recompile',
@@ -129,6 +139,9 @@ def test_missing_obs_dir_is_a_usage_error(tmp_path, capsys):
     assert rc == 2
 
 
+# Baseline-lifecycle family like the roundtrip/prune tests below
+# (~11s of repeated multi-tier analysis): tier-2.
+@pytest.mark.slow
 def test_write_baseline_preserves_unanalyzed_tiers(bad_tree, tmp_path,
                                                    capsys):
     """Refreshing the baseline in a smaller environment (skipped tier /
@@ -186,6 +199,7 @@ def test_select_and_ignore_filtering(bad_tree, tmp_path, capsys):
     assert _run(args + ['--ignore', 'NOPE1'], capsys)[0] == 2
 
 
+@pytest.mark.slow
 def test_prune_baseline_drops_only_stale_entries(bad_tree, tmp_path,
                                                  capsys):
     """--prune-baseline: entries that stopped reproducing go, entries
@@ -250,6 +264,7 @@ def test_skip_sched_drops_sch_and_mem_rules(bad_tree, tmp_path, capsys):
             'MEM404', 'MEM405'} <= set(RULE_CATALOG)
 
 
+@pytest.mark.slow
 def test_prune_baseline_ignores_min_severity(bad_tree, tmp_path,
                                              capsys):
     """--prune-baseline --min-severity error must not classify

@@ -5,6 +5,7 @@ guarantee, as a static check)."""
 import os
 
 import jax
+import pytest
 
 from dgmc_tpu.analysis import (SpecimenCache, callback_equations,
                                lint_concurrency_paths, load_baseline,
@@ -19,6 +20,9 @@ REPO = os.path.dirname(os.path.dirname(os.path.dirname(
 BASELINE = os.path.join(REPO, 'lint-baseline.json')
 
 
+# The full two-tier repo lint (~31s) — CI runs the identical check as
+# its own dgmc-lint step, so tier-1 need not repeat it.
+@pytest.mark.slow
 def test_repo_lint_matches_committed_baseline():
     """No finding outside the reviewed ledger — the exact check CI runs
     (``dgmc-lint --fail-on new``): source AND concurrency tiers over

@@ -101,6 +101,9 @@ def test_pascal_runs(voc_root):
     assert state is not None
 
 
+# Willow repeats the keypoint-CLI shape pascal already smokes in
+# tier-1, at ~21s for the two-run transfer protocol; tier-2 keeps it.
+@pytest.mark.slow
 def test_willow_runs(voc_root, willow_root):
     from examples import willow
     accs = willow.main([
@@ -113,6 +116,9 @@ def test_willow_runs(voc_root, willow_root):
     assert np.isfinite(accs).all()
 
 
+# A second full dbp15k CLI run on top of test_dbp15k_runs (~22s);
+# the resume path itself is covered by the checkpoint-manager tests.
+@pytest.mark.slow
 def test_dbp15k_resumes_mid_schedule(dbp_root, tmp_path, capsys):
     """Kill/restart lands in the right phase with the right step: run the
     two-phase schedule to completion once, then restart from the epoch-2
@@ -148,10 +154,14 @@ def test_dbp15k_resumes_mid_schedule(dbp_root, tmp_path, capsys):
     assert any(json.loads(ln).get('phase') == 2 for ln in lines)
 
 
+@pytest.mark.slow
 def test_dbp15k_model_shards_cli(dbp_root):
     """The --model_shards flag drives the GSPMD corr-sharded path (the
     scale-out axis the reference lacks); on the virtual 8-device CPU
-    platform two model shards must train and evaluate end to end."""
+    platform two model shards must train and evaluate end to end.
+    Tier-2: the sharded-corr parity itself is pinned by
+    tests/parallel/test_sharding.py in tier-1; this adds the CLI
+    wiring on top (~12s)."""
     from examples import dbp15k
     state = dbp15k.main([
         '--category', 'zh_en', '--data_root', str(dbp_root),

@@ -19,6 +19,7 @@ Two guarantees the finiteness/shape smoke tests cannot give:
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 from flax import linen as nn
 
 from dgmc_tpu.models import DGMC
@@ -129,6 +130,9 @@ def test_consensus_iteration_golden_sparse_matches():
         dense[2], [0.66524096, 0.09003057, 0.24472847], atol=1e-6)
 
 
+# A 100-step CPU training run (~47s); the consensus-iteration goldens
+# above pin the numerics in tier-1, the quality floor is tier-2.
+@pytest.mark.slow
 def test_synthetic_matching_quality_floor():
     """Train the flagship dense matcher on synthetic geometric pairs for a
     fixed 100-step budget; unseen-pair Hits@1 must stay ≥ 0.6.

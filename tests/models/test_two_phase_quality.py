@@ -71,8 +71,12 @@ def build_alignment_problem(seed=0):
             PairBatch(s=g_s, t=g_t, y=y_test, y_mask=y_test >= 0))
 
 
-@pytest.mark.parametrize('dtype', [None, jnp.bfloat16],
-                         ids=['f32', 'bf16'])
+# The bf16 arm repeats the full two-phase training run (~18s) purely
+# for the dtype parity; tier-1 keeps the f32 arm.
+@pytest.mark.parametrize(
+    'dtype',
+    [None, pytest.param(jnp.bfloat16, marks=pytest.mark.slow)],
+    ids=['f32', 'bf16'])
 def test_two_phase_schedule_matching_quality(dtype):
     batch, test_batch = build_alignment_problem(seed=0)
     model = DGMC(RelCNN(C, 64, num_layers=2, dropout=0.3, dtype=dtype),

@@ -1,0 +1,186 @@
+"""obs.anomaly: EWMA spike + CUSUM shift detection on synthetic
+step-changes, seeded white-noise silence, the bounded event ring, the
+rate-limited callback, and the offline changepoints scan."""
+
+import random
+
+from dgmc_tpu.obs.anomaly import (AnomalyWatch, CusumDetector,
+                                  EwmaDetector, changepoints)
+from dgmc_tpu.obs.live import prometheus_exposition
+from tests.obs.test_live import parse_exposition
+
+
+class Clock:
+    def __init__(self, t=1_000_000.0):
+        self.t = float(t)
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, s):
+        self.t += s
+
+
+def test_ewma_warmup_trains_silently():
+    d = EwmaDetector(warmup=10)
+    for i in range(10):
+        z, spiked = d.observe(float(i))
+        assert z is None and not spiked
+
+
+def test_ewma_spikes_on_cliff():
+    d = EwmaDetector(alpha=0.1, z_threshold=4.0, warmup=10)
+    rng = random.Random(0)
+    for _ in range(50):
+        d.observe(1.0 + 0.01 * rng.gauss(0, 1))
+    z, spiked = d.observe(5.0)  # a 400-sigma cliff
+    assert spiked and abs(z) > 4.0
+
+
+def test_ewma_flat_history_floor():
+    """A dead-constant signal must not flag an infinitesimal wiggle
+    with an infinite z: the sigma floor keeps z finite."""
+    d = EwmaDetector(warmup=5)
+    for _ in range(20):
+        d.observe(100.0)
+    z, spiked = d.observe(100.0 + 1e-9)
+    assert z is not None and abs(z) < 1.0 and not spiked
+
+
+def test_cusum_fires_on_step_change_and_resets():
+    det = CusumDetector(k=0.5, h=5.0)
+    fired = []
+    # 1-sigma sustained shift: each sample adds z-k = 0.5 to s+.
+    for i in range(30):
+        shifted, direction = det.observe(1.0)
+        if shifted:
+            fired.append((i, direction))
+    assert fired[0] == (9, 'up')  # 10 * 0.5 >= 5.0 at index 9
+    assert det.s_pos < 5.0  # reset after each fire
+    down = CusumDetector(k=0.5, h=5.0)
+    assert any(down.observe(-1.0) == (True, 'down') for _ in range(30))
+
+
+def test_watch_detects_synthetic_step_change():
+    """Quiet gaussian baseline, then the mean jumps 8 sigma: the watch
+    must record the excursion (spike on the cliff, CUSUM shift as it
+    sustains) on exactly that signal."""
+    w = AnomalyWatch(time_fn=Clock())
+    rng = random.Random(1)
+    for _ in range(60):
+        w.observe('step_latency_s', 0.10 + 0.005 * rng.gauss(0, 1))
+    for _ in range(30):
+        w.observe('step_latency_s', 0.14 + 0.005 * rng.gauss(0, 1))
+    c = w.counters()['signals']['step_latency_s']
+    assert c['samples'] == 90
+    assert c['spikes'] >= 1
+    assert c['shifts'] >= 1
+    events = w.snapshot()['events']
+    assert events and events[0]['signal'] == 'step_latency_s'
+    assert events[0]['direction'] == 'up'
+
+
+def test_watch_quiet_on_white_noise():
+    """Seeded white noise at the configured tuning (z=4, ARL ~930):
+    the false-positive budget over 1000 samples is a handful of
+    events, not a stream."""
+    w = AnomalyWatch(time_fn=Clock())
+    rng = random.Random(2)
+    for _ in range(1000):
+        w.observe('qps', 20.0 + 2.0 * rng.gauss(0, 1))
+    c = w.counters()['signals']['qps']
+    assert c['spikes'] + c['shifts'] <= 8  # < 1% of samples
+
+
+def test_ring_bounded_with_truncation_counter():
+    clock = Clock()
+    w = AnomalyWatch(capacity=8, time_fn=clock)
+    # Train on zeros, then feed exponentially growing magnitudes: each
+    # value outpaces the EWMA's adaptation, so every sample anomales.
+    for _ in range(12):
+        w.observe('guard_skips', 0.0)
+    fired = 0
+    for i in range(20):
+        clock.advance(1.0)
+        if w.observe('guard_skips', 10.0 ** (i + 3)) is not None:
+            fired += 1
+    assert fired > 8
+    snap = w.snapshot()
+    assert len(snap['events']) == 8  # capacity holds
+    assert snap['truncated'] == fired - 8
+    assert snap['capacity'] == 8
+    # The freshest events survived the eviction.
+    assert snap['events'][-1]['sample'] == 32
+
+
+def test_callback_rate_limited_per_signal():
+    clock = Clock()
+    calls = []
+    w = AnomalyWatch(capacity=64, time_fn=clock,
+                     on_anomaly=lambda e: calls.append(e['signal']))
+    for _ in range(12):
+        w.observe('qps', 1.0)
+        w.observe('compile_events', 0.0)
+    assert w.observe('qps', 1e9) is not None
+    assert w.observe('qps', 1e12) is not None  # within the cooldown
+    assert calls == ['qps']
+    clock.advance(AnomalyWatch.CALLBACK_COOLDOWN_S + 1.0)
+    assert w.observe('qps', 1e15) is not None
+    assert calls == ['qps', 'qps']
+    # Independent cooldown per signal.
+    assert w.observe('compile_events', 50.0) is not None
+    assert calls == ['qps', 'qps', 'compile_events']
+
+
+def test_callback_exception_never_escapes():
+    def boom(event):
+        raise RuntimeError('observer crashed')
+
+    w = AnomalyWatch(time_fn=Clock(), on_anomaly=boom)
+    for _ in range(12):
+        w.observe('qps', 1.0)
+    event = w.observe('qps', 1e9)  # must not raise
+    assert event is not None and 'spike' in event['kinds']
+
+
+def test_metric_families_strict_exposition():
+    w = AnomalyWatch(time_fn=Clock())
+    for _ in range(12):
+        w.observe('qps', 1.0)
+    w.observe('qps', 1e9)
+    fams = parse_exposition(prometheus_exposition(w.metric_families()))
+    spikes = {s[1]['signal']: s[2]
+              for s in fams['dgmc_anomaly_spikes_total']['samples']}
+    assert spikes['qps'] >= 1
+    assert fams['dgmc_anomaly_ring_truncated_total']['samples'][0][2] == 0
+    # Empty watch still renders grammatically (labeled zero samples).
+    empty = parse_exposition(
+        prometheus_exposition(AnomalyWatch(time_fn=Clock())
+                              .metric_families()))
+    assert empty['dgmc_anomaly_spikes_total']['samples'][0][1] == \
+        {'signal': 'none'}
+
+
+def test_changepoints_one_event_per_excursion():
+    """A sustained step change is ONE changepoint at the shift round —
+    the re-baseline keeps the following steady rounds quiet."""
+    series = [1.0] * 5 + [2.0] * 5
+    cps = changepoints(series)
+    assert len(cps) == 1
+    assert cps[0]['index'] == 5
+    assert cps[0]['direction'] == 'up'
+    assert cps[0]['value'] == 2.0
+
+
+def test_changepoints_down_and_none_handling():
+    series = [10.0, None, 10.0, 10.0, None, 10.0, 3.0, 3.0]
+    cps = changepoints(series)
+    assert len(cps) == 1
+    assert cps[0]['direction'] == 'down'
+    assert cps[0]['index'] == 6  # index in the ORIGINAL series
+
+
+def test_changepoints_stable_and_short_series():
+    assert changepoints([5.0, 5.0, 5.0, 5.0, 5.0]) == []
+    assert changepoints([1.0, 100.0]) == []  # under warmup: no baseline
+    assert changepoints([]) == []

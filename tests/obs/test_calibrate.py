@@ -1,0 +1,263 @@
+"""obs.calibrate: median/MAD noise-floor fits, the fit CLI, and the
+round-trip through ``obs.diff --calibration`` — a within-noise delta
+that the fixed threshold failed must pass the calibrated gate, a
+genuine regression must still fail, and a tight calibration must be
+able to FAIL a delta the fixed threshold waved through."""
+
+import copy
+import json
+import os
+
+import pytest
+
+from dgmc_tpu.obs import calibrate as cal_mod
+from dgmc_tpu.obs import diff as diff_mod
+from tests.obs.test_diff import BASE_TIMINGS, write_run
+
+
+def test_fit_samples_golden():
+    s = cal_mod.fit_samples([1.0, 2.0, 3.0, 4.0, 100.0])
+    assert s['n'] == 5
+    assert s['median'] == 3.0
+    assert s['mad'] == 1.0  # the outlier does not move the MAD
+    assert s['sigma'] == pytest.approx(1.4826)
+    assert s['rel_sigma'] == pytest.approx(1.4826 / 3.0)
+    assert (s['min'], s['max']) == (1.0, 100.0)
+    with pytest.raises(ValueError):
+        cal_mod.fit_samples([])
+
+
+def test_fit_samples_zero_median_has_no_rel_sigma():
+    s = cal_mod.fit_samples([-1.0, 0.0, 1.0])
+    assert s['median'] == 0.0
+    assert s['rel_sigma'] is None
+
+
+def _repeat_runs(tmp_path, p50s):
+    dirs = []
+    for i, p50 in enumerate(p50s):
+        t = copy.deepcopy(BASE_TIMINGS)
+        t['steps']['p50_s'] = p50
+        dirs.append(write_run(tmp_path, f'rep{i}', timings=t))
+    return dirs
+
+
+def test_fit_calibration_from_obs_dirs(tmp_path):
+    dirs = _repeat_runs(tmp_path, [0.10, 0.11, 0.12])
+    cal = cal_mod.fit_calibration(obs_dirs=dirs)
+    m = cal['metrics']['step_p50_s']
+    assert m['n'] == 3
+    assert m['median'] == 0.11
+    assert m['rel_sigma'] == pytest.approx(1.4826 * 0.01 / 0.11)
+    # Repeat-identical metrics fit a zero noise floor, not a crash.
+    assert cal['metrics']['compile_events']['rel_sigma'] == 0.0
+    assert cal['version'] == cal_mod.CALIBRATION_SCHEMA_VERSION
+
+
+def test_fit_calibration_from_round_files(tmp_path):
+    for i, qps in enumerate([20.0, 22.0, 21.0], start=1):
+        p = tmp_path / f'SERVE_r0{i}.json'
+        p.write_text(json.dumps({
+            'family': 'SERVE', 'round': i, 'qps': qps,
+            'clients': 4, 'hits_at_1': 0.19,
+            'latency': {'client_p50_ms': 150.0}}))
+    cal = cal_mod.fit_calibration(round_paths=[str(tmp_path)])
+    assert cal['metrics']['SERVE.qps']['n'] == 3
+    assert cal['metrics']['SERVE.qps']['median'] == 21.0
+    assert 'round' not in {k.split('.')[1]
+                           for k in cal['metrics']}
+
+
+def test_fit_cli_writes_calibration(tmp_path, capsys):
+    dirs = _repeat_runs(tmp_path, [0.10, 0.11, 0.12])
+    out = str(tmp_path / 'calibration.json')
+    rc = cal_mod.main(['--obs-dir', dirs[0], '--obs-dir', dirs[1],
+                       '--obs-dir', dirs[2], '--out', out])
+    assert rc == 0
+    with open(out) as f:
+        cal = json.load(f)
+    assert cal['metrics']['step_p50_s']['n'] == 3
+    assert 'step_p50_s' in capsys.readouterr().out
+
+
+def test_fit_cli_usage_and_undersampled(tmp_path):
+    # No sources at all: usage error (argparse exits 2).
+    with pytest.raises(SystemExit) as exc:
+        cal_mod.main(['--out', str(tmp_path / 'c.json')])
+    assert exc.value.code == 2
+    # One repeat cannot calibrate anything at min-samples 2.
+    d = _repeat_runs(tmp_path, [0.10])
+    assert cal_mod.main(['--obs-dir', d[0],
+                         '--out', str(tmp_path / 'c.json')]) == 2
+
+
+CAL = {
+    'version': 1,
+    'min_samples': 2,
+    'metrics': {
+        # A noisy step-time floor: rel_sigma 0.15 -> 3-sigma gate 0.45.
+        'step_p50_s': {'n': 5, 'median': 0.1, 'mad': 0.0101,
+                       'sigma': 0.015, 'rel_sigma': 0.15,
+                       'min': 0.08, 'max': 0.13},
+    },
+}
+
+
+def _write_cal(tmp_path, cal):
+    p = tmp_path / 'calibration.json'
+    p.write_text(json.dumps(cal))
+    return str(p)
+
+
+def test_apply_calibration_scales_armed_gates():
+    thresholds = {'step_p50': 0.25, 'step_p95': 0.40, 'min_hits1': None}
+    out, notes = cal_mod.apply_calibration(thresholds, CAL)
+    assert out['step_p50'] == pytest.approx(0.45)  # 3 x 0.15
+    assert out['step_p95'] == 0.40      # no stats: fixed kept
+    assert out['min_hits1'] is None     # unarmed gates stay unarmed
+    assert len(notes) == 1
+    n = notes[0]
+    assert n['gate'] == 'step_p50' and n['metric'] == 'step_p50_s'
+    assert n['fixed'] == 0.25 and n['calibrated'] == pytest.approx(0.45)
+
+
+def test_apply_calibration_guards():
+    # Under-sampled stats are ignored (min_samples=3 at apply time).
+    thin = {'version': 1, 'metrics': {
+        'step_p50_s': dict(CAL['metrics']['step_p50_s'], n=2)}}
+    out, notes = cal_mod.apply_calibration({'step_p50': 0.25}, thin)
+    assert out['step_p50'] == 0.25 and notes == []
+    # A dead-flat repeat set floors at 0.01, never a zero-width gate.
+    flat = {'version': 1, 'metrics': {
+        'step_p50_s': dict(CAL['metrics']['step_p50_s'],
+                           rel_sigma=0.0)}}
+    out, _ = cal_mod.apply_calibration({'step_p50': 0.25}, flat)
+    assert out['step_p50'] == 0.01
+    # rel_sigma None (zero median) cannot scale a relative gate.
+    nocal = {'version': 1, 'metrics': {
+        'step_p50_s': dict(CAL['metrics']['step_p50_s'],
+                           rel_sigma=None)}}
+    out, notes = cal_mod.apply_calibration({'step_p50': 0.25}, nocal)
+    assert out['step_p50'] == 0.25 and notes == []
+
+
+def test_load_calibration_errors(tmp_path):
+    with pytest.raises(ValueError):
+        cal_mod.load_calibration(str(tmp_path / 'absent.json'))
+    bad = tmp_path / 'bad.json'
+    bad.write_text(json.dumps({'no_metrics': True}))
+    with pytest.raises(ValueError):
+        cal_mod.load_calibration(str(bad))
+
+
+def _p50_run(tmp_path, name, p50):
+    t = copy.deepcopy(BASE_TIMINGS)
+    t['steps'] = dict(t['steps'], p50_s=p50)
+    return write_run(tmp_path, name, timings=t)
+
+
+def test_diff_calibration_loosens_within_noise_delta(tmp_path, capsys):
+    """The tentpole round-trip: +30% p50 fails the fixed 25% gate but
+    is within 3 sigma of a 15% noise floor — the calibrated gate must
+    pass it, and say so in an info row."""
+    a = _p50_run(tmp_path, 'a', 0.10)
+    b = _p50_run(tmp_path, 'b', 0.13)
+    cal = _write_cal(tmp_path, CAL)
+    assert diff_mod.main([a, b]) == 1          # fixed: REGRESSION
+    capsys.readouterr()
+    assert diff_mod.main([a, b, '--calibration', cal]) == 0
+    out = capsys.readouterr().out
+    assert 'calibrated:step_p50' in out
+    assert 'rel_sigma' in out
+
+
+def test_diff_calibration_still_fails_genuine_regression(tmp_path):
+    a = _p50_run(tmp_path, 'a', 0.10)
+    b = _p50_run(tmp_path, 'b', 0.20)  # +100% >> 3 x 0.15
+    cal = _write_cal(tmp_path, CAL)
+    assert diff_mod.main([a, b, '--calibration', cal]) == 1
+
+
+def test_diff_calibration_tightens_quiet_metric(tmp_path, capsys):
+    """The other direction: a +10% delta the fixed 25% gate waves
+    through FAILS once calibration says the metric repeats within
+    2%."""
+    a = _p50_run(tmp_path, 'a', 0.10)
+    b = _p50_run(tmp_path, 'b', 0.11)
+    quiet = {'version': 1, 'metrics': {
+        'step_p50_s': dict(CAL['metrics']['step_p50_s'],
+                           rel_sigma=0.02)}}
+    cal = _write_cal(tmp_path, quiet)
+    assert diff_mod.main([a, b]) == 0          # fixed: passes
+    capsys.readouterr()
+    assert diff_mod.main([a, b, '--calibration', cal]) == 1
+    assert 'REGRESSION' in capsys.readouterr().out
+
+
+def test_diff_calibration_z_flag(tmp_path):
+    a = _p50_run(tmp_path, 'a', 0.10)
+    b = _p50_run(tmp_path, 'b', 0.13)
+    cal = _write_cal(tmp_path, CAL)
+    # z=1: gate 0.15 < 0.30 delta -> fail; default z=3 passes above.
+    assert diff_mod.main([a, b, '--calibration', cal,
+                          '--calibration-z', '1.0']) == 1
+
+
+def test_diff_calibration_preserves_lost_account_rule(tmp_path, capsys):
+    """Calibration widens gates; it must never un-fail a vanished
+    metric (the lost-account asymmetry is not noise)."""
+    a = write_run(tmp_path, 'a')
+    timerless = copy.deepcopy(BASE_TIMINGS)
+    timerless['steps'] = {}
+    b = write_run(tmp_path, 'b', timings=timerless)
+    cal = _write_cal(tmp_path, CAL)
+    assert diff_mod.main([a, b, '--calibration', cal]) == 1
+    assert 'missing from candidate' in capsys.readouterr().out
+
+
+def test_diff_calibration_unreadable_is_usage_error(tmp_path, capsys):
+    a = write_run(tmp_path, 'a')
+    b = write_run(tmp_path, 'b')
+    assert diff_mod.main([a, b, '--calibration',
+                          str(tmp_path / 'absent.json')]) == 2
+    assert 'calibration' in capsys.readouterr().err
+
+
+def test_diff_json_carries_calibration_notes(tmp_path, capsys):
+    a = _p50_run(tmp_path, 'a', 0.10)
+    b = _p50_run(tmp_path, 'b', 0.13)
+    cal = _write_cal(tmp_path, CAL)
+    assert diff_mod.main([a, b, '--calibration', cal, '--json']) == 0
+    payload = json.loads(capsys.readouterr().out)
+    assert payload['calibration'][0]['gate'] == 'step_p50'
+    uncal = json.loads('null')
+    assert uncal is None  # sanity for the next assertion's shape
+    capsys.readouterr()
+    assert diff_mod.main([a, a, '--json']) == 0
+    payload = json.loads(capsys.readouterr().out)
+    assert payload['calibration'] is None
+
+
+def test_timeline_trend_marks_shift_round(tmp_path, capsys):
+    """obs.timeline --trend: a qps collapse at r05 reads as one
+    changepoint labeled with the ROUND, not the list index."""
+    from dgmc_tpu.obs import timeline as tl
+    for i, qps in enumerate([20.0, 21.0, 20.5, 20.8, 5.0], start=1):
+        p = tmp_path / f'SERVE_r0{i}.json'
+        p.write_text(json.dumps({
+            'family': 'SERVE', 'round': i, 'qps': qps, 'clients': 4,
+            'latency': {'client_p50_ms': 150.0,
+                        'client_p95_ms': 300.0}}))
+    rows = tl.collect_rounds([str(tmp_path)])
+    trends = tl.trend(rows)
+    qps_t = next(t for t in trends if t['metric'] == 'qps')
+    assert qps_t['changepoints'] == [
+        {'round': 5, 'direction': 'down', 'value': 5.0}]
+    # Stable series stay quiet.
+    p50_t = next(t for t in trends
+                 if t['metric'] == 'latency_p50_ms')
+    assert p50_t['changepoints'] == []
+    assert tl.main([str(tmp_path), '--trend']) == 0
+    out = capsys.readouterr().out
+    assert 'trend changepoints' in out
+    assert 'r05 down' in out

@@ -1,0 +1,270 @@
+"""obs.slo: error-budget arithmetic goldens, multi-window burn-rate
+alerting, breach firing + cooldown, windowed-ring expiry, spec
+validation, and the dgmc_slo_* exposition under the strict parser."""
+
+import json
+
+import pytest
+
+from dgmc_tpu.obs.live import prometheus_exposition
+from dgmc_tpu.obs.slo import (DEFAULT_SERVE_SPEC, SloSpec, SloTracker,
+                              WindowedRatio, load_slo_spec)
+from tests.obs.test_live import parse_exposition
+
+
+class Clock:
+    """Deterministic time_fn for golden budget numbers."""
+
+    def __init__(self, t=1_000_000.0):
+        self.t = float(t)
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, s):
+        self.t += s
+
+
+SPEC = {
+    'name': 'test-slo',
+    'window_s': 3600.0,
+    'availability': {'objective': 0.999},
+    'latency': [
+        {'name': 'query', 'threshold_ms': 1000.0, 'objective': 0.95},
+        {'name': 'device_execute', 'stage': 'device_execute',
+         'threshold_ms': 500.0, 'objective': 0.95},
+    ],
+}
+
+
+def feed(tracker, clock, n=1000, bad_every=100, pace_s=0.1,
+         latency_s=0.05):
+    """n events paced over n*pace_s seconds, one bad per bad_every."""
+    for i in range(n):
+        clock.advance(pace_s)
+        tracker.record(i % bad_every != bad_every - 1,
+                       latency_s=latency_s,
+                       stages_ms={'device_execute': latency_s * 1e3})
+
+
+def test_budget_consumption_golden():
+    """1% bad against a 99.9% objective: exactly 10 budgets' worth.
+
+    The same 1% of failed events counts bad for every latency
+    objective too (an error is not a fast success), so the 95%
+    objectives consume 0.01 / 0.05 = 0.2 of their budgets.
+    """
+    clock = Clock()
+    t = SloTracker(SloSpec(SPEC), time_fn=clock)
+    feed(t, clock)  # 1000 events over 100s, 10 bad, all fast
+    state = t.check()
+    avail = state['objectives']['availability']
+    assert avail['events'] == 1000 and avail['bad'] == 10
+    assert avail['window_bad_fraction'] == pytest.approx(0.01)
+    assert avail['budget_consumed'] == pytest.approx(10.0)
+    for name in ('query', 'device_execute'):
+        lat = state['objectives'][name]
+        assert lat['bad'] == 10
+        assert lat['budget_consumed'] == pytest.approx(0.2)
+
+
+def test_multi_window_burn_alerting():
+    """Burn 10.0 pages the slow pair (threshold 6) on both legs but
+    not the fast pair (threshold 14.4) — and the breach callback sees
+    exactly the alerting pair, once."""
+    clock = Clock()
+    breaches = []
+    t = SloTracker(SloSpec(SPEC), time_fn=clock,
+                   on_breach=lambda kind, detail: breaches.append(kind))
+    feed(t, clock)
+    state = t.check()
+    burn = state['objectives']['availability']['burn']
+    assert burn['fast']['long'] == pytest.approx(10.0)
+    assert burn['fast']['short'] == pytest.approx(10.0)
+    assert not burn['fast']['alerting']
+    assert burn['slow']['alerting']
+    assert 'burn:slow:availability' in breaches
+    assert 'budget-exhausted:availability' in breaches  # 10.0 >= 1.0
+    assert not any(k.startswith('burn:fast') for k in breaches)
+
+
+def test_unmeasured_short_leg_cannot_alert():
+    """Events older than the short window leave that leg empty: the
+    multi-window AND must read no-evidence as no-page, even with the
+    long leg far over threshold."""
+    clock = Clock()
+    t = SloTracker(SloSpec(SPEC), time_fn=clock)
+    for _ in range(100):
+        clock.advance(0.1)
+        t.record(False)  # a 100%-bad burst
+    clock.advance(400.0)  # past fast short_s=300, inside long_s=3600
+    burn = t.check()['objectives']['availability']['burn']
+    assert burn['fast']['long'] is not None
+    assert burn['fast']['long'] > 14.4
+    assert burn['fast']['short'] is None
+    assert not burn['fast']['alerting']
+
+
+def test_breach_cooldown_rate_limits_callback():
+    clock = Clock()
+    calls = []
+    t = SloTracker(SloSpec(SPEC), time_fn=clock,
+                   on_breach=lambda kind, detail: calls.append(kind))
+    for _ in range(10):
+        clock.advance(0.1)
+        t.record(False)
+    t.check()
+    t.check()  # same breach kinds inside the cooldown: no re-fire
+    n_first = len(calls)
+    assert n_first > 0
+    clock.advance(SloTracker.BREACH_COOLDOWN_S + 1.0)
+    t.check()
+    assert len(calls) == 2 * n_first
+    # ...but the COUNTS keep counting every judged breach.
+    counts = t.check()['breaches']['counts']
+    assert counts['budget-exhausted:availability'] >= 4
+
+
+def test_floor_breach():
+    spec = SloSpec(dict(SPEC, hits1_floor=0.5, goodput_floor=0.9))
+    clock = Clock()
+    calls = []
+    t = SloTracker(spec, time_fn=clock,
+                   on_breach=lambda kind, detail: calls.append(kind))
+    t.record(True, latency_s=0.01)
+    t.update_gauges(hits1=0.3, goodput=0.95)
+    state = t.check()
+    assert state['floors']['hits1']['breached']
+    assert not state['floors']['goodput']['breached']
+    assert calls == ['floor:hits1']
+    # None clears: an absent headline is unmeasured, not breached.
+    t.update_gauges(hits1=None)
+    assert t.check()['floors']['hits1']['value'] is None
+
+
+def test_windowed_ratio_expiry():
+    clock = Clock()
+    r = WindowedRatio(60.0, bucket_s=10.0, time_fn=clock)
+    for _ in range(10):
+        r.add(False)
+    assert r.bad_fraction(60.0) == 1.0
+    clock.advance(120.0)
+    # Horizon passed: the ring forgot the burst entirely.
+    assert r.bad_fraction(60.0) is None
+    r.add(True)
+    assert r.bad_fraction(60.0) == 0.0
+
+
+def test_windowed_ratio_trailing_window():
+    clock = Clock(1_000_000.0)
+    r = WindowedRatio(100.0, bucket_s=10.0, time_fn=clock)
+    r.add(False)
+    clock.advance(50.0)
+    r.add(True)
+    assert r.counts(100.0) == (1, 2)
+    assert r.counts(20.0) == (0, 1)  # the old bad event aged out
+
+
+def test_ring_bucket_resolves_shortest_window():
+    """The ring bucket must quantize the SHORTEST burn leg into >= 6
+    buckets — a horizon-sized bucket would blind the fast-short leg."""
+    spec = SloSpec(DEFAULT_SERVE_SPEC)
+    assert spec.horizon_s == 21600.0
+    assert spec.ring_bucket_s == 50.0  # min(3600, 300, 1800) / 6
+    pinned = SloSpec(dict(SPEC, bucket_s=5.0))
+    assert pinned.ring_bucket_s == 5.0
+
+
+@pytest.mark.parametrize('raw, needle', [
+    ({'availability': {'objective': 1.5}}, 'objective'),
+    ({'availability': {'objective': 0.999},
+      'latency': [{'name': 'q', 'threshold_ms': -3,
+                   'objective': 0.9}]}, 'threshold_ms'),
+    ({'latency': [{'name': 'q', 'threshold_ms': 10, 'objective': 0.9},
+                  {'name': 'q', 'threshold_ms': 20,
+                   'objective': 0.9}]}, 'duplicate'),
+    ({'window_s': -1, 'availability': {'objective': 0.9}}, 'window_s'),
+    ({}, 'no objectives'),
+    ({'availability': {'objective': 0.9},
+      'burn_windows': {'fast': {'long_s': 10.0, 'short_s': 60.0,
+                                'threshold': 2.0}}}, 'short_s'),
+    ([], 'object'),
+])
+def test_spec_validation_errors(raw, needle):
+    with pytest.raises(ValueError, match=needle):
+        SloSpec(raw)
+
+
+def test_load_slo_spec_errors(tmp_path):
+    with pytest.raises(ValueError, match='cannot read'):
+        load_slo_spec(str(tmp_path / 'absent.json'))
+    bad = tmp_path / 'bad.json'
+    bad.write_text('{not json')
+    with pytest.raises(ValueError, match='not valid JSON'):
+        load_slo_spec(str(bad))
+    good = tmp_path / 'good.json'
+    good.write_text(json.dumps(DEFAULT_SERVE_SPEC))
+    assert load_slo_spec(str(good)).name == 'serve-default'
+
+
+def test_metric_families_strict_exposition():
+    clock = Clock()
+    spec = SloSpec(dict(SPEC, hits1_floor=0.5))
+    t = SloTracker(spec, time_fn=clock)
+    feed(t, clock, n=100, bad_every=10)
+    t.update_gauges(hits1=0.4)
+    text = prometheus_exposition(t.metric_families())
+    fams = parse_exposition(text)
+    for name in ('dgmc_slo_error_budget_consumed', 'dgmc_slo_burn_rate',
+                 'dgmc_slo_burn_alerting', 'dgmc_slo_events_total',
+                 'dgmc_slo_breaches_total', 'dgmc_slo_floor_breached'):
+        assert name in fams, name
+    consumed = {s[1]['objective']: s[2]
+                for s in fams['dgmc_slo_error_budget_consumed']['samples']}
+    assert consumed['availability'] == pytest.approx(100.0)
+    events = {(s[1]['objective'], s[1]['outcome']): s[2]
+              for s in fams['dgmc_slo_events_total']['samples']}
+    assert events[('availability', 'bad')] == 10
+    assert events[('availability', 'good')] == 90
+    legs = {(s[1]['objective'], s[1]['window'], s[1]['leg'])
+            for s in fams['dgmc_slo_burn_rate']['samples']}
+    assert ('availability', 'fast', 'short') in legs
+    floor = fams['dgmc_slo_floor_breached']['samples'][0]
+    assert floor[1]['floor'] == 'hits1' and floor[2] == 1
+
+
+def test_empty_tracker_exposition_parses():
+    """Zero events must still render a grammatical exposition (the
+    breaches family keeps a labeled zero sample)."""
+    t = SloTracker(SloSpec(SPEC), time_fn=Clock())
+    fams = parse_exposition(prometheus_exposition(t.metric_families()))
+    kinds = [s[1]['kind']
+             for s in fams['dgmc_slo_breaches_total']['samples']]
+    assert kinds == ['none']
+    assert fams['dgmc_slo_error_budget_consumed']['samples'] == []
+
+
+def test_status_omits_spec_echo():
+    t = SloTracker(SloSpec(SPEC), time_fn=Clock())
+    assert 'spec' in t.snapshot()
+    assert 'spec' not in t.status()
+
+
+def test_stage_latency_uses_named_stage():
+    """The device_execute objective judges the qtrace stage, not the
+    end-to-end latency; an event without the stage is no evidence."""
+    clock = Clock()
+    t = SloTracker(SloSpec(SPEC), time_fn=clock)
+    # Fast end-to-end, slow device stage: only the stage objective
+    # should burn.
+    for _ in range(20):
+        clock.advance(0.1)
+        t.record(True, latency_s=0.01,
+                 stages_ms={'device_execute': 900.0})
+    state = t.check()
+    assert state['objectives']['query']['bad'] == 0
+    assert state['objectives']['device_execute']['bad'] == 20
+    # No stages_ms at all: the stage objective records nothing.
+    t2 = SloTracker(SloSpec(SPEC), time_fn=clock)
+    t2.record(True, latency_s=0.01)
+    assert t2.check()['objectives']['device_execute']['events'] == 0

@@ -40,7 +40,12 @@ def _batch(B=8, n=8, e=20, c=4, seed=0):
     return PairBatch(s=side(1), t=side(2), y=y, y_mask=y >= 0)
 
 
-@pytest.mark.parametrize('ndev', [8])
+# The cross-device BN-stat sync contract holds at any device count;
+# tier-1 pins it on 2 devices (~1/3 the wall clock), tier-2 repeats
+# it at the full virtual-8 mesh.
+@pytest.mark.parametrize('ndev', [2,
+                                  pytest.param(8,
+                                               marks=pytest.mark.slow)])
 def test_bn_stats_match_single_device(ndev):
     if len(jax.devices()) < ndev:
         pytest.skip(f'needs {ndev} devices')
@@ -57,7 +62,7 @@ def test_bn_stats_match_single_device(ndev):
     single = make_train_step(model)
     s1, out1 = single(state, batch, key)
 
-    mesh = make_mesh(data=ndev)
+    mesh = make_mesh(data=ndev, devices=jax.devices()[:ndev])
     sharded = make_sharded_train_step(model, mesh)
     s2, out2 = sharded(replicate(state_host, mesh),
                        shard_batch(batch, mesh), key)

@@ -56,6 +56,10 @@ def test_scalars_never_partitioned():
     assert specs['vec'] == P('data')
 
 
+# ~15s end-to-end guarded-state train/restore; the guard semantics
+# themselves stay tier-1 in tests/resilience/test_guard_step.py, and
+# the rule-typing contract in the lighter tests above.
+@pytest.mark.slow
 def test_guarded_train_state_round_trip():
     """One rule list types the ENTIRE GuardedTrainState pytree: the spec
     tree has the state's exact structure, optimizer moments follow their
@@ -149,6 +153,7 @@ def test_streamed_dense_rejected():
             {}, None, None)  # raises before touching args
 
 
+@pytest.mark.slow
 def test_streamed_rules_train_eval_match_reference():
     """The full rules-driven path (S row-sharded over ``data``, streamed
     shortlisting, rule-typed state in/out shardings) against the
